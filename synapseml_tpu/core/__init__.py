@@ -1,0 +1,45 @@
+"""Core substrate: params, stages, pipelines, columnar tables, persistence, telemetry."""
+
+from .params import ComplexParam, Param, ParamValidators, Params
+from .stage import (
+    Estimator,
+    Model,
+    Pipeline,
+    PipelineModel,
+    PipelineStage,
+    STAGE_REGISTRY,
+    Transformer,
+    UnaryTransformer,
+    stage_class,
+)
+from .table import Table, concat_tables
+from .serialization import load_stage, register_state_class, save_stage
+from .clock import StopWatch, buffered_map
+from .fault import retry_with_backoff, retry_with_timeout, using, using_many
+
+__all__ = [
+    "Param",
+    "ComplexParam",
+    "Params",
+    "ParamValidators",
+    "PipelineStage",
+    "Transformer",
+    "Estimator",
+    "Model",
+    "Pipeline",
+    "PipelineModel",
+    "UnaryTransformer",
+    "STAGE_REGISTRY",
+    "stage_class",
+    "Table",
+    "concat_tables",
+    "save_stage",
+    "load_stage",
+    "register_state_class",
+    "StopWatch",
+    "buffered_map",
+    "retry_with_backoff",
+    "retry_with_timeout",
+    "using",
+    "using_many",
+]

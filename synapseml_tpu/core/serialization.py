@@ -1,0 +1,169 @@
+"""Stage / pipeline persistence.
+
+Rebuild of the reference's ``ComplexParamsWritable/Readable`` machinery
+(``core/.../core/serialize/ComplexParamsSerializer.scala`` + the custom param classes
+under ``org/apache/spark/ml/param/``): a stage saves to a *directory* containing
+
+- ``metadata.json`` — class name, uid, framework version, all simple (JSON) params;
+- one entry per set complex param, dispatched by value type:
+  nested stages recurse into subdirectories, numpy arrays become ``.npy``, dicts of
+  arrays ``.npz``, bytes ``.bin``; objects exposing the ``state_dict()`` /
+  ``from_state_dict()`` protocol (e.g. fitted boosters) get a typed JSON+npz pair.
+
+Round-tripping every stage through save/load is enforced by the serialization fuzzing
+meta-test (reference: ``SerializationFuzzing``, ``core/src/test/.../Fuzzing.scala:222``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+from typing import Any, Dict
+
+import numpy as np
+
+from .params import Params
+from .telemetry import BUILD_VERSION
+
+__all__ = ["save_stage", "load_stage", "register_state_class", "STATE_REGISTRY"]
+
+# Classes implementing state_dict()/from_state_dict(), keyed by class name.
+STATE_REGISTRY: Dict[str, type] = {}
+
+
+def register_state_class(cls):
+    """Class decorator registering a ``state_dict``-protocol type for persistence."""
+    STATE_REGISTRY[cls.__name__] = cls
+    return cls
+
+
+def _is_stage(v) -> bool:
+    from .stage import PipelineStage
+
+    return isinstance(v, PipelineStage)
+
+
+def _save_value(value, path: str) -> Dict[str, Any]:
+    """Persist one complex value under ``path`` (a directory prefix, no extension).
+
+    Returns a JSON descriptor recorded in metadata so load can dispatch."""
+    from .stage import PipelineStage
+
+    if isinstance(value, PipelineStage):
+        save_stage(value, path + ".stage")
+        return {"kind": "stage"}
+    if isinstance(value, np.ndarray):
+        np.save(path + ".npy", value, allow_pickle=value.dtype == object)
+        return {"kind": "ndarray", "pickled": bool(value.dtype == object)}
+    if isinstance(value, bytes):
+        with open(path + ".bin", "wb") as f:
+            f.write(value)
+        return {"kind": "bytes"}
+    if isinstance(value, (list, tuple)) and all(_is_stage(v) for v in value) and value:
+        os.makedirs(path + ".stages", exist_ok=True)
+        for i, st in enumerate(value):
+            save_stage(st, os.path.join(path + ".stages", f"{i:04d}"))
+        return {"kind": "stages", "n": len(value), "tuple": isinstance(value, tuple)}
+    if type(value).__name__ in STATE_REGISTRY and hasattr(value, "state_dict"):
+        state = value.state_dict()
+        arrays = {k: np.asarray(v) for k, v in state.items() if isinstance(v, np.ndarray)}
+        scalars = {k: v for k, v in state.items() if not isinstance(v, np.ndarray)}
+        np.savez(path + ".state.npz", **arrays)
+        with open(path + ".state.json", "w") as f:
+            json.dump({"class": type(value).__name__, "scalars": scalars}, f, default=_jsonable)
+        return {"kind": "state"}
+    # Last resort: JSON-serializable python structures (lists/dicts of simple values).
+    try:
+        with open(path + ".json", "w") as f:
+            json.dump(value, f, default=_jsonable)
+        return {"kind": "json"}
+    except TypeError:
+        raise TypeError(
+            f"Cannot serialize complex param value of type {type(value).__name__} at {path}. "
+            f"Implement state_dict()/from_state_dict() and @register_state_class it."
+        )
+
+
+def _load_value(desc: Dict[str, Any], path: str):
+    kind = desc["kind"]
+    if kind == "stage":
+        return load_stage(path + ".stage")
+    if kind == "ndarray":
+        return np.load(path + ".npy", allow_pickle=desc.get("pickled", False))
+    if kind == "bytes":
+        with open(path + ".bin", "rb") as f:
+            return f.read()
+    if kind == "stages":
+        out = [
+            load_stage(os.path.join(path + ".stages", f"{i:04d}")) for i in range(desc["n"])
+        ]
+        return tuple(out) if desc.get("tuple") else out
+    if kind == "state":
+        with open(path + ".state.json") as f:
+            head = json.load(f)
+        cls = STATE_REGISTRY[head["class"]]
+        arrays = dict(np.load(path + ".state.npz", allow_pickle=False))
+        return cls.from_state_dict({**head["scalars"], **arrays})
+    if kind == "json":
+        with open(path + ".json") as f:
+            return json.load(f)
+    raise ValueError(f"Unknown complex value kind {kind!r}")
+
+
+from .params import _json_default as _jsonable  # single JSON-coercion rule for the package
+
+
+def save_stage(stage: Params, path: str) -> None:
+    if os.path.exists(path):
+        if not os.path.isdir(path):
+            raise ValueError(f"save path {path!r} exists and is not a directory")
+        # Only clobber directories we wrote (marked by metadata.json) or empty ones —
+        # a typo'd path must not silently destroy unrelated files.
+        if os.path.exists(os.path.join(path, "metadata.json")) or not os.listdir(path):
+            shutil.rmtree(path)
+        else:
+            raise ValueError(
+                f"save path {path!r} exists and does not look like a saved stage; refusing to overwrite"
+            )
+    os.makedirs(path, exist_ok=True)
+    complex_descs = {}
+    for name, value in stage.complex_param_values().items():
+        if value is None:
+            complex_descs[name] = {"kind": "none"}
+            continue
+        complex_descs[name] = _save_value(value, os.path.join(path, name))
+    meta = {
+        "class": type(stage).__name__,
+        "uid": stage.uid,
+        "buildVersion": BUILD_VERSION,
+        "params": stage.simple_param_values(),
+        "complexParams": complex_descs,
+    }
+    with open(os.path.join(path, "metadata.json"), "w") as f:
+        json.dump(meta, f, indent=2, sort_keys=True, default=_jsonable)
+
+
+def load_stage(path: str):
+    from .stage import stage_class
+
+    with open(os.path.join(path, "metadata.json")) as f:
+        meta = json.load(f)
+    cls = stage_class(meta["class"])
+    stage = cls.__new__(cls)
+    # Initialize Params plumbing without invoking subclass __init__ conventions.
+    object.__setattr__(stage, "_param_values", {})
+    stage.uid = meta["uid"]
+    for k, v in meta["params"].items():
+        param = cls.get_param(k)
+        if param.dtype is tuple and isinstance(v, list):
+            v = tuple(v)
+        stage.set(k, v)
+    for name, desc in meta["complexParams"].items():
+        if desc["kind"] == "none":
+            stage.set(name, None)
+        else:
+            stage.set(name, _load_value(desc, os.path.join(path, name)))
+    if hasattr(stage, "_post_load"):
+        stage._post_load()
+    return stage

@@ -1,0 +1,195 @@
+"""Pipeline stage abstractions: Transformer / Estimator / Model / Pipeline.
+
+TPU-native rebuild of the SparkML stage contract the reference builds everything on
+(``Estimator``/``Transformer``/``PipelineStage``; reference usage e.g.
+``LightGBMBase.train`` at ``lightgbm/.../LightGBMBase.scala:43`` and every transformer in
+``core/.../stages/``). Differences from the reference, by design:
+
+- stages consume/produce :class:`~synapseml_tpu.core.table.Table` (columnar batches)
+  instead of Spark DataFrames;
+- there is no lazy query planner: ``transform`` is eager. XLA jit inside stages is the
+  "planner" — stages are encouraged to implement vectorized whole-table computation and
+  fall back to ``map_partitions`` only for IO / native-engine paths;
+- every concrete stage auto-registers in :data:`STAGE_REGISTRY` (the analogue of
+  ``JarLoadingUtils.instantiateServices`` classpath reflection,
+  ``core/.../core/utils/JarLoadingUtils.scala:44-56``) which powers save/load,
+  codegen and the fuzzing meta-test.
+"""
+
+from __future__ import annotations
+
+import inspect
+from typing import Any, Dict, List, Optional, Sequence
+
+from .params import ComplexParam, Param, Params
+from .table import Table
+from .telemetry import log_stage_call
+
+__all__ = [
+    "PipelineStage",
+    "Transformer",
+    "Estimator",
+    "Model",
+    "Pipeline",
+    "PipelineModel",
+    "UnaryTransformer",
+    "STAGE_REGISTRY",
+    "register_stage",
+    "stage_class",
+]
+
+# name -> class, for save/load + reflection tests (SURVEY.md §4 FuzzingTest).
+STAGE_REGISTRY: Dict[str, type] = {}
+
+
+def register_stage(cls):
+    prev = STAGE_REGISTRY.get(cls.__name__)
+    if prev is not None and prev.__module__ != cls.__module__:
+        import logging
+
+        logging.getLogger("synapseml_tpu").warning(
+            "stage name collision: %s defined in both %s and %s; later wins for load_stage",
+            cls.__name__, prev.__module__, cls.__module__,
+        )
+    STAGE_REGISTRY[cls.__name__] = cls
+    return cls
+
+
+def stage_class(name: str) -> type:
+    try:
+        return STAGE_REGISTRY[name]
+    except KeyError:
+        raise KeyError(f"Unknown stage class {name!r}. Registered: {sorted(STAGE_REGISTRY)}") from None
+
+
+class PipelineStage(Params):
+    """Common base: params + uid + save/load."""
+
+    def __init_subclass__(cls, **kwargs):
+        super().__init_subclass__(**kwargs)
+        if not inspect.isabstract(cls) and not cls.__name__.startswith("_"):
+            register_stage(cls)
+
+    # save/load implemented in serialization.py to keep this module dependency-light.
+    def save(self, path: str) -> None:
+        from .serialization import save_stage
+
+        save_stage(self, path)
+
+    @staticmethod
+    def load(path: str) -> "PipelineStage":
+        from .serialization import load_stage
+
+        return load_stage(path)
+
+    def _validate_input(self, table: Table, *needed_cols: str) -> None:
+        for c in needed_cols:
+            if c not in table:
+                raise ValueError(
+                    f"{type(self).__name__}({self.uid}): input is missing column {c!r}; "
+                    f"available: {table.column_names}"
+                )
+
+
+class Transformer(PipelineStage):
+    """Maps a Table to a Table (reference: SparkML ``Transformer``)."""
+
+    def transform(self, table: Table) -> Table:
+        log_stage_call(self, "transform")
+        return self._transform(table)
+
+    def _transform(self, table: Table) -> Table:  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    def __call__(self, table: Table) -> Table:
+        return self.transform(table)
+
+
+class Estimator(PipelineStage):
+    """Fits a Table, producing a :class:`Model` (reference: SparkML ``Estimator``)."""
+
+    def fit(self, table: Table) -> "Model":
+        log_stage_call(self, "fit")
+        model = self._fit(table)
+        model.parent = self
+        return model
+
+    def _fit(self, table: Table) -> "Model":  # pragma: no cover - abstract
+        raise NotImplementedError
+
+
+class Model(Transformer):
+    """A fitted transformer. ``parent`` points back at the estimator."""
+
+    parent: Optional[Estimator] = None
+
+
+class UnaryTransformer(Transformer):
+    """Convenience: input column -> output column transformers."""
+
+    input_col = Param("input column name", str, default="input")
+    output_col = Param("output column name", str, default="output")
+
+    def _transform(self, table: Table) -> Table:
+        self._validate_input(table, self.input_col)
+        out = self._transform_column(table.column(self.input_col), table)
+        return table.with_column(self.output_col, out)
+
+    def _transform_column(self, col, table: Table):  # pragma: no cover - abstract
+        raise NotImplementedError
+
+
+class Pipeline(Estimator):
+    """Sequential composition of stages (reference: SparkML ``Pipeline``).
+
+    ``fit`` threads the table through: estimators are fitted and replaced by their
+    models (which then transform the running table); transformers transform directly.
+    """
+
+    stages = ComplexParam("list of pipeline stages", list, default=[])
+
+    def __init__(self, stages: Optional[Sequence[PipelineStage]] = None, uid=None, **kw):
+        super().__init__(uid=uid, **kw)
+        if stages is not None:
+            self.set("stages", list(stages))
+
+    def _fit(self, table: Table) -> "PipelineModel":
+        stages = list(self.stages)
+        fitted: List[Transformer] = []
+        cur = table
+        for i, st in enumerate(stages):
+            is_last = i == len(stages) - 1
+            if isinstance(st, Estimator):
+                m = st.fit(cur)
+                if not is_last:  # skip the (possibly expensive) discarded final transform
+                    cur = m.transform(cur)
+                fitted.append(m)
+            elif isinstance(st, Transformer):
+                if not is_last:
+                    cur = st.transform(cur)
+                fitted.append(st)
+            else:
+                raise TypeError(f"Pipeline stage {st!r} is neither Estimator nor Transformer")
+        return PipelineModel(stages=fitted)
+
+
+class PipelineModel(Model):
+    """Fitted pipeline: applies each fitted stage in order.
+
+    Reference also constructs these directly from stage arrays
+    (``NamespaceInjections.pipelineModel``, used at ``CognitiveServiceBase.scala:318``) —
+    the constructor here serves the same purpose.
+    """
+
+    stages = ComplexParam("list of fitted transformer stages", list, default=[])
+
+    def __init__(self, stages: Optional[Sequence[Transformer]] = None, uid=None, **kw):
+        super().__init__(uid=uid, **kw)
+        if stages is not None:
+            self.set("stages", list(stages))
+
+    def _transform(self, table: Table) -> Table:
+        cur = table
+        for st in self.stages:
+            cur = st.transform(cur)
+        return cur

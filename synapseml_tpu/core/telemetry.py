@@ -1,0 +1,50 @@
+"""Structured per-stage telemetry.
+
+Rebuild of the reference's ``BasicLogging`` trait
+(``core/.../logging/BasicLogging.scala:26-71``): every stage method call emits one
+structured JSON event ``{uid, className, method, buildVersion}`` so hosts can count
+feature usage. Here events go to the ``synapseml_tpu.telemetry`` logger at DEBUG and to
+an in-process ring buffer that tests/tools can inspect (``recent_events``).
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import logging
+import threading
+import time
+from typing import Any, Dict, List
+
+__all__ = ["log_stage_call", "recent_events", "clear_events", "BUILD_VERSION"]
+
+BUILD_VERSION = "0.1.0"
+
+_logger = logging.getLogger("synapseml_tpu.telemetry")
+_events: "collections.deque[Dict[str, Any]]" = collections.deque(maxlen=4096)
+_lock = threading.Lock()
+
+
+def log_stage_call(stage, method: str, **extra) -> None:
+    evt = {
+        "uid": getattr(stage, "uid", "?"),
+        "className": type(stage).__name__,
+        "method": method,
+        "buildVersion": BUILD_VERSION,
+        "ts": time.time(),
+        **extra,
+    }
+    with _lock:
+        _events.append(evt)
+    if _logger.isEnabledFor(logging.DEBUG):
+        _logger.debug("metrics/ %s", json.dumps(evt, default=str))
+
+
+def recent_events() -> List[Dict[str, Any]]:
+    with _lock:
+        return list(_events)
+
+
+def clear_events() -> None:
+    with _lock:
+        _events.clear()

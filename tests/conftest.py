@@ -1,0 +1,32 @@
+"""Test harness configuration.
+
+Multi-chip behavior is tested on a *virtual 8-device CPU mesh* (no TPU hardware in unit
+CI), mirroring how the reference simulates multi-task distribution with `local[*]`
+Spark (reference: ``core/src/test/.../SparkSessionFactory.scala`` — SURVEY.md §4
+"Multi-node without a real cluster"). Flags must be set before jax initializes.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(42)
+
+
+@pytest.fixture(scope="session")
+def eight_device_mesh():
+    import jax
+    from jax.sharding import Mesh
+
+    devs = np.array(jax.devices()[:8]).reshape(4, 2)
+    return Mesh(devs, ("data", "model"))

@@ -1,0 +1,79 @@
+import pytest
+
+from synapseml_tpu.core import ComplexParam, Param, ParamValidators, Params
+
+
+class Widget(Params):
+    size = Param("widget size", int, default=3, validator=ParamValidators.gt(0))
+    name = Param("widget name", str, default="w")
+    payload = ComplexParam("arbitrary payload", object, default=None)
+    required = Param("no default", float)
+
+
+class SubWidget(Widget):
+    extra = Param("extra knob", bool, default=False)
+
+
+def test_defaults_and_set():
+    w = Widget()
+    assert w.size == 3
+    assert w.name == "w"
+    w.size = 10
+    assert w.size == 10
+    w.set("name", "z")
+    assert w.name == "z"
+
+
+def test_ctor_kwargs():
+    w = Widget(size=5, name="q")
+    assert w.size == 5 and w.name == "q"
+
+
+def test_validation():
+    w = Widget()
+    with pytest.raises(ValueError):
+        w.size = -1
+    with pytest.raises(KeyError):
+        w.set("nope", 1)
+
+
+def test_required_param_raises_until_set():
+    w = Widget()
+    with pytest.raises(KeyError):
+        _ = w.required
+    w.required = 2.5
+    assert w.required == 2.5
+
+
+def test_inheritance_merges_params():
+    assert set(SubWidget.params()) == {"size", "name", "payload", "required", "extra"}
+    s = SubWidget(extra=True)
+    assert s.extra is True and s.size == 3
+
+
+def test_copy_isolated():
+    w = Widget(size=7)
+    w2 = w.copy({"size": 9})
+    assert w.size == 7 and w2.size == 9
+    assert w.uid == w2.uid  # copy keeps identity, like SparkML copy()
+
+
+def test_simple_vs_complex_split():
+    w = Widget(size=4, payload={"a": 1})
+    assert "payload" not in w.simple_param_values()
+    assert w.complex_param_values() == {"payload": {"a": 1}}
+
+
+def test_explain_params_mentions_all():
+    text = Widget().explain_params()
+    for p in ["size", "name", "payload", "required"]:
+        assert p in text
+
+
+def test_mutable_default_not_shared():
+    class L(Params):
+        items = Param("list", list, default=[])
+
+    a, b = L(), L()
+    a.items.append(1)  # appends to a copy, not to the class default
+    assert b.items == []

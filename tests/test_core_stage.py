@@ -1,0 +1,145 @@
+import numpy as np
+import pytest
+
+from synapseml_tpu.core import (
+    ComplexParam,
+    Estimator,
+    Model,
+    Param,
+    Pipeline,
+    PipelineModel,
+    STAGE_REGISTRY,
+    Table,
+    Transformer,
+    UnaryTransformer,
+    load_stage,
+)
+from synapseml_tpu.core.serialization import register_state_class
+from synapseml_tpu.core.telemetry import clear_events, recent_events
+
+
+class AddConst(UnaryTransformer):
+    amount = Param("value to add", float, default=1.0)
+
+    def _transform_column(self, col, table):
+        return col + self.amount
+
+
+class MeanCenterModel(Model):
+    input_col = Param("input col", str, default="x")
+    mean = Param("fitted mean", float, default=0.0)
+
+    def _transform(self, table):
+        return table.with_column(self.input_col, table[self.input_col] - self.mean)
+
+
+class MeanCenter(Estimator):
+    input_col = Param("input col", str, default="x")
+
+    def _fit(self, table):
+        return MeanCenterModel(
+            input_col=self.input_col, mean=float(np.mean(table[self.input_col]))
+        )
+
+
+@pytest.fixture
+def t():
+    return Table({"x": np.array([1.0, 2.0, 3.0, 4.0])})
+
+
+def test_transformer(t):
+    out = AddConst(input_col="x", output_col="y", amount=2.0).transform(t)
+    np.testing.assert_allclose(out["y"], [3, 4, 5, 6])
+
+
+def test_estimator_fit_sets_parent(t):
+    est = MeanCenter()
+    m = est.fit(t)
+    assert m.parent is est
+    np.testing.assert_allclose(m.transform(t)["x"], [-1.5, -0.5, 0.5, 1.5])
+
+
+def test_missing_column_message(t):
+    with pytest.raises(ValueError, match="missing column"):
+        AddConst(input_col="nope").transform(t)
+
+
+def test_pipeline_fit_transform(t):
+    pipe = Pipeline(stages=[AddConst(input_col="x", output_col="x", amount=10.0), MeanCenter()])
+    pm = pipe.fit(t)
+    assert isinstance(pm, PipelineModel)
+    out = pm.transform(t)
+    np.testing.assert_allclose(out["x"], [-1.5, -0.5, 0.5, 1.5])
+
+
+def test_registry_contains_stages():
+    for name in ["AddConst", "MeanCenter", "MeanCenterModel", "Pipeline", "PipelineModel"]:
+        assert name in STAGE_REGISTRY
+
+
+def test_save_load_roundtrip(tmp_path, t):
+    stage = AddConst(input_col="x", output_col="y", amount=5.0)
+    p = str(tmp_path / "s1")
+    stage.save(p)
+    loaded = load_stage(p)
+    assert type(loaded) is AddConst
+    assert loaded.uid == stage.uid
+    np.testing.assert_allclose(loaded.transform(t)["y"], stage.transform(t)["y"])
+
+
+def test_save_load_fitted_pipeline(tmp_path, t):
+    pm = Pipeline(stages=[AddConst(input_col="x", output_col="x"), MeanCenter()]).fit(t)
+    p = str(tmp_path / "pm")
+    pm.save(p)
+    loaded = load_stage(p)
+    out1, out2 = pm.transform(t), loaded.transform(t)
+    np.testing.assert_allclose(out1["x"], out2["x"])
+
+
+def test_save_load_ndarray_complex_param(tmp_path, t):
+    class ArrStage(Transformer):
+        weights = ComplexParam("weight array", np.ndarray, default=None)
+
+        def _transform(self, table):
+            return table.with_column("w", np.resize(self.weights, table.num_rows))
+
+    s = ArrStage(weights=np.array([1.0, 2.0]))
+    p = str(tmp_path / "arr")
+    s.save(p)
+    loaded = load_stage(p)
+    np.testing.assert_allclose(loaded.weights, [1.0, 2.0])
+
+
+def test_state_protocol_roundtrip(tmp_path):
+    @register_state_class
+    class Booster:
+        def __init__(self, w, n):
+            self.w, self.n = w, n
+
+        def state_dict(self):
+            return {"w": self.w, "n": self.n}
+
+        @classmethod
+        def from_state_dict(cls, d):
+            return cls(d["w"], int(d["n"]))
+
+    class BoostStage(Transformer):
+        booster = ComplexParam("fitted booster", object, default=None)
+
+        def _transform(self, table):
+            return table
+
+    s = BoostStage(booster=Booster(np.arange(3.0), 7))
+    p = str(tmp_path / "b")
+    s.save(p)
+    loaded = load_stage(p)
+    assert loaded.booster.n == 7
+    np.testing.assert_allclose(loaded.booster.w, [0, 1, 2])
+
+
+def test_telemetry_events(t):
+    clear_events()
+    MeanCenter().fit(t).transform(t)
+    methods = [(e["className"], e["method"]) for e in recent_events()]
+    assert ("MeanCenter", "fit") in methods
+    assert ("MeanCenterModel", "transform") in methods

@@ -1,0 +1,99 @@
+import numpy as np
+import pytest
+
+from synapseml_tpu.core import Table, concat_tables
+
+
+@pytest.fixture
+def t():
+    return Table(
+        {
+            "x": np.arange(10, dtype=np.float32),
+            "label": np.arange(10) % 2,
+            "text": [f"row{i}" for i in range(10)],
+            "vec": np.arange(20, dtype=np.float32).reshape(10, 2),
+        },
+        npartitions=3,
+    )
+
+
+def test_basic_shape(t):
+    assert t.num_rows == 10
+    assert set(t.column_names) == {"x", "label", "text", "vec"}
+    assert t.column("vec").shape == (10, 2)
+    assert t["text"].dtype == object
+
+
+def test_length_mismatch_raises():
+    with pytest.raises(ValueError):
+        Table({"a": [1, 2], "b": [1, 2, 3]})
+
+
+def test_select_drop_rename(t):
+    assert t.select("x", "label").column_names == ["x", "label"]
+    assert "text" not in t.drop("text")
+    assert "y" in t.rename({"x": "y"})
+
+
+def test_with_column_and_row(t):
+    t2 = t.with_column("y", t["x"] * 2)
+    assert t2["y"][3] == 6.0
+    r = t2.row(3)
+    assert r["text"] == "row3" and r["y"] == 6.0
+
+
+def test_filter_take_slice(t):
+    assert t.filter(t["label"] == 1).num_rows == 5
+    assert t.take([0, 9])["x"].tolist() == [0.0, 9.0]
+    assert t.slice(2, 5)["x"].tolist() == [2.0, 3.0, 4.0]
+
+
+def test_partitions_cover_all_rows(t):
+    parts = list(t.partitions())
+    assert len(parts) == 3
+    assert sum(p.num_rows for p in parts) == 10
+    got = np.concatenate([p["x"] for p in parts])
+    np.testing.assert_array_equal(got, t["x"])
+
+
+def test_map_partitions_identity_and_parallel(t):
+    out = t.map_partitions(lambda p, i: p.with_column("pid", np.full(p.num_rows, i)))
+    assert out.num_rows == 10
+    assert sorted(set(out["pid"].tolist())) == [0, 1, 2]
+    out2 = t.map_partitions(lambda p, i: p, parallel=True)
+    np.testing.assert_array_equal(out2["x"], t["x"])
+
+
+def test_random_split(t):
+    a, b = t.random_split([0.5, 0.5], seed=1)
+    assert a.num_rows + b.num_rows == 10
+    merged = sorted(a["x"].tolist() + b["x"].tolist())
+    assert merged == t["x"].tolist()
+
+
+def test_concat_preserves_object_cols(t):
+    c = concat_tables([t.slice(0, 4), t.slice(4, 10)])
+    assert c.num_rows == 10
+    assert c["text"][7] == "row7"
+    assert c["vec"].shape == (10, 2)
+
+
+def test_pandas_roundtrip(t):
+    df = t.to_pandas()
+    back = Table.from_pandas(df)
+    np.testing.assert_allclose(back["x"], t["x"])
+    assert back["text"][2] == "row2"
+
+
+def test_ragged_object_column():
+    t = Table({"r": [[1, 2], [1, 2, 3]]})
+    assert t["r"].dtype == object
+    assert list(t["r"][1]) == [1, 2, 3]
+
+
+def test_empty_partition_tolerated():
+    # Reference handles empty partitions explicitly (LightGBMBase.scala:353-361).
+    t = Table({"x": np.arange(2)}, npartitions=5)
+    assert t.npartitions == 2  # clamped to rows
+    t2 = Table({"x": np.arange(5)}, npartitions=3)
+    assert [p.num_rows for p in t2.partitions()] == [2, 1, 2]
