@@ -20,20 +20,36 @@ _logger = logging.getLogger("synapseml_tpu.fault")
 
 
 def run_with_timeout(fn: Callable[[], Any], timeout_s: float) -> Any:
-    """Run ``fn`` on a worker thread, raising TimeoutError after ``timeout_s``.
+    """Run ``fn`` on a daemon thread, raising TimeoutError after ``timeout_s``.
 
-    On timeout the worker thread is abandoned (daemonized pool, no join) — a hung ``fn``
-    must not block the caller past the deadline.
+    On timeout the worker thread is truly abandoned (daemon=True, never joined) — a hung
+    ``fn`` neither blocks the caller past the deadline nor prevents interpreter exit.
     """
-    ex = concurrent.futures.ThreadPoolExecutor(max_workers=1)
-    try:
-        return ex.submit(fn).result(timeout=timeout_s)
-    finally:
-        ex.shutdown(wait=False)
+    import threading
+
+    box: dict = {}
+    done = threading.Event()
+
+    def worker():
+        try:
+            box["value"] = fn()
+        except BaseException as e:  # noqa: BLE001 - re-raised in caller
+            box["error"] = e
+        finally:
+            done.set()
+
+    t = threading.Thread(target=worker, daemon=True)
+    t.start()
+    if not done.wait(timeout_s):
+        raise TimeoutError(f"timed out after {timeout_s}s")
+    if "error" in box:
+        raise box["error"]
+    return box["value"]
 
 
 def retry_with_timeout(fn: Callable[[], Any], times: int = 3, timeout_s: float = 60.0) -> Any:
     """Retry ``fn`` up to ``times`` attempts, each bounded by ``timeout_s``."""
+    times = max(1, times)  # always run at least once
     last: Optional[BaseException] = None
     for attempt in range(times):
         try:
@@ -54,6 +70,7 @@ def retry_with_backoff(
     sleep: Callable[[float], None] = time.sleep,
 ) -> Any:
     """Exponential-backoff retry (reference: LightGBM ``networkInit`` backoff loop)."""
+    retries = max(1, retries)  # always run at least once
     delay = initial_delay_s
     last: Optional[BaseException] = None
     for attempt in range(retries):

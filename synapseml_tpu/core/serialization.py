@@ -53,7 +53,8 @@ def _save_value(value, path: str) -> Dict[str, Any]:
     if isinstance(value, PipelineStage):
         save_stage(value, path + ".stage")
         return {"kind": "stage"}
-    if isinstance(value, np.ndarray):
+    if isinstance(value, np.ndarray) or hasattr(value, "__array__"):
+        value = np.asarray(value)  # covers jax.Array — device arrays persist as numpy
         np.save(path + ".npy", value, allow_pickle=value.dtype == object)
         return {"kind": "ndarray", "pickled": bool(value.dtype == object)}
     if isinstance(value, bytes):
@@ -67,8 +68,12 @@ def _save_value(value, path: str) -> Dict[str, Any]:
         return {"kind": "stages", "n": len(value), "tuple": isinstance(value, tuple)}
     if type(value).__name__ in STATE_REGISTRY and hasattr(value, "state_dict"):
         state = value.state_dict()
-        arrays = {k: np.asarray(v) for k, v in state.items() if isinstance(v, np.ndarray)}
-        scalars = {k: v for k, v in state.items() if not isinstance(v, np.ndarray)}
+
+        def _arrayish(v):
+            return isinstance(v, np.ndarray) or hasattr(v, "__array__")
+
+        arrays = {k: np.asarray(v) for k, v in state.items() if _arrayish(v)}
+        scalars = {k: v for k, v in state.items() if not _arrayish(v)}
         np.savez(path + ".state.npz", **arrays)
         with open(path + ".state.json", "w") as f:
             json.dump({"class": type(value).__name__, "scalars": scalars}, f, default=_jsonable)
@@ -120,28 +125,38 @@ def save_stage(stage: Params, path: str) -> None:
             raise ValueError(f"save path {path!r} exists and is not a directory")
         # Only clobber directories we wrote (marked by metadata.json) or empty ones —
         # a typo'd path must not silently destroy unrelated files.
-        if os.path.exists(os.path.join(path, "metadata.json")) or not os.listdir(path):
-            shutil.rmtree(path)
-        else:
+        if not (os.path.exists(os.path.join(path, "metadata.json")) or not os.listdir(path)):
             raise ValueError(
                 f"save path {path!r} exists and does not look like a saved stage; refusing to overwrite"
             )
-    os.makedirs(path, exist_ok=True)
-    complex_descs = {}
-    for name, value in stage.complex_param_values().items():
-        if value is None:
-            complex_descs[name] = {"kind": "none"}
-            continue
-        complex_descs[name] = _save_value(value, os.path.join(path, name))
-    meta = {
-        "class": type(stage).__name__,
-        "uid": stage.uid,
-        "buildVersion": BUILD_VERSION,
-        "params": stage.simple_param_values(),
-        "complexParams": complex_descs,
-    }
-    with open(os.path.join(path, "metadata.json"), "w") as f:
-        json.dump(meta, f, indent=2, sort_keys=True, default=_jsonable)
+    # Write to a sibling temp dir and swap in only on success, so a mid-save failure
+    # can't destroy a previously persisted model.
+    tmp = path.rstrip("/") + ".saving.tmp"
+    if os.path.exists(tmp):
+        shutil.rmtree(tmp)
+    os.makedirs(tmp)
+    try:
+        complex_descs = {}
+        for name, value in stage.complex_param_values().items():
+            if value is None:
+                complex_descs[name] = {"kind": "none"}
+                continue
+            complex_descs[name] = _save_value(value, os.path.join(tmp, name))
+        meta = {
+            "class": type(stage).__name__,
+            "uid": stage.uid,
+            "buildVersion": BUILD_VERSION,
+            "params": stage.simple_param_values(),
+            "complexParams": complex_descs,
+        }
+        with open(os.path.join(tmp, "metadata.json"), "w") as f:
+            json.dump(meta, f, indent=2, sort_keys=True, default=_jsonable)
+    except BaseException:
+        shutil.rmtree(tmp, ignore_errors=True)
+        raise
+    if os.path.exists(path):
+        shutil.rmtree(path)
+    os.replace(tmp, path)
 
 
 def load_stage(path: str):

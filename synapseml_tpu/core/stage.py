@@ -63,11 +63,18 @@ def stage_class(name: str) -> type:
 
 
 class PipelineStage(Params):
-    """Common base: params + uid + save/load."""
+    """Common base: params + uid + save/load.
+
+    Classes that are frameworks bases rather than loadable stages opt out of registry
+    registration by declaring ``_abstract_stage = True`` in their own body.
+    """
+
+    _abstract_stage = True
 
     def __init_subclass__(cls, **kwargs):
         super().__init_subclass__(**kwargs)
-        if not inspect.isabstract(cls) and not cls.__name__.startswith("_"):
+        is_abstract = cls.__dict__.get("_abstract_stage", False) or inspect.isabstract(cls)
+        if not is_abstract and not cls.__name__.startswith("_"):
             register_stage(cls)
 
     # save/load implemented in serialization.py to keep this module dependency-light.
@@ -94,6 +101,8 @@ class PipelineStage(Params):
 class Transformer(PipelineStage):
     """Maps a Table to a Table (reference: SparkML ``Transformer``)."""
 
+    _abstract_stage = True
+
     def transform(self, table: Table) -> Table:
         log_stage_call(self, "transform")
         return self._transform(table)
@@ -108,6 +117,8 @@ class Transformer(PipelineStage):
 class Estimator(PipelineStage):
     """Fits a Table, producing a :class:`Model` (reference: SparkML ``Estimator``)."""
 
+    _abstract_stage = True
+
     def fit(self, table: Table) -> "Model":
         log_stage_call(self, "fit")
         model = self._fit(table)
@@ -121,11 +132,14 @@ class Estimator(PipelineStage):
 class Model(Transformer):
     """A fitted transformer. ``parent`` points back at the estimator."""
 
+    _abstract_stage = True
     parent: Optional[Estimator] = None
 
 
 class UnaryTransformer(Transformer):
     """Convenience: input column -> output column transformers."""
+
+    _abstract_stage = True
 
     input_col = Param("input column name", str, default="input")
     output_col = Param("output column name", str, default="output")

@@ -297,6 +297,12 @@ def concat_tables(tables: Sequence[Table]) -> Table:
     if not tables:
         return Table({})
     names = tables[0].column_names
+    for i, t in enumerate(tables[1:], 1):
+        if set(t.column_names) != set(names):
+            raise ValueError(
+                f"concat_tables: table {i} columns {sorted(t.column_names)} != "
+                f"table 0 columns {sorted(names)}"
+            )
     cols = {}
     for n in names:
         parts = [t.column(n) for t in tables]

@@ -1,0 +1,25 @@
+"""Runtime: topology discovery, shared per-host state, distributed bring-up."""
+
+from .shared import SharedVariable, clear_shared_pool, shared_singleton
+from .topology import (
+    ClusterInfo,
+    best_mesh_shape,
+    cluster_info,
+    device_kind,
+    initialize_distributed,
+    is_tpu,
+    make_mesh,
+)
+
+__all__ = [
+    "SharedVariable",
+    "shared_singleton",
+    "clear_shared_pool",
+    "ClusterInfo",
+    "cluster_info",
+    "make_mesh",
+    "best_mesh_shape",
+    "initialize_distributed",
+    "device_kind",
+    "is_tpu",
+]

@@ -1,0 +1,74 @@
+"""Process-wide shared state — the ``SharedSingleton``/``SharedVariable`` equivalent.
+
+Reference: ``core/.../io/http/SharedVariable.scala:18-58`` — a JVM-wide pool keyed by
+UUID so every task running in one executor JVM shares a single object (used for LightGBM
+``SharedState``, serving servers, ``PartitionConsolidator``). Here the unit of sharing is
+the Python process (one process per TPU host); partition-parallel threads of one host get
+one shared instance, guarded by per-key locks.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, Generic, Optional, TypeVar
+
+__all__ = ["SharedVariable", "shared_singleton", "clear_shared_pool"]
+
+T = TypeVar("T")
+
+_pool: Dict[str, Any] = {}
+_pool_lock = threading.Lock()
+_key_locks: Dict[str, threading.Lock] = {}
+
+
+def _key_lock(key: str) -> threading.Lock:
+    with _pool_lock:
+        if key not in _key_locks:
+            _key_locks[key] = threading.Lock()
+        return _key_locks[key]
+
+
+def shared_singleton(key: str, factory: Callable[[], T]) -> T:
+    """Get-or-create the process-wide instance for ``key``.
+
+    The factory runs at most once per process per key, even under concurrent access
+    (double-checked under the per-key lock).
+    """
+    if key in _pool:
+        return _pool[key]
+    with _key_lock(key):
+        if key not in _pool:
+            value = factory()
+            with _pool_lock:
+                _pool[key] = value
+        return _pool[key]
+
+
+def clear_shared_pool(prefix: str = "") -> None:
+    with _pool_lock:
+        for k in [k for k in _pool if k.startswith(prefix)]:
+            del _pool[k]
+        for k in [k for k in _key_locks if k.startswith(prefix)]:
+            del _key_locks[k]
+
+
+class SharedVariable(Generic[T]):
+    """A handle whose value is shared per-process, lazily constructed.
+
+    >>> sv = SharedVariable(lambda: [])
+    >>> sv.get() is sv.get()
+    True
+    """
+
+    def __init__(self, factory: Callable[[], T], key: Optional[str] = None):
+        import uuid
+
+        self._factory = factory
+        self._key = key or f"sharedvar-{uuid.uuid4().hex}"
+
+    def get(self) -> T:
+        return shared_singleton(self._key, self._factory)
+
+    @property
+    def key(self) -> str:
+        return self._key

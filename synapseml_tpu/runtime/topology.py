@@ -1,0 +1,156 @@
+"""Device / host topology discovery — the ``ClusterUtil`` equivalent.
+
+The reference discovers Spark executors and tasks-per-executor to size its native
+process groups (``core/.../core/utils/ClusterUtil.scala:20-176``: ``getNumTasksPerExec``,
+``getExecutors``, ``getDriverHost``). On TPU the analogous facts come from the JAX
+runtime and pod-slice metadata: local/global device counts, process (host) index/count,
+and the ICI mesh shape. This module centralizes them and builds ``jax.sharding.Mesh``
+objects that the distributed trainers (GBDT histogram ``psum``, linear ``pmean``) and
+serving layer consume.
+
+Multi-host bring-up (the reference's driver-socket rendezvous,
+``LightGBMBase.scala:399-437``) maps to ``jax.distributed.initialize`` — coordinator
+address instead of driver ServerSocket, with the same retry-with-backoff semantics.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import math
+import os
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+__all__ = [
+    "ClusterInfo",
+    "cluster_info",
+    "make_mesh",
+    "best_mesh_shape",
+    "initialize_distributed",
+    "device_kind",
+    "is_tpu",
+]
+
+_logger = logging.getLogger("synapseml_tpu.topology")
+
+
+@dataclasses.dataclass(frozen=True)
+class ClusterInfo:
+    """Snapshot of the accelerator topology (ClusterUtil.getExecutors analogue)."""
+
+    num_devices: int
+    local_num_devices: int
+    num_hosts: int
+    host_index: int
+    platform: str
+    device_kinds: Tuple[str, ...]
+
+    @property
+    def devices_per_host(self) -> int:
+        return self.local_num_devices
+
+
+def cluster_info() -> ClusterInfo:
+    import jax
+
+    devs = jax.devices()
+    return ClusterInfo(
+        num_devices=jax.device_count(),
+        local_num_devices=jax.local_device_count(),
+        num_hosts=jax.process_count(),
+        host_index=jax.process_index(),
+        platform=devs[0].platform if devs else "cpu",
+        device_kinds=tuple(sorted({d.device_kind for d in devs})),
+    )
+
+
+def device_kind() -> str:
+    import jax
+
+    return jax.devices()[0].device_kind
+
+
+def is_tpu() -> bool:
+    import jax
+
+    return jax.devices()[0].platform == "tpu"
+
+
+def best_mesh_shape(n_devices: int, n_axes: int) -> Tuple[int, ...]:
+    """Factor ``n_devices`` into ``n_axes`` axes, largest-first.
+
+    Used when the caller asks for e.g. a ('data','model') mesh without specifying the
+    split; mirrors how the reference derives numTasksPerExec from cores/taskCpus
+    (``ClusterUtil.scala:20-105``) — sensible defaults, overridable.
+    """
+    shape = [1] * n_axes
+    rem = n_devices
+    for i in range(n_axes - 1):
+        # Peel off the largest power-of-two-ish factor for leading axes.
+        f = 1
+        for cand in range(int(math.isqrt(rem)), 0, -1):
+            if rem % cand == 0:
+                f = max(f, rem // cand if i == 0 else cand)
+                break
+        shape[i] = f
+        rem //= f
+    shape[-1] = rem
+    return tuple(shape)
+
+
+def make_mesh(
+    axis_names: Sequence[str] = ("data",),
+    shape: Optional[Sequence[int]] = None,
+    devices: Optional[Sequence] = None,
+):
+    """Build a ``jax.sharding.Mesh`` over available devices.
+
+    ``shape=None`` puts all devices on the first axis (pure data parallelism — the only
+    parallelism the reference's trainers use, SURVEY.md §2.1) and 1 on the rest.
+    """
+    import jax
+    from jax.sharding import Mesh
+
+    devs = list(devices) if devices is not None else list(jax.devices())
+    axis_names = tuple(axis_names)
+    if shape is None:
+        shape = (len(devs),) + (1,) * (len(axis_names) - 1)
+    shape = tuple(int(s) for s in shape)
+    total = int(np.prod(shape))
+    if total > len(devs):
+        raise ValueError(f"mesh shape {shape} needs {total} devices, have {len(devs)}")
+    arr = np.array(devs[:total]).reshape(shape)
+    return Mesh(arr, axis_names)
+
+
+def initialize_distributed(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+    retries: int = 5,
+) -> None:
+    """Multi-host rendezvous: ``jax.distributed.initialize`` with backoff retry.
+
+    Replaces the reference's driver-socket rendezvous + exponential-backoff native
+    network init (``TrainUtils.scala:237-296``). No-ops when single-host and no
+    coordinator is configured.
+    """
+    import jax
+
+    from ..core.fault import retry_with_backoff
+
+    if coordinator_address is None and "JAX_COORDINATOR_ADDRESS" not in os.environ:
+        if num_processes in (None, 1):
+            _logger.debug("single-host: skipping jax.distributed.initialize")
+            return
+
+    def _init():
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+
+    retry_with_backoff(_init, retries=retries, initial_delay_s=1.0, max_delay_s=30.0)

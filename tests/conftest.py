@@ -8,11 +8,18 @@ Spark (reference: ``core/src/test/.../SparkSessionFactory.scala`` — SURVEY.md 
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force CPU even if the ambient env points JAX at real accelerators (e.g. the axon
+# TPU tunnel, whose sitecustomize hook registers the backend at interpreter start and
+# overrides JAX_PLATFORMS) — unit tests always run on the virtual 8-device CPU mesh.
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
 os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
 
 import numpy as np
 import pytest

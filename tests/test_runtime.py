@@ -1,0 +1,85 @@
+import threading
+
+import numpy as np
+import pytest
+
+from synapseml_tpu.runtime import (
+    SharedVariable,
+    best_mesh_shape,
+    clear_shared_pool,
+    cluster_info,
+    make_mesh,
+    shared_singleton,
+)
+
+
+def test_cluster_info_virtual_devices():
+    info = cluster_info()
+    assert info.num_devices == 8  # conftest forces 8 CPU devices
+    assert info.num_hosts == 1
+    assert info.platform == "cpu"
+
+
+def test_make_mesh_default_1d():
+    mesh = make_mesh(("data",))
+    assert mesh.shape == {"data": 8}
+
+
+def test_make_mesh_2d():
+    mesh = make_mesh(("data", "model"), shape=(4, 2))
+    assert mesh.shape == {"data": 4, "model": 2}
+
+
+def test_make_mesh_too_big_raises():
+    with pytest.raises(ValueError, match="devices"):
+        make_mesh(("data",), shape=(1000,))
+
+
+def test_best_mesh_shape():
+    assert np.prod(best_mesh_shape(8, 2)) == 8
+    assert np.prod(best_mesh_shape(12, 3)) == 12
+    assert best_mesh_shape(8, 1) == (8,)
+
+
+def test_shared_singleton_runs_factory_once():
+    clear_shared_pool("t1-")
+    calls = []
+
+    def factory():
+        calls.append(1)
+        return object()
+
+    objs = []
+    threads = [
+        threading.Thread(target=lambda: objs.append(shared_singleton("t1-key", factory)))
+        for _ in range(8)
+    ]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    assert len(calls) == 1
+    assert all(o is objs[0] for o in objs)
+
+
+def test_shared_variable():
+    sv = SharedVariable(lambda: [])
+    assert sv.get() is sv.get()
+
+
+def test_psum_over_mesh():
+    """Histogram-allreduce pattern the GBDT engine uses: psum over the data axis."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from jax.experimental.shard_map import shard_map
+
+    mesh = make_mesh(("data",))
+    x = jnp.arange(8.0)
+
+    def local_hist(xs):
+        return jax.lax.psum(jnp.sum(xs, keepdims=True), "data")
+
+    f = shard_map(local_hist, mesh=mesh, in_specs=P("data"), out_specs=P("data"))
+    out = f(x)
+    np.testing.assert_allclose(np.asarray(out), np.full(8, 28.0))

@@ -1,0 +1,162 @@
+import numpy as np
+import pytest
+
+from synapseml_tpu.core import Table
+from synapseml_tpu.stages import (
+    ClassBalancer,
+    DropColumns,
+    DynamicMiniBatchTransformer,
+    EnsembleByKey,
+    Explode,
+    FixedMiniBatchTransformer,
+    FlattenBatch,
+    Lambda,
+    MultiColumnAdapter,
+    PartitionConsolidator,
+    RenameColumn,
+    Repartition,
+    SelectColumns,
+    StratifiedRepartition,
+    SummarizeData,
+    TextPreprocessor,
+    Timer,
+    UDFTransformer,
+    UnicodeNormalize,
+)
+
+
+@pytest.fixture
+def t():
+    return Table(
+        {
+            "a": np.arange(8, dtype=np.float64),
+            "b": np.arange(8, dtype=np.float64) * 10,
+            "label": np.array([0, 0, 0, 0, 0, 0, 1, 1]),
+            "text": [f"The Cat {i}" for i in range(8)],
+        },
+        npartitions=2,
+    )
+
+
+def test_column_ops(t):
+    assert "a" not in DropColumns(cols=["a"]).transform(t)
+    assert SelectColumns(cols=["a", "b"]).transform(t).column_names == ["a", "b"]
+    assert "z" in RenameColumn(input_col="a", output_col="z").transform(t)
+    assert Repartition(n=4).transform(t).npartitions == 4
+    assert PartitionConsolidator().transform(t).npartitions == 1
+
+
+def test_lambda_and_udf(t):
+    out = Lambda(transform_func=lambda x: x.with_column("c", x["a"] + 1)).transform(t)
+    np.testing.assert_allclose(out["c"], t["a"] + 1)
+    out = UDFTransformer(input_col="a", output_col="sq", udf=lambda v: v * v).transform(t)
+    assert out["sq"][3] == 9.0
+    out = UDFTransformer(
+        input_cols=["a", "b"], output_col="s", udf=lambda x, y: x + y, vectorized=True
+    ).transform(t)
+    np.testing.assert_allclose(out["s"], t["a"] + t["b"])
+
+
+def test_explode():
+    t = Table({"k": [1, 2], "seq": [[10, 20], [30]]})
+    out = Explode(input_col="seq").transform(t)
+    assert out["seq"].tolist() == [10, 20, 30]
+    assert out["k"].tolist() == [1, 1, 2]
+
+
+def test_minibatch_roundtrip(t):
+    batched = FixedMiniBatchTransformer(batch_size=3).transform(t)
+    # partitions of 4 rows each -> batches of 3+1 per partition
+    assert batched.num_rows == 4
+    assert len(batched["a"][0]) == 3
+    flat = FlattenBatch().transform(batched)
+    np.testing.assert_allclose(np.sort(flat["a"]), np.sort(t["a"]))
+    assert flat["text"].tolist()[:2] == ["The Cat 0", "The Cat 1"]
+
+
+def test_dynamic_minibatch(t):
+    batched = DynamicMiniBatchTransformer().transform(t)
+    assert batched.num_rows == 2  # one batch per partition
+    flat = FlattenBatch().transform(batched)
+    assert flat.num_rows == 8
+
+
+def test_flatten_mismatch_raises():
+    bad = Table({"x": [np.array([1, 2])], "y": [np.array([1, 2, 3])]})
+    with pytest.raises(ValueError, match="FlattenBatch"):
+        FlattenBatch().transform(bad)
+
+
+def test_stratified_repartition_each_partition_sees_each_label(t):
+    out = StratifiedRepartition(label_col="label", mode="equal", seed=1).transform(t)
+    for p in out.partitions():
+        assert set(np.unique(p["label"])) == {0, 1}
+
+
+def test_stratified_original_keeps_rows(t):
+    out = StratifiedRepartition(label_col="label", mode="original", seed=1).transform(t)
+    assert out.num_rows == t.num_rows
+
+
+def test_ensemble_by_key():
+    t = Table({"k": [0, 0, 1, 1], "score": [1.0, 3.0, 10.0, 20.0]})
+    out = EnsembleByKey(keys=["k"], cols=["score"]).transform(t)
+    assert out.num_rows == 2
+    np.testing.assert_allclose(sorted(out["mean(score)"]), [2.0, 15.0])
+    out2 = EnsembleByKey(keys=["k"], cols=["score"], collapse_group=False).transform(t)
+    assert out2.num_rows == 4
+    np.testing.assert_allclose(out2["mean(score)"], [2.0, 2.0, 15.0, 15.0])
+
+
+def test_ensemble_by_key_vector():
+    t = Table({"k": [0, 0], "v": np.array([[1.0, 2.0], [3.0, 4.0]])})
+    out = EnsembleByKey(keys=["k"], cols=["v"]).transform(t)
+    np.testing.assert_allclose(out["mean(v)"][0], [2.0, 3.0])
+
+
+def test_class_balancer(t):
+    model = ClassBalancer(input_col="label").fit(t)
+    out = model.transform(t)
+    w = out["weight"]
+    assert w[0] == 1.0  # majority class
+    assert w[7] == 3.0  # 6/2
+
+
+def test_summarize_data(t):
+    s = SummarizeData().transform(t)
+    feats = s["Feature"].tolist()
+    assert "a" in feats and "text" not in feats
+    i = feats.index("a")
+    assert s["Mean"][i] == pytest.approx(3.5)
+    assert s["Count"][i] == 8
+    assert s["P50"][i] == pytest.approx(3.5)
+
+
+def test_text_preprocessor():
+    t = Table({"text": ["The quick brown Fox"]})
+    out = TextPreprocessor(map={"quick": "slow", "fox": "dog"}, output_col="o").transform(t)
+    assert out["o"][0] == "the slow brown dog"
+
+
+def test_unicode_normalize():
+    t = Table({"text": ["Café"]})
+    out = UnicodeNormalize(form="NFKD", lower=True, output_col="o").transform(t)
+    assert out["o"][0].startswith("caf")
+
+
+def test_multi_column_adapter(t):
+    from synapseml_tpu.stages import UDFTransformer as U
+
+    base = U(udf=lambda v: v + 1, vectorized=True)
+    m = MultiColumnAdapter(base_stage=base, input_cols=["a", "b"], output_cols=["a2", "b2"]).fit(t)
+    out = m.transform(t)
+    np.testing.assert_allclose(out["a2"], t["a"] + 1)
+    np.testing.assert_allclose(out["b2"], t["b"] + 1)
+
+
+def test_timer(t):
+    inner = UDFTransformer(input_col="a", output_col="o", udf=lambda v: v, vectorized=True)
+    m = Timer(stage=inner).fit(t)
+    out = m.transform(t)
+    assert "o" in out
+    assert m._last_elapsed_s >= 0
