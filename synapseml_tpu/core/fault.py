@@ -8,9 +8,9 @@ and ``StreamUtilities.using/usingMany`` (``core/.../core/env/StreamUtilities.sca
 
 from __future__ import annotations
 
-import concurrent.futures
 import contextlib
 import logging
+import threading
 import time
 from typing import Any, Callable, Iterable, Optional, Sequence, Tuple, Type
 
@@ -25,8 +25,6 @@ def run_with_timeout(fn: Callable[[], Any], timeout_s: float) -> Any:
     On timeout the worker thread is truly abandoned (daemon=True, never joined) — a hung
     ``fn`` neither blocks the caller past the deadline nor prevents interpreter exit.
     """
-    import threading
-
     box: dict = {}
     done = threading.Event()
 

@@ -78,6 +78,10 @@ def _save_value(value, path: str) -> Dict[str, Any]:
         with open(path + ".state.json", "w") as f:
             json.dump({"class": type(value).__name__, "scalars": scalars}, f, default=_jsonable)
         return {"kind": "state"}
+    if callable(value):
+        # Closures (Lambda/UDFTransformer funcs) are not persistable; record the slot so
+        # load yields None and the stage can warn (reference Lambda has the same caveat).
+        return {"kind": "callable_dropped"}
     # Last resort: JSON-serializable python structures (lists/dicts of simple values).
     try:
         with open(path + ".json", "w") as f:
@@ -110,6 +114,14 @@ def _load_value(desc: Dict[str, Any], path: str):
         cls = STATE_REGISTRY[head["class"]]
         arrays = dict(np.load(path + ".state.npz", allow_pickle=False))
         return cls.from_state_dict({**head["scalars"], **arrays})
+    if kind == "callable_dropped":
+        import logging
+
+        logging.getLogger("synapseml_tpu").warning(
+            "loaded stage had a callable param at %s; callables don't persist — reset to None",
+            path,
+        )
+        return None
     if kind == "json":
         with open(path + ".json") as f:
             return json.load(f)

@@ -1,0 +1,14 @@
+"""Deep-learning stages: ONNX-backed featurization + model repository
+(reference: ``deep-learning`` module)."""
+
+from .downloader import LocalRepository, ModelDownloader, ModelSchema, Repository, ZooRepository
+from .featurizer import ImageFeaturizer
+
+__all__ = [
+    "ImageFeaturizer",
+    "ModelDownloader",
+    "ModelSchema",
+    "Repository",
+    "LocalRepository",
+    "ZooRepository",
+]

@@ -1,0 +1,92 @@
+"""``ImageFeaturizer`` — headless-CNN image featurization.
+
+Rebuild of ``deep-learning/.../cntk/ImageFeaturizer.scala:40-197``: resize/normalize an
+image column, run a vision model, and emit either the penultimate features
+(``cut_output_layers=1``, the reference's "headless" mode) or the logits
+(``cut_output_layers=0``). The reference chains ResizeImageTransformer → UnrollImage →
+CNTKModel; here the backbone is an ONNX graph executed by the XLA importer, and zoo
+models expose the feature layer as a named output so no graph surgery is needed.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from ..core import ComplexParam, Param, Table, Transformer
+from ..core.params import ParamValidators
+from ..image.stages import ResizeImageTransformer, _to_batch
+from ..onnx.model import ONNXModel
+
+__all__ = ["ImageFeaturizer"]
+
+_IMAGENET_MEAN = [0.485, 0.456, 0.406]
+_IMAGENET_STD = [0.229, 0.224, 0.225]
+
+
+class ImageFeaturizer(Transformer):
+    input_col = Param("image column", str, default="image")
+    output_col = Param("output features column", str, default="features")
+    model_name = Param("zoo model name (e.g. ResNet50); ignored if model_bytes set",
+                       str, default="ResNet50")
+    model_bytes = ComplexParam("explicit ONNX model bytes", bytes, default=None)
+    model_dir = Param("local cache dir for downloaded models", str, default="/tmp/synapseml_tpu_models")
+    cut_output_layers = Param("1 = penultimate features (headless), 0 = logits", int,
+                              default=1, validator=ParamValidators.in_range(0, 1))
+    image_height = Param("input height", int, default=224)
+    image_width = Param("input width", int, default=224)
+    channel_order = Param("channel order of incoming images", str, default="bgr",
+                          validator=ParamValidators.in_list(["bgr", "rgb"]))
+    scale = Param("pixel pre-scale (1/255 for uint8 input)", float, default=1.0 / 255.0)
+    mean = Param("per-channel normalization mean (rgb order)", list, default=_IMAGENET_MEAN)
+    std = Param("per-channel normalization std (rgb order)", list, default=_IMAGENET_STD)
+    batch_size = Param("inference bucket size", int, default=32, validator=ParamValidators.gt(0))
+    dtype_policy = Param("float32 | bfloat16", str, default="float32",
+                         validator=ParamValidators.in_list(["float32", "bfloat16"]))
+
+    def __init__(self, uid=None, **kw):
+        super().__init__(uid=uid, **kw)
+        self._onnx: Optional[ONNXModel] = None
+
+    def _post_load(self):
+        self._onnx = None
+
+    def _resolve_model(self):
+        if self._onnx is not None:
+            return self._onnx
+        if self.model_bytes is not None:
+            data = self.model_bytes
+            input_name, feat, logits = "data", "features", "logits"
+        else:
+            from .downloader import ModelDownloader
+
+            dl = ModelDownloader(self.model_dir)
+            schema = dl.download_by_name(self.model_name)
+            data = dl.local.read_bytes(schema)
+            input_name, feat, logits = schema.input_name, schema.feature_output, schema.logits_output
+        fetch = feat if self.cut_output_layers >= 1 else logits
+        self._onnx = ONNXModel(
+            feed_dict={input_name: "__img_nchw"},
+            fetch_dict={self.output_col: fetch},
+            batch_size=self.batch_size,
+            dtype_policy=self.dtype_policy,
+        ).set_model(data)
+        return self._onnx
+
+    def _transform(self, table: Table) -> Table:
+        self._validate_input(table, self.input_col)
+        resized = ResizeImageTransformer(
+            input_col=self.input_col, output_col="__img_r",
+            height=self.image_height, width=self.image_width,
+        ).transform(table)
+        batch = _to_batch(resized["__img_r"]).astype(np.float32)
+        if self.channel_order == "bgr":  # zoo models expect RGB
+            batch = batch[..., ::-1]
+        x = batch * self.scale
+        x = (x - np.asarray(self.mean, np.float32)) / np.asarray(self.std, np.float32)
+        nchw = np.transpose(x, (0, 3, 1, 2))
+        onnx = self._resolve_model()
+        with_feed = resized.drop("__img_r").with_column("__img_nchw", nchw)
+        out = onnx.transform(with_feed)
+        return out.drop("__img_nchw")

@@ -1,0 +1,6 @@
+"""Image ops and stages (reference: ``opencv`` module + ``core/.../image/``)."""
+
+from . import ops
+from .stages import ImageSetAugmenter, ImageTransformer, ResizeImageTransformer, UnrollImage
+
+__all__ = ["ops", "ImageTransformer", "ResizeImageTransformer", "UnrollImage", "ImageSetAugmenter"]

@@ -1,0 +1,186 @@
+"""Image pipeline stages.
+
+Rebuilds of ``opencv/.../ImageTransformer.scala`` (stage-list driven image pipeline),
+``ImageSetAugmenter.scala``, and core's opencv-free ``ResizeImageTransformer`` /
+``UnrollImage`` (``core/.../image/``). Image columns are either object columns of HWC
+uint8/float arrays (ragged sizes) or uniform ``(N,H,W,C)`` tensor columns; stages
+normalize to tensor columns as soon as sizes become uniform so downstream ops run
+batched on the accelerator.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ..core import Param, Table, Transformer, concat_tables
+from ..core.params import ParamValidators
+from . import ops as iops
+
+__all__ = ["ImageTransformer", "ResizeImageTransformer", "UnrollImage", "ImageSetAugmenter"]
+
+
+def _to_batch(col) -> Optional[np.ndarray]:
+    """Object column of uniform HWC arrays -> (N,H,W,C); None if ragged."""
+    if isinstance(col, np.ndarray) and col.dtype != object:
+        return col if col.ndim == 4 else None
+    shapes = {np.asarray(v).shape for v in col}
+    if len(shapes) == 1:
+        return np.stack([np.asarray(v) for v in col])
+    return None
+
+
+def _from_batch(batch: np.ndarray):
+    return np.asarray(batch)
+
+
+class ImageTransformer(Transformer):
+    """Sequential image-op pipeline encoded as a list of ``{"action": ..., params}``
+    dicts — same contract as the reference's stage list (``ImageTransformerStage.apply``,
+    ``ImageTransformer.scala:34-48``). Supported actions: ``resize``, ``crop``,
+    ``centercrop``, ``colorformat``, ``blur``, ``gaussiankernel``, ``threshold``,
+    ``flip``, ``normalize``."""
+
+    input_col = Param("input image column", str, default="image")
+    output_col = Param("output image column", str, default="image")
+    stages = Param("list of image op dicts with 'action' key", list, default=[])
+
+    # -- single-stage helpers, batched ------------------------------------------
+
+    def _apply_stage(self, batch: np.ndarray, stage: Dict[str, Any]) -> np.ndarray:
+        action = stage["action"].lower()
+        if action == "resize":
+            if "size" in stage:  # aspect-preserving shorter-side resize is per-image
+                raise ValueError("resize with 'size' must be applied pre-batch (ragged)")
+            return np.asarray(iops.resize(batch, int(stage["height"]), int(stage["width"])))
+        if action == "crop":
+            return np.asarray(iops.crop(batch, int(stage["x"]), int(stage["y"]),
+                                        int(stage["width"]), int(stage["height"])))
+        if action == "centercrop":
+            return np.asarray(iops.center_crop(batch, int(stage["width"]), int(stage["height"])))
+        if action == "colorformat":
+            return np.asarray(iops.color_convert(batch, stage["format"]))
+        if action == "blur":
+            return np.asarray(iops.box_blur(batch, int(stage["height"]), int(stage["width"])))
+        if action == "gaussiankernel":
+            return np.asarray(iops.gaussian_blur(batch, int(stage["aperturesize"]),
+                                                 float(stage.get("sigma", -1.0))))
+        if action == "threshold":
+            return np.asarray(iops.threshold(batch, float(stage["threshold"]),
+                                             float(stage["maxval"]),
+                                             stage.get("thresholdtype", "binary")))
+        if action == "flip":
+            return np.asarray(iops.flip(batch, int(stage.get("flipcode", 1))))
+        if action == "normalize":
+            return np.asarray(iops.normalize(batch, stage["mean"], stage["std"],
+                                             float(stage.get("scale", 1.0))))
+        raise ValueError(f"unknown image action {action!r}")
+
+    def _transform(self, table: Table) -> Table:
+        self._validate_input(table, self.input_col)
+        col = table[self.input_col]
+        batch = _to_batch(col)
+        stages = list(self.stages)
+        if batch is None:
+            # Ragged: resolve per-image until a uniform-size op (resize) appears.
+            imgs = [np.asarray(v) for v in col]
+            while stages:
+                st = dict(stages[0])
+                action = st["action"].lower()
+                if action == "resize" and "size" in st:
+                    imgs = [iops.resize_shorter(im, int(st["size"])) for im in imgs]
+                    stages.pop(0)
+                    continue
+                if action == "resize":
+                    h, w = int(st["height"]), int(st["width"])
+                    imgs = [
+                        np.asarray(iops.resize(im[None], h, w))[0] for im in imgs
+                    ]
+                    stages.pop(0)
+                    batch = np.stack(imgs)
+                    break
+                # apply per-image with batch dim 1
+                imgs = [self._apply_stage(im[None], st)[0] for im in imgs]
+                stages.pop(0)
+            if batch is None:
+                try:
+                    batch = np.stack(imgs)
+                except ValueError:
+                    out = np.empty(len(imgs), dtype=object)
+                    for i, im in enumerate(imgs):
+                        out[i] = im
+                    return table.with_column(self.output_col, out, meta={"type": "image"})
+        for st in stages:
+            batch = self._apply_stage(batch, st)
+        return table.with_column(self.output_col, _from_batch(batch), meta={"type": "image"})
+
+
+class ResizeImageTransformer(Transformer):
+    """Opencv-free resize (reference ``core/.../image/ResizeImageTransformer.scala``)."""
+
+    input_col = Param("input image column", str, default="image")
+    output_col = Param("output image column", str, default="image")
+    height = Param("target height", int, default=224, validator=ParamValidators.gt(0))
+    width = Param("target width", int, default=224, validator=ParamValidators.gt(0))
+
+    def _transform(self, table: Table) -> Table:
+        self._validate_input(table, self.input_col)
+        col = table[self.input_col]
+        batch = _to_batch(col)
+        if batch is not None:
+            out = np.asarray(iops.resize(batch, self.height, self.width))
+        else:
+            out = np.stack(
+                [np.asarray(iops.resize(np.asarray(v)[None], self.height, self.width))[0]
+                 for v in col]
+            )
+        return table.with_column(self.output_col, out, meta={"type": "image"})
+
+
+class UnrollImage(Transformer):
+    """Flatten image column into a feature vector column
+    (reference ``core/.../image/UnrollImage.scala``; CNTK convention unrolls per
+    channel-plane, i.e. CHW order)."""
+
+    input_col = Param("input image column", str, default="image")
+    output_col = Param("output vector column", str, default="features")
+
+    def _transform(self, table: Table) -> Table:
+        self._validate_input(table, self.input_col)
+        col = table[self.input_col]
+        batch = _to_batch(col)
+        if batch is None:
+            raise ValueError(
+                f"UnrollImage({self.uid}): images must be uniform size (resize first)"
+            )
+        n = batch.shape[0]
+        chw = np.transpose(batch, (0, 3, 1, 2))
+        return table.with_column(self.output_col, chw.reshape(n, -1).astype(np.float32))
+
+
+class ImageSetAugmenter(Transformer):
+    """Dataset augmentation by mirroring (reference ``ImageSetAugmenter.scala``):
+    emits original rows plus flipped copies, multiplying the row count."""
+
+    input_col = Param("image column", str, default="image")
+    output_col = Param("output image column", str, default="image")
+    flip_left_right = Param("add horizontal mirrors", bool, default=True)
+    flip_up_down = Param("add vertical mirrors", bool, default=False)
+
+    def _transform(self, table: Table) -> Table:
+        self._validate_input(table, self.input_col)
+        col = table[self.input_col]
+        batch = _to_batch(col)
+        if batch is None:
+            raise ValueError(f"ImageSetAugmenter({self.uid}): resize images first")
+        tables = [table.with_column(self.output_col, batch, meta={"type": "image"})]
+        if self.flip_left_right:
+            tables.append(table.with_column(self.output_col, np.asarray(iops.flip(batch, 1)),
+                                            meta={"type": "image"}))
+        if self.flip_up_down:
+            tables.append(table.with_column(self.output_col, np.asarray(iops.flip(batch, 0)),
+                                            meta={"type": "image"}))
+        if self.output_col != self.input_col:
+            tables = [t.drop(self.input_col) if self.input_col in t else t for t in tables]
+        return concat_tables(tables)

@@ -1,0 +1,5 @@
+"""Flagship model zoo (builder-backed ONNX graphs + native flax models)."""
+
+from .zoo import MODEL_BUILDERS, bert_encoder, build_model_bytes, resnet, vit
+
+__all__ = ["MODEL_BUILDERS", "build_model_bytes", "resnet", "bert_encoder", "vit"]

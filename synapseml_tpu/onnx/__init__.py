@@ -1,0 +1,23 @@
+"""ONNX engine: wire codec, builder, JAX importer/executor, ONNXModel transformer."""
+
+from .builder import constant_node, make_graph, make_model, node, save_model, value_info
+from .importer import OnnxFunction, load_model
+from .model import ONNXModel
+from .wire import DataType, ModelProto, parse_model, serialize_model, tensor_to_numpy
+
+__all__ = [
+    "OnnxFunction",
+    "load_model",
+    "ONNXModel",
+    "DataType",
+    "ModelProto",
+    "parse_model",
+    "serialize_model",
+    "tensor_to_numpy",
+    "node",
+    "make_graph",
+    "make_model",
+    "value_info",
+    "constant_node",
+    "save_model",
+]

@@ -1,0 +1,180 @@
+"""ONNX graph → XLA executable.
+
+Replaces the reference's ONNX Runtime JNI session
+(``deep-learning/.../onnx/ONNXModel.scala:173-193`` ``initializeOrt`` /
+``applyModel:305-355``) with a direct lowering: the graph is *interpreted once under
+``jax.jit`` tracing*, emitting one fused XLA program per input-shape signature. There is
+no per-op dispatch at run time and no JVM↔native tensor copies — feeds go device-side
+once, the whole graph runs as a single compiled computation.
+
+Static-shape discipline (TPU requirement): ``Shape``/shape arithmetic is constant-folded
+during tracing (any node whose inputs are all graph-constants is evaluated eagerly and
+pinned as numpy), so BERT-style dynamic-reshape chains compile to static programs. Each
+distinct input shape triggers one retrace — callers batch with fixed bucket sizes
+(``ONNXModel`` pads minibatches for exactly this reason; the reference instead pins
+shape(0)=batch at ``ONNXModel.scala:357-362``).
+
+``dtype_policy='bfloat16'`` runs floating-point compute in bf16 (inputs/weights cast,
+matmul/conv accumulate in f32 via ``preferred_element_type``, outputs returned f32) —
+the MXU-native mode.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .ops import OPS
+from .wire import GraphProto, ModelProto, ValueInfo, parse_model, tensor_to_numpy
+
+__all__ = ["OnnxFunction", "load_model"]
+
+_logger = logging.getLogger("synapseml_tpu.onnx")
+
+
+def _is_const(v) -> bool:
+    return isinstance(v, np.ndarray) or np.isscalar(v)
+
+
+class OnnxFunction:
+    """Callable wrapper: ``fn(feeds: dict[str, array]) -> dict[str, array]``.
+
+    jit-compiled per input-shape signature; signatures are cached by jax.jit itself.
+    """
+
+    def __init__(self, model: "ModelProto | bytes", dtype_policy: str = "float32"):
+        import jax
+
+        if isinstance(model, (bytes, bytearray, memoryview)):
+            model = parse_model(bytes(model))
+        self.model = model
+        self.graph = model.graph
+        self.opset = model.opset_version
+        if dtype_policy not in ("float32", "bfloat16"):
+            raise ValueError(f"unknown dtype_policy {dtype_policy!r}")
+        self.dtype_policy = dtype_policy
+        self.constants: Dict[str, np.ndarray] = {
+            t.name: tensor_to_numpy(t) for t in self.graph.initializer
+        }
+        init_names = set(self.constants)
+        # Graph inputs that are not initializers are the real feeds.
+        self.input_infos: List[ValueInfo] = [
+            vi for vi in self.graph.input if vi.name not in init_names
+        ]
+        self.input_names: List[str] = [vi.name for vi in self.input_infos]
+        self.output_names: List[str] = [vi.name for vi in self.graph.output]
+        self._validate_ops(self.graph)
+        self._jit = jax.jit(self._run_positional)
+
+    # -- public ------------------------------------------------------------------
+
+    def __call__(self, feeds: Dict[str, Any]) -> Dict[str, Any]:
+        missing = [n for n in self.input_names if n not in feeds]
+        if missing:
+            raise ValueError(f"missing feeds {missing}; expected {self.input_names}")
+        args = [np.asarray(feeds[n]) for n in self.input_names]
+        outs = self._jit(*args)
+        return dict(zip(self.output_names, outs))
+
+    def input_shapes(self) -> Dict[str, Optional[List[Any]]]:
+        return {vi.name: vi.shape for vi in self.input_infos}
+
+    # -- execution ---------------------------------------------------------------
+
+    def _validate_ops(self, graph: GraphProto) -> None:
+        missing = sorted({n.op_type for n in graph.node if n.op_type not in OPS})
+        if missing:
+            raise NotImplementedError(
+                f"ONNX ops not supported by the importer: {missing}. "
+                f"Supported: {len(OPS)} ops; extend synapseml_tpu/onnx/ops.py."
+            )
+
+    def _cast_policy_in(self, x):
+        import jax.numpy as jnp
+
+        dtype = getattr(x, "dtype", None)
+        if self.dtype_policy == "bfloat16" and dtype is not None and jnp.issubdtype(dtype, jnp.floating):
+            return jnp.asarray(x, dtype=jnp.bfloat16)
+        return x
+
+    def _run_positional(self, *arrays):
+        import jax.numpy as jnp
+
+        env: Dict[str, Any] = {"": None}
+        for name, const in self.constants.items():
+            env[name] = (
+                const.astype(np.dtype("bfloat16"))
+                if self.dtype_policy == "bfloat16" and np.issubdtype(const.dtype, np.floating)
+                else const
+            )
+        for name, arr in zip(self.input_names, arrays):
+            env[name] = self._cast_policy_in(arr)
+        self._run_graph(self.graph, env)
+        outs = []
+        for name in self.output_names:
+            v = env[name]
+            if self.dtype_policy == "bfloat16" and hasattr(v, "dtype") and v.dtype == jnp.bfloat16:
+                v = v.astype(jnp.float32)
+            outs.append(jnp.asarray(v))
+        return tuple(outs)
+
+    def _run_graph(self, graph: GraphProto, env: Dict[str, Any]) -> None:
+        import jax.numpy as jnp
+
+        accum = jnp.float32 if self.dtype_policy == "bfloat16" else None
+
+        def subgraph_runner(sub: GraphProto):
+            def run():
+                sub_env = dict(env)
+                self._run_graph(sub, sub_env)
+                vals = [sub_env[o.name] for o in sub.output]
+                return vals[0] if len(vals) == 1 else tuple(vals)
+
+            return run
+
+        for node in graph.node:
+            try:
+                fn = OPS[node.op_type]
+            except KeyError:
+                raise NotImplementedError(f"unsupported ONNX op {node.op_type}") from None
+            inputs = [env[i] if i else None for i in node.input]
+            ctx = {
+                "op_type": node.op_type,
+                "opset": self.opset,
+                "n_outputs": len(node.output),
+                "accum_dtype": accum,
+                "subgraph_runner": subgraph_runner,
+            }
+            try:
+                out = fn(inputs, node.attrs(), ctx)
+            except Exception as e:
+                raise type(e)(
+                    f"while executing node {node.name or '?'} ({node.op_type}) "
+                    f"inputs={node.input}: {e}"
+                ) from e
+            outs = out if isinstance(out, tuple) else (out,)
+            # Constant folding: all-constant inputs => pin outputs as numpy so shape
+            # chains (Shape -> Gather -> Concat -> Reshape) stay static under tracing.
+            if all(v is None or _is_const(v) for v in inputs) and node.op_type != "Dropout":
+                pinned = []
+                for o in outs:
+                    try:
+                        pinned.append(np.asarray(o))
+                    except Exception:
+                        pinned.append(o)  # traced despite const inputs (shouldn't happen)
+                outs = tuple(pinned)
+            for name, val in zip(node.output, outs):
+                if name:
+                    env[name] = val
+
+
+def load_model(path_or_bytes, dtype_policy: str = "float32") -> OnnxFunction:
+    """Load an ``.onnx`` file (path or bytes) into an executable function."""
+    if isinstance(path_or_bytes, (bytes, bytearray, memoryview)):
+        data = bytes(path_or_bytes)
+    else:
+        with open(path_or_bytes, "rb") as f:
+            data = f.read()
+    return OnnxFunction(data, dtype_policy=dtype_policy)

@@ -1,0 +1,861 @@
+"""ONNX operator implementations on JAX.
+
+Each entry maps an ONNX op_type to ``fn(inputs, attrs, ctx) -> output | tuple``.
+``inputs`` holds jnp arrays (traced under jit), numpy arrays (graph constants —
+initializers, Constant nodes, and anything derived only from them or from *shapes*),
+or None for omitted optional inputs. Numpy-ness is significant: ops that *need* static
+values (Reshape target, Slice bounds, ...) require numpy inputs, which the executor
+guarantees by constant-folding shape arithmetic during tracing (under ``jit`` shapes are
+static, so ``Shape`` always yields numpy — this is how dynamic-shape chains in BERT-style
+exports compile to static XLA programs; reference pins only dim 0 instead,
+``ONNXModel.scala:357-362``).
+
+Opset notes: handles both attribute-style (<13) and input-style (>=13) axes for
+Squeeze/Unsqueeze/Reduce*, Clip min/max attrs (<11) vs inputs, Pad attrs (<11) vs
+inputs, Slice attrs (<10) vs inputs.
+
+TPU notes: convs/matmuls go through ``lax.conv_general_dilated``/``jnp.matmul`` and land
+on the MXU; XLA picks layouts (NCHW semantics preserved from ONNX). bf16 execution is
+applied at the executor level by dtype policy, not per-op.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+OPS: Dict[str, Callable] = {}
+
+
+def op(*names: str):
+    def deco(fn):
+        for n in names:
+            OPS[n] = fn
+        return fn
+
+    return deco
+
+
+def _static(v, what: str) -> np.ndarray:
+    """Require a graph-constant (numpy) value; informative error otherwise."""
+    if v is None:
+        raise ValueError(f"{what}: missing required static input")
+    if isinstance(v, np.ndarray) or np.isscalar(v):
+        return np.asarray(v)
+    raise ValueError(
+        f"{what} must be a graph constant (initializer / shape-derived), got a traced "
+        f"array; this graph has genuinely data-dependent shapes, which XLA cannot compile"
+    )
+
+
+def _ints(v, what: str) -> List[int]:
+    return [int(x) for x in np.atleast_1d(_static(v, what))]
+
+
+def _axis_list(attrs, inputs, idx, what, default=None):
+    """axes from attrs (opset<13) or inputs[idx] (>=13)."""
+    if attrs.get("axes") is not None:
+        return [int(a) for a in attrs["axes"]]
+    if len(inputs) > idx and inputs[idx] is not None:
+        return _ints(inputs[idx], what)
+    return default
+
+
+# ---------------------------------------------------------------------------------
+# elementwise math
+# ---------------------------------------------------------------------------------
+
+_BINOPS = {
+    "Add": jnp.add, "Sub": jnp.subtract, "Mul": jnp.multiply, "Div": jnp.divide,
+    "Pow": jnp.power, "Mod": jnp.mod, "PRelu": lambda x, s: jnp.where(x >= 0, x, x * s),
+    "And": jnp.logical_and, "Or": jnp.logical_or, "Xor": jnp.logical_xor,
+    "BitwiseAnd": jnp.bitwise_and, "BitwiseOr": jnp.bitwise_or, "BitwiseXor": jnp.bitwise_xor,
+}
+for _name, _fn in _BINOPS.items():
+    OPS[_name] = (lambda f: lambda inputs, attrs, ctx: f(inputs[0], inputs[1]))(_fn)
+
+_UNOPS = {
+    "Sqrt": jnp.sqrt, "Exp": jnp.exp, "Log": jnp.log, "Abs": jnp.abs, "Neg": jnp.negative,
+    "Floor": jnp.floor, "Ceil": jnp.ceil, "Reciprocal": lambda x: 1.0 / x,
+    "Sign": jnp.sign, "Erf": jax.scipy.special.erf, "Not": jnp.logical_not,
+    "Relu": jax.nn.relu, "Sigmoid": jax.nn.sigmoid, "Tanh": jnp.tanh,
+    "Softplus": jax.nn.softplus, "Softsign": jax.nn.soft_sign, "Identity": lambda x: x,
+    "IsNaN": jnp.isnan, "Sin": jnp.sin, "Cos": jnp.cos, "Tan": jnp.tan,
+    "Asin": jnp.arcsin, "Acos": jnp.arccos, "Atan": jnp.arctan,
+    "Sinh": jnp.sinh, "Cosh": jnp.cosh, "Asinh": jnp.arcsinh, "Acosh": jnp.arccosh,
+    "Atanh": jnp.arctanh, "BitwiseNot": jnp.bitwise_not,
+}
+for _name, _fn in _UNOPS.items():
+    OPS[_name] = (lambda f: lambda inputs, attrs, ctx: f(inputs[0]))(_fn)
+
+
+@op("Round")
+def _round(inputs, attrs, ctx):
+    return jnp.round(inputs[0])  # banker's rounding matches ONNX spec
+
+
+@op("Equal", "Greater", "GreaterOrEqual", "Less", "LessOrEqual")
+def _compare(inputs, attrs, ctx, _fns={"Equal": jnp.equal, "Greater": jnp.greater,
+                                       "GreaterOrEqual": jnp.greater_equal,
+                                       "Less": jnp.less, "LessOrEqual": jnp.less_equal}):
+    return _fns[ctx["op_type"]](inputs[0], inputs[1])
+
+
+@op("Min", "Max", "Sum", "Mean")
+def _variadic(inputs, attrs, ctx):
+    vals = [v for v in inputs if v is not None]
+    red = {"Min": jnp.minimum, "Max": jnp.maximum}.get(ctx["op_type"])
+    if red is not None:
+        return functools.reduce(red, vals)
+    s = functools.reduce(jnp.add, vals)
+    return s / len(vals) if ctx["op_type"] == "Mean" else s
+
+
+@op("Clip")
+def _clip(inputs, attrs, ctx):
+    lo = attrs.get("min") if attrs.get("min") is not None else (inputs[1] if len(inputs) > 1 else None)
+    hi = attrs.get("max") if attrs.get("max") is not None else (inputs[2] if len(inputs) > 2 else None)
+    return jnp.clip(inputs[0], lo, hi)
+
+
+@op("LeakyRelu")
+def _leaky(inputs, attrs, ctx):
+    return jax.nn.leaky_relu(inputs[0], attrs.get("alpha", 0.01))
+
+
+@op("Elu")
+def _elu(inputs, attrs, ctx):
+    return jax.nn.elu(inputs[0], attrs.get("alpha", 1.0))
+
+
+@op("Selu")
+def _selu(inputs, attrs, ctx):
+    a = attrs.get("alpha", 1.6732632423543772)
+    g = attrs.get("gamma", 1.0507009873554805)
+    x = inputs[0]
+    return g * jnp.where(x > 0, x, a * (jnp.exp(x) - 1.0))
+
+
+@op("Celu")
+def _celu(inputs, attrs, ctx):
+    return jax.nn.celu(inputs[0], attrs.get("alpha", 1.0))
+
+
+@op("HardSigmoid")
+def _hard_sigmoid(inputs, attrs, ctx):
+    a, b = attrs.get("alpha", 0.2), attrs.get("beta", 0.5)
+    return jnp.clip(a * inputs[0] + b, 0.0, 1.0)
+
+
+@op("HardSwish")
+def _hard_swish(inputs, attrs, ctx):
+    x = inputs[0]
+    return x * jnp.clip(x / 6.0 + 0.5, 0.0, 1.0)
+
+
+@op("Mish")
+def _mish(inputs, attrs, ctx):
+    x = inputs[0]
+    return x * jnp.tanh(jax.nn.softplus(x))
+
+
+@op("Gelu")
+def _gelu(inputs, attrs, ctx):
+    approx = attrs.get("approximate", "none") == "tanh"
+    return jax.nn.gelu(inputs[0], approximate=approx)
+
+
+@op("Softmax")
+def _softmax(inputs, attrs, ctx):
+    axis = attrs.get("axis", -1 if ctx["opset"] >= 13 else 1)
+    if ctx["opset"] >= 13:
+        return jax.nn.softmax(inputs[0], axis=axis)
+    # pre-13: flatten trailing dims from axis, softmax over the flattened tail
+    x = inputs[0]
+    shape = x.shape
+    lead = int(np.prod(shape[:axis])) if axis > 0 else 1
+    flat = x.reshape(lead, -1)
+    return jax.nn.softmax(flat, axis=-1).reshape(shape)
+
+
+@op("LogSoftmax")
+def _log_softmax(inputs, attrs, ctx):
+    axis = attrs.get("axis", -1 if ctx["opset"] >= 13 else 1)
+    return jax.nn.log_softmax(inputs[0], axis=axis)
+
+
+@op("Einsum")
+def _einsum(inputs, attrs, ctx):
+    return jnp.einsum(attrs["equation"], *[v for v in inputs if v is not None])
+
+
+@op("CumSum")
+def _cumsum(inputs, attrs, ctx):
+    axis = int(_static(inputs[1], "CumSum.axis"))
+    x = inputs[0]
+    if attrs.get("reverse", 0):
+        x = jnp.flip(x, axis)
+    out = jnp.cumsum(x, axis=axis)
+    if attrs.get("exclusive", 0):
+        out = jnp.roll(out, 1, axis)
+        idx = [slice(None)] * out.ndim
+        idx[axis] = 0
+        out = out.at[tuple(idx)].set(0)
+    if attrs.get("reverse", 0):
+        out = jnp.flip(out, axis)
+    return out
+
+
+# ---------------------------------------------------------------------------------
+# matmul / gemm
+# ---------------------------------------------------------------------------------
+
+@op("MatMul")
+def _matmul(inputs, attrs, ctx):
+    return jnp.matmul(inputs[0], inputs[1], preferred_element_type=ctx.get("accum_dtype"))
+
+
+@op("Gemm")
+def _gemm(inputs, attrs, ctx):
+    a, b = inputs[0], inputs[1]
+    if attrs.get("transA", 0):
+        a = a.T
+    if attrs.get("transB", 0):
+        b = b.T
+    out = attrs.get("alpha", 1.0) * jnp.matmul(a, b, preferred_element_type=ctx.get("accum_dtype"))
+    if len(inputs) > 2 and inputs[2] is not None:
+        out = out + attrs.get("beta", 1.0) * inputs[2]
+    return out.astype(a.dtype) if out.dtype != a.dtype else out
+
+
+# ---------------------------------------------------------------------------------
+# convolution / pooling
+# ---------------------------------------------------------------------------------
+
+def _resolve_pads(attrs, spatial_rank: int, x_shape, k_shape, strides, dilations):
+    """ONNX pads [x1b,x2b,...,x1e,x2e,...] or auto_pad."""
+    auto = attrs.get("auto_pad", "NOTSET")
+    if auto in ("NOTSET", ""):
+        pads = attrs.get("pads") or [0] * (2 * spatial_rank)
+        return [(int(pads[i]), int(pads[i + spatial_rank])) for i in range(spatial_rank)]
+    if auto == "VALID":
+        return [(0, 0)] * spatial_rank
+    # SAME_UPPER / SAME_LOWER
+    out = []
+    for i in range(spatial_rank):
+        in_dim = x_shape[2 + i]
+        eff_k = (k_shape[i] - 1) * dilations[i] + 1
+        out_dim = -(-in_dim // strides[i])
+        total = max(0, (out_dim - 1) * strides[i] + eff_k - in_dim)
+        lo = total // 2 if auto == "SAME_UPPER" else (total + 1) // 2
+        out.append((lo, total - lo))
+    return out
+
+
+@op("Conv")
+def _conv(inputs, attrs, ctx):
+    x, w = inputs[0], inputs[1]
+    b = inputs[2] if len(inputs) > 2 else None
+    rank = x.ndim - 2
+    strides = [int(s) for s in attrs.get("strides", [1] * rank)]
+    dilations = [int(d) for d in attrs.get("dilations", [1] * rank)]
+    groups = int(attrs.get("group", 1))
+    kernel_spatial = w.shape[2:]
+    pads = _resolve_pads(attrs, rank, x.shape, kernel_spatial, strides, dilations)
+    dn = lax.conv_dimension_numbers(x.shape, w.shape,
+                                    ("NCHW"[: rank + 2], "OIHW"[: rank + 2], "NCHW"[: rank + 2])
+                                    if rank <= 2 else
+                                    ("NCDHW", "OIDHW", "NCDHW"))
+    out = lax.conv_general_dilated(
+        x, w, window_strides=strides, padding=pads, rhs_dilation=dilations,
+        dimension_numbers=dn, feature_group_count=groups,
+        preferred_element_type=ctx.get("accum_dtype"),
+    )
+    if out.dtype != x.dtype:
+        out = out.astype(x.dtype)
+    if b is not None:
+        out = out + b.reshape((1, -1) + (1,) * rank)
+    return out
+
+
+@op("ConvTranspose")
+def _conv_transpose(inputs, attrs, ctx):
+    x, w = inputs[0], inputs[1]
+    b = inputs[2] if len(inputs) > 2 else None
+    rank = x.ndim - 2
+    strides = [int(s) for s in attrs.get("strides", [1] * rank)]
+    dilations = [int(d) for d in attrs.get("dilations", [1] * rank)]
+    groups = int(attrs.get("group", 1))
+    if groups != 1:
+        raise NotImplementedError("grouped ConvTranspose not supported yet")
+    kernel_spatial = w.shape[2:]
+    pads = _resolve_pads(attrs, rank, x.shape, kernel_spatial, strides, dilations)
+    out_pads = [int(p) for p in attrs.get("output_padding", [0] * rank)]
+    # ONNX W layout for ConvTranspose is (C_in, C_out/groups, *k); transpose to OIHW.
+    w_t = jnp.swapaxes(w, 0, 1)
+    w_t = jnp.flip(w_t, axis=tuple(range(2, 2 + rank)))
+    # conv_transpose via input dilation
+    padding = []
+    for i in range(rank):
+        eff_k = (kernel_spatial[i] - 1) * dilations[i] + 1
+        padding.append((eff_k - 1 - pads[i][0], eff_k - 1 - pads[i][1] + out_pads[i]))
+    dn = lax.conv_dimension_numbers(x.shape, w_t.shape,
+                                    ("NCHW"[: rank + 2], "OIHW"[: rank + 2], "NCHW"[: rank + 2]))
+    out = lax.conv_general_dilated(
+        x, w_t, window_strides=[1] * rank, padding=padding, lhs_dilation=strides,
+        rhs_dilation=dilations, dimension_numbers=dn,
+        preferred_element_type=ctx.get("accum_dtype"),
+    )
+    if out.dtype != x.dtype:
+        out = out.astype(x.dtype)
+    if b is not None:
+        out = out + b.reshape((1, -1) + (1,) * rank)
+    return out
+
+
+def _pool(x, kernel, strides, pads, reducer, init, count_include_pad, ceil_mode=0):
+    rank = len(kernel)
+    if ceil_mode:
+        # extend end-padding so ceil-division windows fit
+        new_pads = []
+        for i in range(rank):
+            in_dim = x.shape[2 + i] + pads[i][0] + pads[i][1]
+            rem = (in_dim - kernel[i]) % strides[i]
+            extra = (strides[i] - rem) % strides[i] if rem else 0
+            new_pads.append((pads[i][0], pads[i][1] + extra))
+        pads = new_pads
+    window = (1, 1) + tuple(kernel)
+    strides_full = (1, 1) + tuple(strides)
+    pads_full = ((0, 0), (0, 0)) + tuple(pads)
+    out = lax.reduce_window(x, init, reducer, window, strides_full, pads_full)
+    return out, pads
+
+
+@op("MaxPool")
+def _maxpool(inputs, attrs, ctx):
+    x = inputs[0]
+    kernel = [int(k) for k in attrs["kernel_shape"]]
+    rank = len(kernel)
+    strides = [int(s) for s in attrs.get("strides", [1] * rank)]
+    dil = [int(d) for d in attrs.get("dilations", [1] * rank)]
+    if any(d != 1 for d in dil):
+        raise NotImplementedError("dilated MaxPool not supported")
+    pads = _resolve_pads(attrs, rank, x.shape, kernel, strides, [1] * rank)
+    neg_inf = jnp.array(-jnp.inf if jnp.issubdtype(x.dtype, jnp.floating)
+                        else jnp.iinfo(x.dtype).min, dtype=x.dtype)
+    out, _ = _pool(x, kernel, strides, pads, lax.max, neg_inf, False,
+                   attrs.get("ceil_mode", 0))
+    return out
+
+
+@op("AveragePool")
+def _avgpool(inputs, attrs, ctx):
+    x = inputs[0]
+    kernel = [int(k) for k in attrs["kernel_shape"]]
+    rank = len(kernel)
+    strides = [int(s) for s in attrs.get("strides", [1] * rank)]
+    pads = _resolve_pads(attrs, rank, x.shape, kernel, strides, [1] * rank)
+    include_pad = attrs.get("count_include_pad", 0)
+    out, eff_pads = _pool(x, kernel, strides, pads, lax.add, jnp.array(0, x.dtype),
+                          include_pad, attrs.get("ceil_mode", 0))
+    if include_pad:
+        return out / float(np.prod(kernel))
+    ones = jnp.ones(x.shape[2:], dtype=x.dtype)[None, None]
+    counts, _ = _pool(ones, kernel, strides, eff_pads, lax.add, jnp.array(0, x.dtype), True)
+    return out / counts
+
+
+@op("GlobalAveragePool")
+def _gap(inputs, attrs, ctx):
+    x = inputs[0]
+    return jnp.mean(x, axis=tuple(range(2, x.ndim)), keepdims=True)
+
+
+@op("GlobalMaxPool")
+def _gmp(inputs, attrs, ctx):
+    x = inputs[0]
+    return jnp.max(x, axis=tuple(range(2, x.ndim)), keepdims=True)
+
+
+@op("LRN")
+def _lrn(inputs, attrs, ctx):
+    x = inputs[0]
+    size = int(attrs["size"])
+    alpha, beta, bias = attrs.get("alpha", 1e-4), attrs.get("beta", 0.75), attrs.get("bias", 1.0)
+    sq = x * x
+    half = size // 2
+    pads = ((0, 0), (half, size - 1 - half)) + ((0, 0),) * (x.ndim - 2)
+    window = (1, size) + (1,) * (x.ndim - 2)
+    summed = lax.reduce_window(sq, jnp.array(0, x.dtype), lax.add, window, (1,) * x.ndim, pads)
+    return x / jnp.power(bias + (alpha / size) * summed, beta)
+
+
+# ---------------------------------------------------------------------------------
+# normalization
+# ---------------------------------------------------------------------------------
+
+@op("BatchNormalization")
+def _batchnorm(inputs, attrs, ctx):
+    x, scale, bias, mean, var = inputs[:5]
+    eps = attrs.get("epsilon", 1e-5)
+    shape = (1, -1) + (1,) * (x.ndim - 2)
+    inv = lax.rsqrt(var.astype(jnp.float32) + eps).astype(x.dtype)
+    return (x - mean.reshape(shape)) * (scale * inv).reshape(shape) + bias.reshape(shape)
+
+
+@op("InstanceNormalization")
+def _instancenorm(inputs, attrs, ctx):
+    x, scale, bias = inputs[:3]
+    eps = attrs.get("epsilon", 1e-5)
+    axes = tuple(range(2, x.ndim))
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.var(x, axis=axes, keepdims=True)
+    shape = (1, -1) + (1,) * (x.ndim - 2)
+    return (x - mean) * lax.rsqrt(var + eps) * scale.reshape(shape) + bias.reshape(shape)
+
+
+@op("LayerNormalization")
+def _layernorm(inputs, attrs, ctx):
+    x = inputs[0]
+    scale = inputs[1] if len(inputs) > 1 else None
+    bias = inputs[2] if len(inputs) > 2 else None
+    axis = attrs.get("axis", -1)
+    eps = attrs.get("epsilon", 1e-5)
+    axes = tuple(range(axis % x.ndim, x.ndim))
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.var(x, axis=axes, keepdims=True)
+    out = (x - mean) * lax.rsqrt(var + eps)
+    if scale is not None:
+        out = out * scale
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+@op("GroupNormalization")
+def _groupnorm(inputs, attrs, ctx):
+    x, scale, bias = inputs[:3]
+    g = int(attrs["num_groups"])
+    eps = attrs.get("epsilon", 1e-5)
+    n, c = x.shape[:2]
+    xg = x.reshape((n, g, c // g) + x.shape[2:])
+    axes = tuple(range(2, xg.ndim))
+    mean = jnp.mean(xg, axis=axes, keepdims=True)
+    var = jnp.var(xg, axis=axes, keepdims=True)
+    out = ((xg - mean) * lax.rsqrt(var + eps)).reshape(x.shape)
+    shape = (1, -1) + (1,) * (x.ndim - 2)
+    return out * scale.reshape(shape) + bias.reshape(shape)
+
+
+@op("Dropout")
+def _dropout(inputs, attrs, ctx):
+    # inference-mode: identity (+ all-true mask as optional second output)
+    x = inputs[0]
+    return (x, jnp.ones(x.shape, dtype=bool))
+
+
+# ---------------------------------------------------------------------------------
+# shape / data movement  (static-shape discipline: see module docstring)
+# ---------------------------------------------------------------------------------
+
+@op("Shape")
+def _shape(inputs, attrs, ctx):
+    shp = np.asarray(np.shape(inputs[0]), dtype=np.int64)
+    start = attrs.get("start", 0)
+    end = attrs.get("end")
+    return shp[start:end]
+
+
+@op("Size")
+def _size(inputs, attrs, ctx):
+    return np.asarray(int(np.prod(np.shape(inputs[0]))), dtype=np.int64)
+
+
+@op("Reshape")
+def _reshape(inputs, attrs, ctx):
+    if attrs.get("shape") is not None:  # opset<5 attribute form
+        target = [int(s) for s in attrs["shape"]]
+    else:
+        target = _ints(inputs[1], "Reshape.shape")
+    x = inputs[0]
+    if attrs.get("allowzero", 0) == 0:
+        target = [x.shape[i] if s == 0 else s for i, s in enumerate(target)]
+    return jnp.reshape(x, target)
+
+
+@op("Flatten")
+def _flatten(inputs, attrs, ctx):
+    x = inputs[0]
+    axis = attrs.get("axis", 1) % (x.ndim + 1)
+    lead = int(np.prod(x.shape[:axis])) if axis else 1
+    return jnp.reshape(x, (lead, -1))
+
+
+@op("Transpose")
+def _transpose(inputs, attrs, ctx):
+    perm = attrs.get("perm")
+    x = inputs[0]
+    return jnp.transpose(x, perm if perm is not None else tuple(reversed(range(x.ndim))))
+
+
+@op("Concat")
+def _concat(inputs, attrs, ctx):
+    vals = [v for v in inputs if v is not None]
+    if all(isinstance(v, np.ndarray) for v in vals):
+        return np.concatenate([np.atleast_1d(v) for v in vals], axis=attrs.get("axis", 0))
+    return jnp.concatenate([jnp.atleast_1d(v) for v in vals], axis=attrs.get("axis", 0))
+
+
+@op("Split")
+def _split(inputs, attrs, ctx):
+    x = inputs[0]
+    axis = attrs.get("axis", 0)
+    splits = attrs.get("split")
+    if splits is None and len(inputs) > 1 and inputs[1] is not None:
+        splits = _ints(inputs[1], "Split.split")
+    n_out = ctx["n_outputs"]
+    if splits is None:
+        dim = x.shape[axis]
+        base = -(-dim // n_out) if attrs.get("num_outputs") else dim // n_out
+        splits = [base] * (n_out - 1) + [dim - base * (n_out - 1)]
+    idx = np.cumsum(splits)[:-1]
+    return tuple(jnp.split(x, idx, axis=axis))
+
+
+@op("Slice")
+def _slice(inputs, attrs, ctx):
+    x = inputs[0]
+    if attrs.get("starts") is not None:  # opset<10 attribute form
+        starts, ends = list(attrs["starts"]), list(attrs["ends"])
+        axes = list(attrs.get("axes", range(len(starts))))
+        steps = [1] * len(starts)
+    else:
+        starts = _ints(inputs[1], "Slice.starts")
+        ends = _ints(inputs[2], "Slice.ends")
+        axes = _ints(inputs[3], "Slice.axes") if len(inputs) > 3 and inputs[3] is not None \
+            else list(range(len(starts)))
+        steps = _ints(inputs[4], "Slice.steps") if len(inputs) > 4 and inputs[4] is not None \
+            else [1] * len(starts)
+    idx = [slice(None)] * x.ndim
+    for s, e, a, st in zip(starts, ends, axes, steps):
+        a = a % x.ndim
+        idx[a] = slice(s if s > -(1 << 62) else None,
+                       e if -(1 << 62) < e < (1 << 62) else None, st)
+    return x[tuple(idx)]
+
+
+@op("Gather")
+def _gather(inputs, attrs, ctx):
+    x, idx = inputs[0], inputs[1]
+    axis = attrs.get("axis", 0)
+    if isinstance(x, np.ndarray) and isinstance(idx, np.ndarray):
+        return np.take(x, idx.astype(np.int64), axis=axis)
+    return jnp.take(x, jnp.asarray(idx), axis=axis)
+
+
+@op("GatherElements")
+def _gather_elements(inputs, attrs, ctx):
+    x, idx = inputs[0], jnp.asarray(inputs[1])
+    axis = attrs.get("axis", 0)
+    return jnp.take_along_axis(x, idx, axis=axis)
+
+
+@op("GatherND")
+def _gather_nd(inputs, attrs, ctx):
+    x, idx = inputs[0], inputs[1]
+    batch_dims = attrs.get("batch_dims", 0)
+    if batch_dims:
+        raise NotImplementedError("GatherND batch_dims>0")
+    idx = jnp.asarray(idx)
+    return x[tuple(jnp.moveaxis(idx, -1, 0))]
+
+
+@op("ScatterND")
+def _scatter_nd(inputs, attrs, ctx):
+    data, indices, updates = inputs[:3]
+    indices = jnp.asarray(indices)
+    out = jnp.asarray(data)
+    red = attrs.get("reduction", "none")
+    at = out.at[tuple(jnp.moveaxis(indices, -1, 0))]
+    if red == "add":
+        return at.add(updates)
+    if red == "mul":
+        return at.multiply(updates)
+    return at.set(updates)
+
+
+@op("Squeeze")
+def _squeeze(inputs, attrs, ctx):
+    x = inputs[0]
+    axes = _axis_list(attrs, inputs, 1, "Squeeze.axes")
+    if axes is None:
+        axes = [i for i, d in enumerate(np.shape(x)) if d == 1]
+    if isinstance(x, np.ndarray):
+        return np.squeeze(x, axis=tuple(a % x.ndim for a in axes))
+    return jnp.squeeze(x, axis=tuple(a % x.ndim for a in axes))
+
+
+@op("Unsqueeze")
+def _unsqueeze(inputs, attrs, ctx):
+    x = inputs[0]
+    axes = _axis_list(attrs, inputs, 1, "Unsqueeze.axes")
+    out_rank = np.ndim(x) + len(axes)
+    axes = sorted(a % out_rank for a in axes)
+    if isinstance(x, np.ndarray):
+        return np.expand_dims(x, tuple(axes))
+    return jnp.expand_dims(x, tuple(axes))
+
+
+@op("Expand")
+def _expand(inputs, attrs, ctx):
+    target = _ints(inputs[1], "Expand.shape")
+    x = inputs[0]
+    # ONNX Expand uses bidirectional broadcast; jnp.broadcast_to needs exact target.
+    in_shape = list(np.shape(x))
+    rank = max(len(in_shape), len(target))
+    in_shape = [1] * (rank - len(in_shape)) + in_shape
+    target = [1] * (rank - len(target)) + list(target)
+    final = [max(a, b) for a, b in zip(in_shape, target)]
+    return jnp.broadcast_to(x, final)
+
+
+@op("Tile")
+def _tile(inputs, attrs, ctx):
+    reps = _ints(inputs[1], "Tile.repeats")
+    return jnp.tile(inputs[0], reps)
+
+
+@op("Pad")
+def _pad(inputs, attrs, ctx):
+    x = inputs[0]
+    mode = attrs.get("mode", "constant")
+    if attrs.get("pads") is not None:  # opset<11
+        pads = [int(p) for p in attrs["pads"]]
+        cval = attrs.get("value", 0.0)
+    else:
+        pads = _ints(inputs[1], "Pad.pads")
+        cval = inputs[2] if len(inputs) > 2 and inputs[2] is not None else 0.0
+    rank = x.ndim
+    axes = _ints(inputs[3], "Pad.axes") if len(inputs) > 3 and inputs[3] is not None \
+        else list(range(rank))
+    width = [(0, 0)] * rank
+    half = len(pads) // 2
+    for i, a in enumerate(axes):
+        width[a % rank] = (pads[i], pads[i + half])
+    if mode == "constant":
+        cval_scalar = cval if np.isscalar(cval) else jnp.reshape(cval, ())
+        return jnp.pad(x, width, constant_values=cval_scalar)
+    jmode = {"reflect": "reflect", "edge": "edge", "wrap": "wrap"}[mode]
+    return jnp.pad(x, width, mode=jmode)
+
+
+@op("Cast", "CastLike")
+def _cast(inputs, attrs, ctx):
+    from .wire import DataType
+
+    if ctx["op_type"] == "CastLike":
+        dtype = np.asarray(inputs[1]).dtype if isinstance(inputs[1], np.ndarray) else inputs[1].dtype
+    else:
+        dtype = DataType.to_numpy(int(attrs["to"]))
+    x = inputs[0]
+    if isinstance(x, np.ndarray):
+        return x.astype(dtype)
+    return x.astype(dtype)
+
+
+@op("Where")
+def _where(inputs, attrs, ctx):
+    c, a, b = inputs[:3]
+    if all(isinstance(v, np.ndarray) for v in (c, a, b)):
+        return np.where(c, a, b)
+    return jnp.where(c, a, b)
+
+
+@op("OneHot")
+def _onehot(inputs, attrs, ctx):
+    indices, depth, values = inputs[:3]
+    axis = attrs.get("axis", -1)
+    d = int(_static(depth, "OneHot.depth"))
+    off_val, on_val = values[0], values[1]
+    oh = jax.nn.one_hot(jnp.asarray(indices) % d, d, axis=axis)
+    return oh * (on_val - off_val) + off_val
+
+
+@op("Range")
+def _range(inputs, attrs, ctx):
+    start, limit, delta = (_static(v, "Range") for v in inputs[:3])
+    return np.arange(start.item(), limit.item(), delta.item(),
+                     dtype=np.asarray(start).dtype)
+
+
+@op("ConstantOfShape")
+def _constant_of_shape(inputs, attrs, ctx):
+    from .wire import tensor_to_numpy
+
+    shape = _ints(inputs[0], "ConstantOfShape.shape")
+    t = attrs.get("value")
+    if t is None:
+        return np.zeros(shape, dtype=np.float32)
+    v = tensor_to_numpy(t)
+    return np.full(shape, v.reshape(-1)[0], dtype=v.dtype)
+
+
+@op("Constant")
+def _constant(inputs, attrs, ctx):
+    from .wire import tensor_to_numpy
+
+    if attrs.get("value") is not None:
+        return tensor_to_numpy(attrs["value"])
+    for k in ("value_float", "value_int"):
+        if attrs.get(k) is not None:
+            return np.asarray(attrs[k])
+    for k in ("value_floats", "value_ints"):
+        if attrs.get(k) is not None:
+            return np.asarray(attrs[k])
+    raise ValueError("Constant node with no value attribute")
+
+
+@op("DepthToSpace")
+def _depth_to_space(inputs, attrs, ctx):
+    x = inputs[0]
+    b = int(attrs["blocksize"])
+    n, c, h, w = x.shape
+    if attrs.get("mode", "DCR") == "DCR":
+        t = x.reshape(n, b, b, c // (b * b), h, w).transpose(0, 3, 4, 1, 5, 2)
+    else:
+        t = x.reshape(n, c // (b * b), b, b, h, w).transpose(0, 1, 4, 2, 5, 3)
+    return t.reshape(n, c // (b * b), h * b, w * b)
+
+
+@op("SpaceToDepth")
+def _space_to_depth(inputs, attrs, ctx):
+    x = inputs[0]
+    b = int(attrs["blocksize"])
+    n, c, h, w = x.shape
+    t = x.reshape(n, c, h // b, b, w // b, b).transpose(0, 3, 5, 1, 2, 4)
+    return t.reshape(n, c * b * b, h // b, w // b)
+
+
+@op("Resize")
+def _resize(inputs, attrs, ctx):
+    x = inputs[0]
+    mode = attrs.get("mode", "nearest")
+    sizes = None
+    if len(inputs) > 3 and inputs[3] is not None:
+        sizes = _ints(inputs[3], "Resize.sizes")
+    elif len(inputs) > 2 and inputs[2] is not None:
+        scales = np.asarray(_static(inputs[2], "Resize.scales"), dtype=np.float64)
+        if scales.size:
+            sizes = [int(np.floor(s * d)) for s, d in zip(scales, x.shape)]
+    if sizes is None:
+        raise ValueError("Resize needs scales or sizes")
+    method = {"nearest": "nearest", "linear": "linear", "cubic": "cubic"}[mode]
+    return jax.image.resize(x, sizes, method=method)
+
+
+@op("ArgMax", "ArgMin")
+def _argminmax(inputs, attrs, ctx):
+    axis = attrs.get("axis", 0)
+    keepdims = attrs.get("keepdims", 1)
+    fn = jnp.argmax if ctx["op_type"] == "ArgMax" else jnp.argmin
+    x = inputs[0]
+    if attrs.get("select_last_index", 0):
+        x = jnp.flip(x, axis)
+        out = x.shape[axis] - 1 - fn(x, axis=axis)
+    else:
+        out = fn(x, axis=axis)
+    out = out.astype(jnp.int64 if jax.config.jax_enable_x64 else jnp.int32)
+    return jnp.expand_dims(out, axis) if keepdims else out
+
+
+@op("TopK")
+def _topk(inputs, attrs, ctx):
+    x = inputs[0]
+    k = int(_static(inputs[1], "TopK.k")) if len(inputs) > 1 else int(attrs["k"])
+    axis = attrs.get("axis", -1)
+    largest = attrs.get("largest", 1)
+    xm = jnp.moveaxis(x, axis, -1)
+    vals, idx = lax.top_k(xm if largest else -xm, k)
+    if not largest:
+        vals = -vals
+    return (jnp.moveaxis(vals, -1, axis), jnp.moveaxis(idx, -1, axis))
+
+
+@op("Trilu")
+def _trilu(inputs, attrs, ctx):
+    x = inputs[0]
+    k = int(_static(inputs[1], "Trilu.k")) if len(inputs) > 1 and inputs[1] is not None else 0
+    return jnp.triu(x, k) if attrs.get("upper", 1) else jnp.tril(x, k)
+
+
+@op("IsInf")
+def _isinf(inputs, attrs, ctx):
+    x = inputs[0]
+    pos = attrs.get("detect_positive", 1)
+    neg = attrs.get("detect_negative", 1)
+    out = jnp.zeros(jnp.shape(x), dtype=bool)
+    if pos:
+        out = out | (x == jnp.inf)
+    if neg:
+        out = out | (x == -jnp.inf)
+    return out
+
+
+# ---------------------------------------------------------------------------------
+# reductions
+# ---------------------------------------------------------------------------------
+
+def _reduce(fn_np, fn_jnp, axes_from_input_opset: int):
+    def impl(inputs, attrs, ctx):
+        x = inputs[0]
+        if ctx["opset"] >= axes_from_input_opset:
+            axes = _axis_list({"axes": attrs.get("axes")}, inputs, 1, "Reduce.axes")
+        else:
+            axes = attrs.get("axes")
+        keepdims = bool(attrs.get("keepdims", 1))
+        if axes is None:
+            if attrs.get("noop_with_empty_axes", 0):
+                return x
+            ax = None
+        else:
+            ax = tuple(int(a) for a in np.atleast_1d(axes))
+        if isinstance(x, np.ndarray):
+            return fn_np(x, axis=ax, keepdims=keepdims)
+        return fn_jnp(x, axis=ax, keepdims=keepdims)
+
+    return impl
+
+
+OPS["ReduceSum"] = _reduce(np.sum, jnp.sum, 13)
+OPS["ReduceMean"] = _reduce(np.mean, jnp.mean, 18)
+OPS["ReduceMax"] = _reduce(np.max, jnp.max, 18)
+OPS["ReduceMin"] = _reduce(np.min, jnp.min, 18)
+OPS["ReduceProd"] = _reduce(np.prod, jnp.prod, 18)
+OPS["ReduceL1"] = _reduce(lambda x, axis, keepdims: np.sum(np.abs(x), axis=axis, keepdims=keepdims),
+                          lambda x, axis, keepdims: jnp.sum(jnp.abs(x), axis=axis, keepdims=keepdims), 18)
+OPS["ReduceL2"] = _reduce(lambda x, axis, keepdims: np.sqrt(np.sum(x * x, axis=axis, keepdims=keepdims)),
+                          lambda x, axis, keepdims: jnp.sqrt(jnp.sum(x * x, axis=axis, keepdims=keepdims)), 18)
+OPS["ReduceSumSquare"] = _reduce(lambda x, axis, keepdims: np.sum(x * x, axis=axis, keepdims=keepdims),
+                                 lambda x, axis, keepdims: jnp.sum(x * x, axis=axis, keepdims=keepdims), 18)
+OPS["ReduceLogSum"] = _reduce(lambda x, axis, keepdims: np.log(np.sum(x, axis=axis, keepdims=keepdims)),
+                              lambda x, axis, keepdims: jnp.log(jnp.sum(x, axis=axis, keepdims=keepdims)), 18)
+OPS["ReduceLogSumExp"] = _reduce(
+    lambda x, axis, keepdims: np.log(np.sum(np.exp(x), axis=axis, keepdims=keepdims)),
+    lambda x, axis, keepdims: jax.scipy.special.logsumexp(x, axis=axis, keepdims=keepdims), 18)
+
+
+@op("If")
+def _if(inputs, attrs, ctx):
+    cond = inputs[0]
+    then_fn, else_fn = ctx["subgraph_runner"](attrs["then_branch"]), ctx["subgraph_runner"](attrs["else_branch"])
+    if isinstance(cond, np.ndarray):  # constant condition: fold at trace time
+        return then_fn() if bool(cond) else else_fn()
+    raise NotImplementedError(
+        "If with traced condition not supported (branches may differ in shape); "
+        "most exported models have constant conditions after shape specialization"
+    )

@@ -45,11 +45,11 @@ def shared_singleton(key: str, factory: Callable[[], T]) -> T:
 
 
 def clear_shared_pool(prefix: str = "") -> None:
+    """Clear cached values. Per-key locks are deliberately retained: deleting a lock
+    another thread currently holds would let two factories race for the same key."""
     with _pool_lock:
         for k in [k for k in _pool if k.startswith(prefix)]:
             del _pool[k]
-        for k in [k for k in _key_locks if k.startswith(prefix)]:
-            del _key_locks[k]
 
 
 class SharedVariable(Generic[T]):

@@ -17,7 +17,6 @@ from __future__ import annotations
 
 import dataclasses
 import logging
-import math
 import os
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -79,25 +78,28 @@ def is_tpu() -> bool:
 
 
 def best_mesh_shape(n_devices: int, n_axes: int) -> Tuple[int, ...]:
-    """Factor ``n_devices`` into ``n_axes`` axes, largest-first.
+    """Factor ``n_devices`` into ``n_axes`` balanced axes, sorted largest-first.
 
+    Greedy prime-factor packing: factors (largest first) go to the axis with the
+    smallest current product, so e.g. 12 over 3 axes -> (3, 2, 2), 8 over 3 -> (2, 2, 2).
     Used when the caller asks for e.g. a ('data','model') mesh without specifying the
     split; mirrors how the reference derives numTasksPerExec from cores/taskCpus
     (``ClusterUtil.scala:20-105``) — sensible defaults, overridable.
     """
-    shape = [1] * n_axes
+    factors: List[int] = []
     rem = n_devices
-    for i in range(n_axes - 1):
-        # Peel off the largest power-of-two-ish factor for leading axes.
-        f = 1
-        for cand in range(int(math.isqrt(rem)), 0, -1):
-            if rem % cand == 0:
-                f = max(f, rem // cand if i == 0 else cand)
-                break
-        shape[i] = f
-        rem //= f
-    shape[-1] = rem
-    return tuple(shape)
+    d = 2
+    while d * d <= rem:
+        while rem % d == 0:
+            factors.append(d)
+            rem //= d
+        d += 1
+    if rem > 1:
+        factors.append(rem)
+    shape = [1] * n_axes
+    for f in sorted(factors, reverse=True):
+        shape[int(np.argmin(shape))] *= f
+    return tuple(sorted(shape, reverse=True))
 
 
 def make_mesh(

@@ -51,7 +51,13 @@ class StratifiedRepartition(Transformer):
         else:  # mixed: upsample only labels below the equal share
             share = n / k
             fracs = {u: max(1.0, share / c) for u, c in zip(uniq, counts)}
+        # Per-label cyclic dealing: each label's rows are spread round-robin over
+        # partitions (with rotating offsets), so every partition sees every label that
+        # has >= 1 row per partition's share — the stage's contract.
+        P = table.npartitions
         idx_parts: List[np.ndarray] = []
+        part_parts: List[np.ndarray] = []
+        offset = 0
         for u, c in zip(uniq, counts):
             rows = np.nonzero(labels == u)[0]
             want = int(round(fracs[u] * c))
@@ -60,9 +66,11 @@ class StratifiedRepartition(Transformer):
             else:
                 take = np.concatenate([rows, rng.choice(rows, size=want - c, replace=True)])
             idx_parts.append(take)
+            part_parts.append((np.arange(len(take)) + offset) % P)
+            offset += len(take)
         idx = np.concatenate(idx_parts)
-        # Deal rows round-robin across partitions so each partition gets every label.
-        order = np.argsort(rng.permutation(len(idx)) % table.npartitions, kind="stable")
+        part_of = np.concatenate(part_parts)
+        order = np.argsort(part_of, kind="stable")
         return table.take(idx[order])
 
 
@@ -82,6 +90,11 @@ class EnsembleByKey(Transformer):
     def _transform(self, table: Table) -> Table:
         self._validate_input(table, *self.keys, *self.cols)
         out_names = self.new_col_names or [f"{self.strategy}({c})" for c in self.cols]
+        if len(out_names) != len(self.cols):
+            raise ValueError(
+                f"EnsembleByKey({self.uid}): new_col_names has {len(out_names)} entries "
+                f"for {len(self.cols)} cols"
+            )
         key_arrays = [table[k] for k in self.keys]
         key_tuples = list(zip(*[a.tolist() for a in key_arrays]))
         uniq: Dict[tuple, int] = {}
@@ -131,7 +144,15 @@ class ClassBalancerModel(Model):
         self._validate_input(table, self.input_col)
         table_vals = table[self.input_col]
         lut = dict(zip(self.values, self.weights))
-        w = np.array([lut[str(v)] for v in table_vals], dtype=np.float64)
+        w = np.empty(len(table_vals), dtype=np.float64)
+        for i, v in enumerate(table_vals):
+            try:
+                w[i] = lut[str(v)]
+            except KeyError:
+                raise ValueError(
+                    f"ClassBalancerModel({self.uid}): label {v!r} in column "
+                    f"{self.input_col!r} was not seen during fit (known: {self.values})"
+                ) from None
         return table.with_column(self.output_col, w)
 
 

@@ -1,0 +1,353 @@
+"""ONNX engine tests: wire round-trip, op semantics vs torch, end-to-end models.
+
+Mirrors the reference's ONNXModelSuite strategy (`deep-learning/src/test/.../ONNXModelSuite.scala`)
+of asserting real model predictions — but cross-checks against torch (CPU) since the
+image has no network access for ONNX zoo downloads.
+"""
+
+import numpy as np
+import pytest
+
+from synapseml_tpu.core import Table
+from synapseml_tpu.onnx import (
+    ONNXModel,
+    OnnxFunction,
+    make_graph,
+    make_model,
+    node,
+    parse_model,
+    serialize_model,
+    value_info,
+)
+from synapseml_tpu.onnx.wire import numpy_to_tensor, tensor_to_numpy
+
+
+def build_fn(nodes, inputs, outputs, inits=None, opset=17, **kw):
+    g = make_graph(nodes, "test", inputs, outputs, inits)
+    return OnnxFunction(serialize_model(make_model(g, opset=opset)), **kw)
+
+
+def test_wire_roundtrip():
+    rng = np.random.default_rng(0)
+    w = rng.normal(size=(4, 3)).astype(np.float32)
+    g = make_graph(
+        [node("MatMul", ["x", "w"], ["y"]), node("Relu", ["y"], ["z"])],
+        "rt",
+        [value_info("x", np.float32, ["N", 4])],
+        [value_info("z", np.float32, ["N", 3])],
+        {"w": w},
+    )
+    m = make_model(g, opset=15)
+    data = serialize_model(m)
+    back = parse_model(data)
+    assert back.opset_version == 15
+    assert [n.op_type for n in back.graph.node] == ["MatMul", "Relu"]
+    np.testing.assert_allclose(tensor_to_numpy(back.graph.initializer[0]), w)
+    assert back.graph.input[0].shape == ["N", 4]
+
+
+def test_tensor_dtypes_roundtrip():
+    for dtype in [np.float32, np.int64, np.int32, np.uint8, np.bool_, np.float16]:
+        arr = (np.arange(6).reshape(2, 3) % 2).astype(dtype)
+        t = numpy_to_tensor("t", arr)
+        back = tensor_to_numpy(t)
+        np.testing.assert_array_equal(back, arr)
+
+
+def test_matmul_relu_exec():
+    w = np.array([[1.0, -1.0], [2.0, 0.5]], dtype=np.float32)
+    fn = build_fn(
+        [node("MatMul", ["x", "w"], ["y"]), node("Relu", ["y"], ["z"])],
+        [value_info("x", np.float32, [None, 2])],
+        [value_info("z", np.float32, [None, 2])],
+        {"w": w},
+    )
+    x = np.array([[1.0, 2.0]], dtype=np.float32)
+    out = fn({"x": x})["z"]
+    np.testing.assert_allclose(np.asarray(out), np.maximum(x @ w, 0))
+
+
+@pytest.mark.parametrize("stride,pad", [(1, 0), (2, 1), (1, 2)])
+def test_conv_matches_torch(stride, pad):
+    import torch
+
+    rng = np.random.default_rng(1)
+    x = rng.normal(size=(2, 3, 16, 16)).astype(np.float32)
+    w = rng.normal(size=(5, 3, 3, 3)).astype(np.float32)
+    b = rng.normal(size=(5,)).astype(np.float32)
+    ref = torch.nn.functional.conv2d(
+        torch.tensor(x), torch.tensor(w), torch.tensor(b), stride=stride, padding=pad
+    ).numpy()
+    fn = build_fn(
+        [node("Conv", ["x", "w", "b"], ["y"], kernel_shape=[3, 3],
+              strides=[stride, stride], pads=[pad, pad, pad, pad])],
+        [value_info("x", np.float32, list(x.shape))],
+        [value_info("y", np.float32, None)],
+        {"w": w, "b": b},
+    )
+    out = np.asarray(fn({"x": x})["y"])
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_grouped_conv_matches_torch():
+    import torch
+
+    rng = np.random.default_rng(2)
+    x = rng.normal(size=(1, 4, 8, 8)).astype(np.float32)
+    w = rng.normal(size=(8, 2, 3, 3)).astype(np.float32)  # groups=2
+    ref = torch.nn.functional.conv2d(torch.tensor(x), torch.tensor(w), groups=2, padding=1).numpy()
+    fn = build_fn(
+        [node("Conv", ["x", "w"], ["y"], kernel_shape=[3, 3], pads=[1, 1, 1, 1], group=2)],
+        [value_info("x", np.float32, list(x.shape))],
+        [value_info("y", np.float32, None)],
+        {"w": w},
+    )
+    np.testing.assert_allclose(np.asarray(fn({"x": x})["y"]), ref, rtol=1e-4, atol=1e-4)
+
+
+def test_maxpool_avgpool_match_torch():
+    import torch
+
+    rng = np.random.default_rng(3)
+    x = rng.normal(size=(2, 3, 9, 9)).astype(np.float32)
+    tx = torch.tensor(x)
+    ref_max = torch.nn.functional.max_pool2d(tx, 3, stride=2, padding=1).numpy()
+    ref_avg = torch.nn.functional.avg_pool2d(tx, 2, stride=2).numpy()
+    fn = build_fn(
+        [
+            node("MaxPool", ["x"], ["m"], kernel_shape=[3, 3], strides=[2, 2], pads=[1, 1, 1, 1]),
+            node("AveragePool", ["x"], ["a"], kernel_shape=[2, 2], strides=[2, 2]),
+        ],
+        [value_info("x", np.float32, list(x.shape))],
+        [value_info("m", np.float32, None), value_info("a", np.float32, None)],
+    )
+    out = fn({"x": x})
+    np.testing.assert_allclose(np.asarray(out["m"]), ref_max, rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(out["a"]), ref_avg, rtol=1e-5, atol=1e-5)
+
+
+def test_batchnorm_gemm_match_torch():
+    import torch
+
+    rng = np.random.default_rng(4)
+    x = rng.normal(size=(4, 6, 5, 5)).astype(np.float32)
+    scale = rng.normal(size=6).astype(np.float32)
+    bias = rng.normal(size=6).astype(np.float32)
+    mean = rng.normal(size=6).astype(np.float32)
+    var = rng.uniform(0.5, 2.0, size=6).astype(np.float32)
+    ref = torch.nn.functional.batch_norm(
+        torch.tensor(x), torch.tensor(mean), torch.tensor(var),
+        torch.tensor(scale), torch.tensor(bias), eps=1e-5,
+    ).numpy()
+    fn = build_fn(
+        [node("BatchNormalization", ["x", "s", "b", "m", "v"], ["y"], epsilon=1e-5)],
+        [value_info("x", np.float32, list(x.shape))],
+        [value_info("y", np.float32, None)],
+        {"s": scale, "b": bias, "m": mean, "v": var},
+    )
+    np.testing.assert_allclose(np.asarray(fn({"x": x})["y"]), ref, rtol=1e-3, atol=1e-4)
+
+    a = rng.normal(size=(3, 4)).astype(np.float32)
+    w = rng.normal(size=(5, 4)).astype(np.float32)
+    c = rng.normal(size=(5,)).astype(np.float32)
+    fn2 = build_fn(
+        [node("Gemm", ["a", "w", "c"], ["y"], transB=1, alpha=1.0, beta=1.0)],
+        [value_info("a", np.float32, [3, 4])],
+        [value_info("y", np.float32, None)],
+        {"w": w, "c": c},
+    )
+    np.testing.assert_allclose(np.asarray(fn2({"a": a})["y"]), a @ w.T + c, rtol=1e-4, atol=1e-5)
+
+
+def test_layernorm_softmax_match_torch():
+    import torch
+
+    rng = np.random.default_rng(5)
+    x = rng.normal(size=(2, 7, 8)).astype(np.float32)
+    g = rng.normal(size=8).astype(np.float32)
+    b = rng.normal(size=8).astype(np.float32)
+    ref = torch.nn.functional.layer_norm(
+        torch.tensor(x), (8,), torch.tensor(g), torch.tensor(b), eps=1e-5
+    ).numpy()
+    fn = build_fn(
+        [node("LayerNormalization", ["x", "g", "b"], ["y"], axis=-1, epsilon=1e-5),
+         node("Softmax", ["y"], ["p"], axis=-1)],
+        [value_info("x", np.float32, list(x.shape))],
+        [value_info("y", np.float32, None), value_info("p", np.float32, None)],
+        {"g": g, "b": b},
+    )
+    out = fn({"x": x})
+    np.testing.assert_allclose(np.asarray(out["y"]), ref, rtol=1e-3, atol=1e-4)
+    np.testing.assert_allclose(
+        np.asarray(out["p"]), torch.softmax(torch.tensor(ref), -1).numpy(), rtol=1e-3, atol=1e-5
+    )
+
+
+def test_dynamic_shape_chain_constant_folds():
+    """BERT-style Shape->Gather->Concat->Reshape chain must compile (static under jit)."""
+    fn = build_fn(
+        [
+            node("Shape", ["x"], ["shp"]),
+            node("Gather", ["shp", "zero"], ["batch"], axis=0),
+            node("Gather", ["shp", "one"], ["seq"], axis=0),
+            node("Unsqueeze", ["batch", "ax0"], ["b1"]),
+            node("Unsqueeze", ["seq", "ax0"], ["s1"]),
+            node("Concat", ["b1", "s1", "negone"], ["newshape"], axis=0),
+            node("Reshape", ["x", "newshape"], ["y"]),
+        ],
+        [value_info("x", np.float32, [None, None, 2, 3])],
+        [value_info("y", np.float32, None)],
+        {
+            "zero": np.array(0, dtype=np.int64),
+            "one": np.array(1, dtype=np.int64),
+            "ax0": np.array([0], dtype=np.int64),
+            "negone": np.array([-1], dtype=np.int64),
+        },
+    )
+    x = np.arange(2 * 5 * 2 * 3, dtype=np.float32).reshape(2, 5, 2, 3)
+    out = np.asarray(fn({"x": x})["y"])
+    assert out.shape == (2, 5, 6)
+    np.testing.assert_allclose(out, x.reshape(2, 5, 6))
+
+
+def test_slice_split_transpose_ops():
+    fn = build_fn(
+        [
+            node("Transpose", ["x"], ["t"], perm=[1, 0]),
+            node("Slice", ["x", "starts", "ends", "axes"], ["s"]),
+            node("Split", ["x"], ["a", "b"], axis=1, num_outputs=2),
+        ],
+        [value_info("x", np.float32, [4, 6])],
+        [value_info("t", np.float32, None), value_info("s", np.float32, None),
+         value_info("a", np.float32, None), value_info("b", np.float32, None)],
+        {
+            "starts": np.array([1], dtype=np.int64),
+            "ends": np.array([3], dtype=np.int64),
+            "axes": np.array([0], dtype=np.int64),
+        },
+        opset=13,
+    )
+    x = np.arange(24, dtype=np.float32).reshape(4, 6)
+    out = fn({"x": x})
+    np.testing.assert_allclose(np.asarray(out["t"]), x.T)
+    np.testing.assert_allclose(np.asarray(out["s"]), x[1:3])
+    np.testing.assert_allclose(np.asarray(out["a"]), x[:, :3])
+    np.testing.assert_allclose(np.asarray(out["b"]), x[:, 3:])
+
+
+def test_squeeze_axes_attr_pre13_and_input_post13():
+    x = np.zeros((1, 3, 1), dtype=np.float32)
+    fn_old = build_fn(
+        [node("Squeeze", ["x"], ["y"], axes=[0])],
+        [value_info("x", np.float32, [1, 3, 1])],
+        [value_info("y", np.float32, None)],
+        opset=11,
+    )
+    assert np.asarray(fn_old({"x": x})["y"]).shape == (3, 1)
+    fn_new = build_fn(
+        [node("Squeeze", ["x", "axes"], ["y"])],
+        [value_info("x", np.float32, [1, 3, 1])],
+        [value_info("y", np.float32, None)],
+        {"axes": np.array([2], dtype=np.int64)},
+        opset=13,
+    )
+    assert np.asarray(fn_new({"x": x})["y"]).shape == (1, 3)
+
+
+def test_reduce_erf_where_cast():
+    import scipy.special
+
+    x = np.linspace(-2, 2, 12, dtype=np.float32).reshape(3, 4)
+    fn = build_fn(
+        [
+            node("ReduceMean", ["x"], ["m"], axes=[1], keepdims=1),
+            node("Erf", ["x"], ["e"]),
+            node("Cast", ["x"], ["i"], to=7),
+            node("Greater", ["x", "m"], ["g"]),
+            node("Where", ["g", "x", "m"], ["w"]),
+        ],
+        [value_info("x", np.float32, [3, 4])],
+        [value_info(n, np.float32, None) for n in ["m", "e", "i", "g", "w"]],
+        opset=13,
+    )
+    out = fn({"x": x})
+    np.testing.assert_allclose(np.asarray(out["m"]), x.mean(1, keepdims=True), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(out["e"]), scipy.special.erf(x), rtol=1e-4)
+    assert np.asarray(out["i"]).dtype == np.int64 or np.asarray(out["i"]).dtype == np.int32
+
+
+def test_unsupported_op_reported():
+    with pytest.raises(NotImplementedError, match="NotARealOp"):
+        build_fn(
+            [node("NotARealOp", ["x"], ["y"])],
+            [value_info("x", np.float32, [1])],
+            [value_info("y", np.float32, None)],
+        )
+
+
+def test_bfloat16_policy_small_cnn():
+    rng = np.random.default_rng(7)
+    x = rng.normal(size=(2, 3, 8, 8)).astype(np.float32)
+    w = rng.normal(size=(4, 3, 3, 3)).astype(np.float32) * 0.1
+    nodes = [
+        node("Conv", ["x", "w"], ["c"], kernel_shape=[3, 3], pads=[1, 1, 1, 1]),
+        node("Relu", ["c"], ["r"]),
+        node("GlobalAveragePool", ["r"], ["g"]),
+        node("Flatten", ["g"], ["y"]),
+    ]
+    f32 = build_fn(nodes, [value_info("x", np.float32, list(x.shape))],
+                   [value_info("y", np.float32, None)], {"w": w})
+    bf16 = build_fn(nodes, [value_info("x", np.float32, list(x.shape))],
+                    [value_info("y", np.float32, None)], {"w": w}, dtype_policy="bfloat16")
+    a = np.asarray(f32({"x": x})["y"])
+    b = np.asarray(bf16({"x": x})["y"])
+    assert b.dtype == np.float32  # policy casts outputs back
+    np.testing.assert_allclose(a, b, rtol=0.05, atol=0.02)
+
+
+def test_onnx_model_transformer_end_to_end():
+    """Pipeline-level: ONNXModel with feed/fetch/softmax/argmax over a Table."""
+    rng = np.random.default_rng(8)
+    w = rng.normal(size=(4, 3)).astype(np.float32)
+    g = make_graph(
+        [node("MatMul", ["features", "w"], ["logits"])],
+        "clf",
+        [value_info("features", np.float32, [None, 4])],
+        [value_info("logits", np.float32, [None, 3])],
+        {"w": w},
+    )
+    model_bytes = serialize_model(make_model(g))
+    t = Table({"feat": rng.normal(size=(10, 4)).astype(np.float32)})
+    m = ONNXModel(
+        feed_dict={"features": "feat"},
+        fetch_dict={"rawPrediction": "logits"},
+        softmax_dict={"rawPrediction": "probability"},
+        argmax_dict={"rawPrediction": "prediction"},
+        batch_size=4,  # forces pad-to-bucket on the final batch of 2
+    ).set_model(model_bytes)
+    out = m.transform(t)
+    logits = t["feat"] @ w
+    np.testing.assert_allclose(out["rawPrediction"], logits, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(out["probability"].sum(axis=1), np.ones(10), rtol=1e-5)
+    np.testing.assert_array_equal(out["prediction"], logits.argmax(1))
+
+
+def test_onnx_model_save_load(tmp_path):
+    rng = np.random.default_rng(9)
+    w = rng.normal(size=(2, 2)).astype(np.float32)
+    g = make_graph(
+        [node("MatMul", ["x", "w"], ["y"])], "m",
+        [value_info("x", np.float32, [None, 2])], [value_info("y", np.float32, None)],
+        {"w": w},
+    )
+    m = ONNXModel(feed_dict={"x": "c"}, fetch_dict={"out": "y"}).set_model(
+        serialize_model(make_model(g))
+    )
+    t = Table({"c": rng.normal(size=(3, 2)).astype(np.float32)})
+    expected = m.transform(t)["out"]
+    p = str(tmp_path / "onnxstage")
+    m.save(p)
+    from synapseml_tpu.core import load_stage
+
+    m2 = load_stage(p)
+    np.testing.assert_allclose(m2.transform(t)["out"], expected, rtol=1e-6)

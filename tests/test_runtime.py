@@ -83,3 +83,21 @@ def test_psum_over_mesh():
     f = shard_map(local_hist, mesh=mesh, in_specs=P("data"), out_specs=P("data"))
     out = f(x)
     np.testing.assert_allclose(np.asarray(out), np.full(8, 28.0))
+
+
+def test_best_mesh_shape_balanced():
+    assert best_mesh_shape(12, 3) == (3, 2, 2)
+    assert best_mesh_shape(8, 3) == (2, 2, 2)
+    assert best_mesh_shape(64, 2) == (8, 8)
+    assert best_mesh_shape(7, 2) == (7, 1)
+
+
+def test_clear_shared_pool_keeps_locks():
+    from synapseml_tpu.runtime.shared import _key_locks
+
+    clear_shared_pool("t2-")
+    shared_singleton("t2-key", lambda: 1)
+    assert "t2-key" in _key_locks
+    clear_shared_pool("t2-")
+    assert "t2-key" in _key_locks  # lock retained, value cleared
+    assert shared_singleton("t2-key", lambda: 2) == 2

@@ -160,3 +160,41 @@ def test_timer(t):
     out = m.transform(t)
     assert "o" in out
     assert m._last_elapsed_s >= 0
+
+
+def test_stratified_repartition_rare_label_reaches_all_partitions():
+    # regression: random assignment used to leave partitions without the rare label
+    t = Table({"x": np.arange(8.0), "label": np.array([0] * 6 + [1] * 2)}, npartitions=2)
+    for seed in range(5):
+        out = StratifiedRepartition(label_col="label", mode="original", seed=seed).transform(t)
+        for p in out.partitions():
+            assert 1 in p["label"], f"seed {seed}: partition missing rare label"
+
+
+def test_ensemble_by_key_name_length_mismatch():
+    t = Table({"k": [0, 0], "s1": [1.0, 2.0], "s2": [3.0, 4.0]})
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError, match="new_col_names"):
+        EnsembleByKey(keys=["k"], cols=["s1", "s2"], new_col_names=["only_one"]).transform(t)
+
+
+def test_class_balancer_unseen_label_message(t):
+    model = ClassBalancer(input_col="label").fit(t)
+    bad = Table({"label": np.array([0, 99])})
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError, match="not seen during fit"):
+        model.transform(bad)
+
+
+def test_lambda_save_load_drops_callable(tmp_path):
+    from synapseml_tpu.core import load_stage
+
+    t = Table({"x": np.arange(3.0)})
+    lam = Lambda(transform_func=lambda x: x.with_column("y", x["x"] * 2))
+    p = str(tmp_path / "lam")
+    lam.save(p)  # must not raise
+    loaded = load_stage(p)
+    out = loaded.transform(t)  # warns, passes through
+    assert "y" not in out
