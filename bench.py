@@ -1,0 +1,62 @@
+"""Headline benchmark: ONNX ResNet-50 inference throughput, images/sec/chip.
+
+BASELINE.json config #1 (ImageFeaturizer ResNet-50 ONNX). The reference has no
+published TPU numbers (``published: {}``), so ``vs_baseline`` is null.
+
+Prints exactly one JSON line:
+    {"metric": "resnet50_onnx_images_per_sec_per_chip", "value": N,
+     "unit": "images/sec/chip", "vs_baseline": null}
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def main() -> None:
+    import jax
+
+    from synapseml_tpu.models.zoo import build_model_bytes
+    from synapseml_tpu.onnx.importer import OnnxFunction
+
+    fn = OnnxFunction(build_model_bytes("ResNet50"), dtype_policy="bfloat16")
+
+    platform = jax.devices()[0].platform
+    batch = 128 if platform != "cpu" else 16
+    rng = np.random.default_rng(0)
+    # Device-resident input: measures engine throughput, not host-link bandwidth.
+    data = jax.device_put(rng.normal(size=(batch, 3, 224, 224)).astype(np.float32))
+
+    import jax.numpy as jnp
+
+    def run(iters):
+        # Chain every iteration into a device-side accumulator and sync ONCE at
+        # the end — immune to async-dispatch / block_until_ready quirks on
+        # tunneled backends.
+        acc = jnp.zeros(())
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = fn({"data": data})
+            acc = acc + out["logits"].sum()
+        float(acc)
+        return time.perf_counter() - t0
+
+    run(3)  # warmup: model compile + accumulator graph compile
+    iters = 30 if platform != "cpu" else 3
+    dt = run(iters)
+
+    images_per_sec = batch * iters / dt
+    print(json.dumps({
+        "metric": "resnet50_onnx_images_per_sec_per_chip",
+        "value": round(images_per_sec, 2),
+        "unit": "images/sec/chip",
+        "vs_baseline": None,
+    }))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -157,4 +157,11 @@ class ModelDownloader:
         return self.download_model(self.remote.get_schema(name), always_download)
 
     def read_bytes(self, name: str) -> bytes:
-        return self.local.read_bytes(self.download_by_name(name))
+        try:  # cached: single read + hash check
+            return self.local.read_bytes(self.local.get_schema(name))
+        except (KeyError, IOError):
+            pass
+        schema = self.remote.get_schema(name)
+        data = self.remote.read_bytes(schema)
+        self.local.add(schema, data)
+        return data

@@ -100,7 +100,7 @@ def resnet(depth: int = 50, num_classes: int = 1000, seed: int = 0) -> ModelProt
             stride = 2 if (stage_i > 0 and block_i == 0) else 1
             name = f"s{stage_i}b{block_i}"
             cout = width * expansion
-            if block_i == 0:
+            if stride != 1 or c != cout:  # identity shortcut when shapes already match
                 sc, _ = _conv_bn_relu(nodes, w, f"{name}_sc", x, cout, c, 1, stride, 0, relu=False)
             else:
                 sc = x

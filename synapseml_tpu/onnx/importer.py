@@ -74,7 +74,13 @@ class OnnxFunction:
         missing = [n for n in self.input_names if n not in feeds]
         if missing:
             raise ValueError(f"missing feeds {missing}; expected {self.input_names}")
-        args = [np.asarray(feeds[n]) for n in self.input_names]
+        import jax
+
+        # Leave device-resident jax arrays in place; only materialize host data.
+        args = [
+            feeds[n] if isinstance(feeds[n], jax.Array) else np.asarray(feeds[n])
+            for n in self.input_names
+        ]
         outs = self._jit(*args)
         return dict(zip(self.output_names, outs))
 

@@ -83,6 +83,20 @@ class ONNXModel(Transformer):
         """Batched execution with pad-to-bucket; returns full-length outputs."""
         fn = self.fn
         n = len(next(iter(feeds.values())))
+        if n == 0:  # empty partitions are normal in a partitioned pipeline
+            dummy = {}
+            shapes = fn.input_shapes()
+            for k, v in feeds.items():
+                shp = v.shape[1:]
+                if not shp and shapes.get(k) and len(shapes[k]) > 1:
+                    shp = tuple(s if isinstance(s, int) else 1 for s in shapes[k][1:])
+                dt = v.dtype if v.dtype != object else np.float32
+                dummy[k] = np.zeros((1,) + tuple(shp), dtype=dt)
+            result = fn(dummy)
+            return {
+                col: np.asarray(result[name])[:0]
+                for col, name in self.fetch_dict.items()
+            }
         b = min(self.batch_size, max(1, n))
         out_parts: Dict[str, List[np.ndarray]] = {k: [] for k in self.fetch_dict}
         for lo in range(0, n, b):

@@ -178,6 +178,7 @@ def _softmax(inputs, attrs, ctx):
     # pre-13: flatten trailing dims from axis, softmax over the flattened tail
     x = inputs[0]
     shape = x.shape
+    axis = axis % x.ndim  # spec coerces negative axis to axis + rank
     lead = int(np.prod(shape[:axis])) if axis > 0 else 1
     flat = x.reshape(lead, -1)
     return jax.nn.softmax(flat, axis=-1).reshape(shape)
@@ -491,7 +492,9 @@ def _reshape(inputs, attrs, ctx):
 @op("Flatten")
 def _flatten(inputs, attrs, ctx):
     x = inputs[0]
-    axis = attrs.get("axis", 1) % (x.ndim + 1)
+    axis = attrs.get("axis", 1)
+    if axis < 0:
+        axis += x.ndim
     lead = int(np.prod(x.shape[:axis])) if axis else 1
     return jnp.reshape(x, (lead, -1))
 
@@ -662,10 +665,7 @@ def _cast(inputs, attrs, ctx):
         dtype = np.asarray(inputs[1]).dtype if isinstance(inputs[1], np.ndarray) else inputs[1].dtype
     else:
         dtype = DataType.to_numpy(int(attrs["to"]))
-    x = inputs[0]
-    if isinstance(x, np.ndarray):
-        return x.astype(dtype)
-    return x.astype(dtype)
+    return inputs[0].astype(dtype)
 
 
 @op("Where")
@@ -682,7 +682,11 @@ def _onehot(inputs, attrs, ctx):
     axis = attrs.get("axis", -1)
     d = int(_static(depth, "OneHot.depth"))
     off_val, on_val = values[0], values[1]
-    oh = jax.nn.one_hot(jnp.asarray(indices) % d, d, axis=axis)
+    idx = jnp.asarray(indices)
+    # spec: negative indices in [-depth, -1] wrap; anything else is all-off
+    valid = (idx >= -d) & (idx <= d - 1)
+    idx = jnp.where(valid, jnp.where(idx < 0, idx + d, idx), -1)
+    oh = jax.nn.one_hot(idx, d, axis=axis)  # one_hot(-1) -> all zeros
     return oh * (on_val - off_val) + off_val
 
 

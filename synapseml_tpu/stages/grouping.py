@@ -56,8 +56,6 @@ class StratifiedRepartition(Transformer):
         # has >= 1 row per partition's share — the stage's contract.
         P = table.npartitions
         idx_parts: List[np.ndarray] = []
-        part_parts: List[np.ndarray] = []
-        offset = 0
         for u, c in zip(uniq, counts):
             rows = np.nonzero(labels == u)[0]
             want = int(round(fracs[u] * c))
@@ -66,11 +64,8 @@ class StratifiedRepartition(Transformer):
             else:
                 take = np.concatenate([rows, rng.choice(rows, size=want - c, replace=True)])
             idx_parts.append(take)
-            part_parts.append((np.arange(len(take)) + offset) % P)
-            offset += len(take)
         idx = np.concatenate(idx_parts)
-        part_of = np.concatenate(part_parts)
-        order = np.argsort(part_of, kind="stable")
+        order = np.argsort(np.arange(len(idx)) % P, kind="stable")
         return table.take(idx[order])
 
 

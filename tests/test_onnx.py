@@ -5,6 +5,7 @@ of asserting real model predictions — but cross-checks against torch (CPU) sin
 image has no network access for ONNX zoo downloads.
 """
 
+import jax.numpy as jnp
 import numpy as np
 import pytest
 
@@ -351,3 +352,35 @@ def test_onnx_model_save_load(tmp_path):
 
     m2 = load_stage(p)
     np.testing.assert_allclose(m2.transform(t)["out"], expected, rtol=1e-6)
+
+
+def test_flatten_softmax_onehot_edge_cases():
+    """Regression: negative axes and out-of-range indices (ONNX spec corners)."""
+    from synapseml_tpu.onnx.ops import OPS
+
+    out = OPS["Flatten"]([jnp.zeros((2, 3, 4))], {"axis": -1},
+                         {"op_type": "Flatten", "opset": 13})
+    assert out.shape == (6, 4)
+    out = OPS["Softmax"]([jnp.ones((2, 3, 4))], {"axis": -1},
+                         {"op_type": "Softmax", "opset": 11})
+    np.testing.assert_allclose(np.asarray(out).sum(-1), 1.0, rtol=1e-6)
+    # OneHot: -1 wraps to depth-1; 5 is out of [-3, 2] -> all-off row
+    out = OPS["OneHot"]([np.array([5, -1, 2]), np.array(3), np.array([0.0, 1.0])],
+                        {}, {"op_type": "OneHot", "opset": 13})
+    np.testing.assert_allclose(np.asarray(out), [[0, 0, 0], [0, 0, 1], [0, 0, 1]])
+
+
+def test_onnx_model_empty_table():
+    """Empty partitions are normal in a partitioned pipeline; must not crash."""
+    rng = np.random.default_rng(0)
+    w = rng.normal(size=(4, 3)).astype(np.float32)
+    g = make_graph(
+        [node("MatMul", ["x", "w"], ["y"])], "m",
+        [value_info("x", np.float32, ["N", 4])], [value_info("y", np.float32, None)],
+        {"w": w},
+    )
+    m = ONNXModel(feed_dict={"x": "c"}, fetch_dict={"out": "y"}).set_model(
+        serialize_model(make_model(g))
+    )
+    out = m.transform(Table({"c": np.zeros((0, 4), np.float32)}))
+    assert out["out"].shape == (0, 3)
