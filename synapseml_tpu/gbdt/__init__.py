@@ -1,0 +1,40 @@
+"""TPU-native histogram-GBDT engine (the LightGBM-equivalent).
+
+Reference: the ``lightgbm/`` module wraps the LightGBM C++ core over SWIG and
+bootstraps a socket allreduce ring from the driver (``LightGBMBase.scala:399-437``,
+``TrainUtils.scala:237-296``). This engine is a from-scratch TPU design:
+
+- feature binning on the host (``binning.py``, the ``Dataset`` construction analogue
+  of ``dataset/DatasetAggregator.scala``);
+- gradient/hessian histograms as **one-hot matmuls on the MXU** (``histogram.py``) —
+  dense fixed-shape work instead of the reference's per-thread C++ bin scans;
+- leaf-wise tree growth with parent-subtract, fully jit-compiled
+  (``grow.py``, the ``LGBM_BoosterUpdateOneIter`` analogue);
+- distributed training = ``psum`` of histograms over the ``data`` axis of a
+  ``jax.sharding.Mesh`` (``boost.py``), replacing ``LGBM_NetworkInit``'s TCP ring —
+  histograms are dense fixed-size tensors, a natural XLA collective;
+- estimator stages with reference param names (``estimators.py``).
+"""
+
+from .binning import BinMapper
+from .boost import GBDTBooster, train
+from .estimators import (
+    LightGBMClassificationModel,
+    LightGBMClassifier,
+    LightGBMRanker,
+    LightGBMRankerModel,
+    LightGBMRegressionModel,
+    LightGBMRegressor,
+)
+
+__all__ = [
+    "BinMapper",
+    "GBDTBooster",
+    "train",
+    "LightGBMClassifier",
+    "LightGBMClassificationModel",
+    "LightGBMRegressor",
+    "LightGBMRegressionModel",
+    "LightGBMRanker",
+    "LightGBMRankerModel",
+]

@@ -1,0 +1,116 @@
+"""Quantile feature binning — the host-side ``Dataset`` construction step.
+
+Reference analogue: LightGBM's ``BinMapper``/``Dataset`` built through
+``LGBM_DatasetCreateFromMat`` after the chunked marshalling in
+``lightgbm/.../dataset/DatasetAggregator.scala``. Binning runs once on the host in
+numpy (data prep, not MXU work); the binned int matrix is what ships to the TPU.
+
+Bin layout (per feature): bins ``0..n_bins-1`` cover finite values by quantile
+ranges; missing values (NaN) map to the LAST bin (LightGBM's ``use_missing`` default
+puts NaN in its own bin). Split "value <= upper_edge[b]" == "bin <= b"; NaN compares
+false so missing rows follow the right/greater branch.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+__all__ = ["BinMapper"]
+
+
+class BinMapper:
+    """Fit per-feature quantile bin edges; transform float matrices to int8/16 bins."""
+
+    def __init__(self, max_bin: int = 255, sample_cnt: int = 200_000, seed: int = 0):
+        if max_bin < 2:
+            raise ValueError(f"max_bin must be >= 2, got {max_bin}")
+        self.max_bin = int(max_bin)
+        self.sample_cnt = int(sample_cnt)
+        self.seed = seed
+        self.upper_edges: Optional[List[np.ndarray]] = None  # per-feature ascending edges
+        self.n_features: Optional[int] = None
+
+    @property
+    def n_bins(self) -> int:
+        """Total bins per feature including the reserved missing bin."""
+        return self.max_bin + 1
+
+    @property
+    def missing_bin(self) -> int:
+        return self.max_bin
+
+    def fit(self, x: np.ndarray) -> "BinMapper":
+        x = np.asarray(x, dtype=np.float64)
+        n, d = x.shape
+        rng = np.random.default_rng(self.seed)
+        if n > self.sample_cnt:
+            idx = rng.choice(n, size=self.sample_cnt, replace=False)
+            sample = x[idx]
+        else:
+            sample = x
+        edges: List[np.ndarray] = []
+        for j in range(d):
+            col = sample[:, j]
+            col = col[np.isfinite(col)]
+            if col.size == 0:
+                edges.append(np.array([np.inf]))
+                continue
+            uniq = np.unique(col)
+            if len(uniq) <= self.max_bin:
+                # exact: one bin per distinct value; upper edge = midpoint to next
+                ue = np.empty(len(uniq))
+                ue[:-1] = (uniq[:-1] + uniq[1:]) / 2
+                ue[-1] = np.inf
+                edges.append(ue)
+            else:
+                qs = np.quantile(col, np.linspace(0, 1, self.max_bin + 1)[1:-1])
+                ue = np.unique(qs)
+                edges.append(np.concatenate([ue, [np.inf]]))
+        self.upper_edges = edges
+        self.n_features = d
+        return self
+
+    def transform(self, x: np.ndarray) -> np.ndarray:
+        """Float matrix -> int32 bin matrix (NaN -> missing bin)."""
+        if self.upper_edges is None:
+            raise RuntimeError("BinMapper.transform called before fit")
+        x = np.asarray(x, dtype=np.float64)
+        n, d = x.shape
+        if d != self.n_features:
+            raise ValueError(f"expected {self.n_features} features, got {d}")
+        out = np.empty((n, d), dtype=np.int32)
+        for j in range(d):
+            col = x[:, j]
+            out[:, j] = np.searchsorted(self.upper_edges[j], col, side="left")
+            miss = ~np.isfinite(col)
+            # +inf searches past the last edge; clamp, then stamp NaN into its bin
+            np.clip(out[:, j], 0, len(self.upper_edges[j]) - 1, out=out[:, j])
+            if miss.any():
+                out[miss, j] = self.missing_bin
+        return out
+
+    def fit_transform(self, x: np.ndarray) -> np.ndarray:
+        return self.fit(x).transform(x)
+
+    def bin_upper_value(self, feature: int, b: np.ndarray) -> np.ndarray:
+        """Raw-value threshold for split 'bin <= b' (used by tree predict on raw x)."""
+        ue = self.upper_edges[feature]
+        return ue[np.clip(b, 0, len(ue) - 1)]
+
+    def to_dict(self) -> dict:
+        return {
+            "max_bin": self.max_bin,
+            "sample_cnt": self.sample_cnt,
+            "seed": self.seed,
+            "upper_edges": [e.tolist() for e in (self.upper_edges or [])],
+        }
+
+    @staticmethod
+    def from_dict(d: dict) -> "BinMapper":
+        m = BinMapper(max_bin=d["max_bin"], sample_cnt=d["sample_cnt"], seed=d["seed"])
+        if d.get("upper_edges"):
+            m.upper_edges = [np.asarray(e) for e in d["upper_edges"]]
+            m.n_features = len(m.upper_edges)
+        return m

@@ -1,0 +1,28 @@
+"""Distributed-engine entry points used by the driver dryrun.
+
+``dryrun_train_step(mesh, n, d)`` runs one full distributed boosting iteration
+(objective grads -> sharded histograms -> psum -> tree growth -> score update) over
+the given mesh's 'data' axis on tiny synthetic shapes — the multi-chip compile/exec
+validation path for ``__graft_entry__.dryrun_multichip``.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .boost import train
+
+__all__ = ["dryrun_train_step"]
+
+
+def dryrun_train_step(mesh, n: int = 512, d: int = 16) -> None:
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(n, d))
+    y = (x[:, 0] + 0.5 * x[:, 1] > 0).astype(np.float64)
+    booster = train(
+        {"objective": "binary", "num_iterations": 2, "num_leaves": 7,
+         "min_data_in_leaf": 2, "max_bin": 31},
+        x, y, mesh=mesh,
+    )
+    p = booster.predict(x[:8])
+    assert np.all(np.isfinite(p)), "non-finite GBDT dryrun predictions"

@@ -70,6 +70,8 @@ class ONNXModel(Transformer):
     def _gather_feed(self, table: Table, col: str) -> np.ndarray:
         arr = table[col]
         if arr.dtype == object:  # ragged/list column -> stack (must be uniform)
+            if len(arr) == 0:
+                return np.zeros((0,), dtype=np.float32)
             try:
                 arr = np.stack([np.asarray(v) for v in arr])
             except ValueError as e:
@@ -93,10 +95,15 @@ class ONNXModel(Transformer):
                 dt = v.dtype if v.dtype != object else np.float32
                 dummy[k] = np.zeros((1,) + tuple(shp), dtype=dt)
             result = fn(dummy)
-            return {
-                col: np.asarray(result[name])[:0]
-                for col, name in self.fetch_dict.items()
-            }
+            out0 = {}
+            for col, name in self.fetch_dict.items():
+                if name not in result:  # same error as the non-empty path
+                    raise ValueError(
+                        f"ONNXModel({self.uid}): graph has no output {name!r}; "
+                        f"outputs: {list(result)}"
+                    )
+                out0[col] = np.asarray(result[name])[:0]
+            return out0
         b = min(self.batch_size, max(1, n))
         out_parts: Dict[str, List[np.ndarray]] = {k: [] for k in self.fetch_dict}
         for lo in range(0, n, b):

@@ -1,0 +1,328 @@
+"""GBDT engine tests.
+
+Mirrors the reference's LightGBM suite strategy
+(`lightgbm/src/test/.../split1/VerifyLightGBMClassifier.scala`): train/predict across
+objectives and boosting modes, save/load roundtrips, distributed parity, SHAP/leaf
+outputs, continuation, early stopping. Datasets are synthetic (the reference's CSV
+datasets are downloaded by its CI and unavailable offline); accuracy asserts check
+separation quality rather than golden numbers.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from synapseml_tpu.core import Table, load_stage
+from synapseml_tpu.gbdt import (
+    BinMapper,
+    GBDTBooster,
+    LightGBMClassifier,
+    LightGBMRanker,
+    LightGBMRegressor,
+    train,
+)
+from synapseml_tpu.gbdt.boost import METRICS, _metric_ndcg
+from synapseml_tpu.gbdt.grow import TreeConfig, grow_tree, predict_binned
+from synapseml_tpu.gbdt.histogram import histogram, histogram_np
+
+
+@pytest.fixture(scope="module")
+def data():
+    rng = np.random.default_rng(0)
+    n, d = 3000, 8
+    x = rng.normal(size=(n, d))
+    logit = 2 * x[:, 0] - 1.5 * x[:, 1] + x[:, 2] * x[:, 3]
+    y = (logit + rng.normal(scale=0.5, size=n) > 0).astype(float)
+    yr = logit + rng.normal(scale=0.3, size=n)
+    return x, y, yr, logit
+
+
+def _auc(y, p):
+    return METRICS["auc"][0](y, p, np.ones(len(y)))
+
+
+# -- binning -----------------------------------------------------------------------
+
+def test_binning_basic():
+    rng = np.random.default_rng(1)
+    x = rng.normal(size=(500, 3))
+    x[::7, 1] = np.nan
+    m = BinMapper(max_bin=15)
+    b = m.fit_transform(x)
+    assert b.shape == x.shape and b.dtype == np.int32
+    assert b.min() >= 0 and b.max() <= m.missing_bin
+    assert (b[::7, 1] == m.missing_bin).all()
+    # few distinct values -> exact bins, transform is invertible by bin
+    xd = np.repeat(np.arange(5.0), 20)[:, None]
+    md = BinMapper(max_bin=15).fit(xd)
+    bd = md.transform(xd)
+    assert len(np.unique(bd)) == 5
+
+
+def test_binning_roundtrip_dict():
+    x = np.random.default_rng(2).normal(size=(100, 2))
+    m = BinMapper(max_bin=7).fit(x)
+    m2 = BinMapper.from_dict(m.to_dict())
+    np.testing.assert_array_equal(m.transform(x), m2.transform(x))
+
+
+# -- histogram ----------------------------------------------------------------------
+
+def test_histogram_methods_agree():
+    rng = np.random.default_rng(3)
+    n, d, B = 1000, 5, 16
+    binned = rng.integers(0, B, size=(n, d)).astype(np.int32)
+    g = rng.normal(size=n).astype(np.float32)
+    h = rng.uniform(0.1, 1, size=n).astype(np.float32)
+    w = (rng.random(n) < 0.8).astype(np.float32)
+    ref = histogram_np(binned, g, h, w, B)
+    for method in ("scatter", "onehot"):
+        out = np.asarray(histogram(binned, g, h, w, B, method=method, chunk=128))
+        np.testing.assert_allclose(out, ref, rtol=2e-2, atol=2e-2)
+    # scatter is exact in f32
+    out = np.asarray(histogram(binned, g, h, w, B, method="scatter"))
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
+
+
+# -- growth -------------------------------------------------------------------------
+
+def test_grow_tree_separates_and_replays(data):
+    x, y, _, _ = data
+    m = BinMapper(max_bin=63)
+    binned = m.fit_transform(x)
+    prob = np.full(len(y), 0.5, np.float32)
+    grad = (prob - y).astype(np.float32)
+    hess = (prob * (1 - prob)).astype(np.float32)
+    cfg = TreeConfig(n_bins=m.n_bins, num_leaves=8, min_data_in_leaf=5,
+                     hist_method="scatter")
+    import jax.numpy as jnp
+
+    tree, node = grow_tree(jnp.asarray(binned), jnp.asarray(grad), jnp.asarray(hess),
+                           jnp.ones(len(y), jnp.float32),
+                           jnp.ones(x.shape[1], jnp.float32), cfg)
+    node2 = np.asarray(predict_binned(tree, jnp.asarray(binned)))
+    np.testing.assert_array_equal(node2, np.asarray(node))
+    score = np.asarray(tree.leaf_value)[node2]
+    assert _auc(y, score) > 0.9
+
+
+# -- training: objectives & modes ---------------------------------------------------
+
+def test_train_binary(data):
+    x, y, _, _ = data
+    b = train({"objective": "binary", "num_iterations": 40, "num_leaves": 15,
+               "min_data_in_leaf": 5}, x[:2400], y[:2400])
+    assert _auc(y[2400:], b.predict(x[2400:])) > 0.92
+
+
+def test_train_regression(data):
+    x, _, yr, _ = data
+    b = train({"objective": "regression", "num_iterations": 60, "num_leaves": 31},
+              x[:2400], yr[:2400])
+    rmse = np.sqrt(np.mean((b.predict(x[2400:]) - yr[2400:]) ** 2))
+    assert rmse < 0.5 * np.std(yr[2400:])
+
+
+def test_train_multiclass(data):
+    x, _, _, logit = data
+    ym = np.digitize(logit, [-1.5, 1.5]).astype(float)
+    b = train({"objective": "multiclass", "num_class": 3, "num_iterations": 30,
+               "num_leaves": 15}, x[:2400], ym[:2400])
+    p = b.predict(x[2400:])
+    assert p.shape == (600, 3)
+    np.testing.assert_allclose(p.sum(1), 1.0, rtol=1e-5)
+    assert (p.argmax(1) == ym[2400:]).mean() > 0.78
+
+
+@pytest.mark.parametrize("mode", ["goss", "dart", "rf"])
+def test_boosting_modes(data, mode):
+    x, y, _, _ = data
+    b = train({"objective": "binary", "num_iterations": 30, "num_leaves": 15,
+               "boosting": mode, "min_data_in_leaf": 5,
+               "bagging_fraction": 0.8, "bagging_freq": 1}, x[:2400], y[:2400])
+    assert _auc(y[2400:], b.predict(x[2400:])) > 0.88, mode
+
+
+@pytest.mark.parametrize("objective", ["l1", "huber", "quantile", "poisson", "tweedie"])
+def test_regression_objectives(data, objective):
+    x, _, yr, _ = data
+    target = np.exp(yr / 4) if objective in ("poisson", "tweedie") else yr
+    b = train({"objective": objective, "num_iterations": 40, "num_leaves": 15,
+               "alpha": 0.5}, x[:2400], target[:2400])
+    pred = b.predict(x[2400:])
+    base = np.full_like(target[2400:], np.median(target[:2400]))
+    assert np.abs(pred - target[2400:]).mean() < np.abs(base - target[2400:]).mean()
+
+
+def test_custom_fobj(data):
+    x, y, _, _ = data
+
+    def fobj(score, yv, w):
+        import jax.numpy as jnp
+
+        p = 1 / (1 + jnp.exp(-score))
+        return (p - yv) * w, p * (1 - p) * w
+
+    b = train({"objective": "binary", "num_iterations": 20, "num_leaves": 15},
+              x[:2400], y[:2400], fobj=fobj)
+    assert _auc(y[2400:], b.predict(x[2400:])) > 0.9
+
+
+def test_early_stopping(data):
+    x, y, _, _ = data
+    b = train({"objective": "binary", "num_iterations": 200, "num_leaves": 15,
+               "early_stopping_round": 5, "metric": "auc"},
+              x[:2400], y[:2400], eval_set=[(x[2400:], y[2400:])])
+    assert b.num_trees < 200
+    assert b.best_iteration is not None and b.best_iteration <= b.num_trees
+
+
+def test_continued_training(data):
+    x, y, _, _ = data
+    b1 = train({"objective": "binary", "num_iterations": 20, "num_leaves": 15},
+               x[:2400], y[:2400])
+    b2 = train({"objective": "binary", "num_iterations": 10, "num_leaves": 15},
+               x[:2400], y[:2400], init_booster=b1)
+    assert b2.num_trees == 30
+    assert _auc(y[2400:], b2.predict(x[2400:])) >= _auc(y[2400:], b1.predict(x[2400:])) - 0.01
+
+
+# -- distributed --------------------------------------------------------------------
+
+def test_distributed_matches_single_device(data, eight_device_mesh):
+    from jax.sharding import Mesh
+
+    x, y, _, _ = data
+    devs = np.asarray(jax.devices()[:8])
+    mesh = Mesh(devs, ("data",))
+    params = {"objective": "binary", "num_iterations": 15, "num_leaves": 15,
+              "min_data_in_leaf": 5}
+    bd = train(params, x[:2400], y[:2400], mesh=mesh)
+    b1 = train(params, x[:2400], y[:2400])
+    # split decisions may differ on near-ties (f32 reduction order differs between
+    # the sharded psum and the single-device scan) but must agree overwhelmingly
+    agree = (bd.feature == b1.feature).mean()
+    assert agree > 0.95, f"split agreement {agree}"
+    pd_, p1 = bd.predict(x[2400:]), b1.predict(x[2400:])
+    assert np.corrcoef(pd_, p1)[0, 1] > 0.999
+
+
+def test_lambdarank():
+    rng = np.random.default_rng(5)
+    Q, d = 100, 6
+    sizes = rng.integers(5, 15, size=Q)
+    n = int(sizes.sum())
+    x = rng.normal(size=(n, d))
+    score = 1.5 * x[:, 0] + x[:, 1]
+    y = np.zeros(n)
+    start = 0
+    for sz in sizes:
+        seg = score[start:start + sz]
+        y[start:start + sz] = np.digitize(seg, np.quantile(seg, [0.5, 0.8]))
+        start += sz
+    b = train({"objective": "lambdarank", "num_iterations": 30, "num_leaves": 15,
+               "min_data_in_leaf": 3}, x, y, group=sizes)
+    ndcg = _metric_ndcg(10)(y, b.predict(x), None, sizes)
+    assert ndcg > 0.9
+
+
+# -- booster surface ----------------------------------------------------------------
+
+def test_booster_json_roundtrip(data):
+    x, y, _, _ = data
+    b = train({"objective": "binary", "num_iterations": 10, "num_leaves": 7},
+              x[:1000], y[:1000])
+    b2 = GBDTBooster.from_json(b.to_json())
+    np.testing.assert_allclose(b2.predict(x[:100]), b.predict(x[:100]), rtol=1e-6)
+
+
+def test_contrib_sums_to_raw(data):
+    x, y, _, _ = data
+    b = train({"objective": "binary", "num_iterations": 10, "num_leaves": 7},
+              x[:1000], y[:1000])
+    contrib = b.predict_contrib(x[:20])
+    np.testing.assert_allclose(contrib.sum(1), b.raw_predict(x[:20]), atol=1e-6)
+
+
+def test_feature_importance(data):
+    x, y, _, _ = data
+    b = train({"objective": "binary", "num_iterations": 20, "num_leaves": 15,
+               "min_data_in_leaf": 5}, x[:2400], y[:2400])
+    for kind in ("split", "gain"):
+        imp = b.feature_importance(kind)
+        assert imp.shape == (x.shape[1],)
+        # x0 and x1 carry the signal; one of them must dominate noise features
+        assert imp[:2].max() > imp[4:].max()
+
+
+def test_predict_leaf_shape(data):
+    x, y, _, _ = data
+    b = train({"objective": "binary", "num_iterations": 5, "num_leaves": 7},
+              x[:500], y[:500])
+    leaves = b.predict_leaf(x[:10])
+    assert leaves.shape == (10, 5)
+    assert (leaves >= 0).all() and (leaves < 7).all()
+
+
+# -- estimator stages ---------------------------------------------------------------
+
+def test_classifier_stage_string_labels(data, tmp_path):
+    x, y, _, _ = data
+    t = Table({"features": x, "label": np.where(y > 0, "cat", "dog")})
+    clf = LightGBMClassifier(num_iterations=30, num_leaves=15, min_data_in_leaf=5,
+                             leaf_prediction_col="leaves", features_shap_col="shap")
+    m = clf.fit(t)
+    out = m.transform(t)
+    assert set(out.column_names) >= {"prediction", "probability", "rawPrediction",
+                                     "leaves", "shap"}
+    assert (out["prediction"] == t["label"]).mean() > 0.9
+    assert out["shap"].shape == (len(y), x.shape[1] + 1)
+    p = str(tmp_path / "clf_model")
+    m.save(p)
+    m2 = load_stage(p)
+    np.testing.assert_array_equal(m2.transform(t)["prediction"], out["prediction"])
+
+
+def test_classifier_validation_early_stop(data):
+    x, y, _, _ = data
+    val = np.zeros(len(y), bool)
+    val[2400:] = True
+    t = Table({"features": x, "label": y, "isVal": val})
+    clf = LightGBMClassifier(num_iterations=200, num_leaves=15,
+                             validation_indicator_col="isVal",
+                             early_stopping_round=5)
+    m = clf.fit(t)
+    assert m.booster.num_trees < 200
+
+
+def test_regressor_stage(data):
+    x, _, yr, _ = data
+    t = Table({"features": x, "label": yr})
+    m = LightGBMRegressor(num_iterations=40, num_leaves=31).fit(t)
+    rmse = np.sqrt(np.mean((m.transform(t)["prediction"] - yr) ** 2))
+    assert rmse < 0.4 * np.std(yr)
+    assert m.get_feature_importances("gain").shape == (x.shape[1],)
+
+
+def test_ranker_stage(data):
+    x, _, _, logit = data
+    rng = np.random.default_rng(7)
+    gid = rng.integers(0, 80, size=len(x))
+    rel = np.digitize(logit, np.quantile(logit, [0.5, 0.8])).astype(float)
+    t = Table({"features": x, "label": rel, "group": gid})
+    m = LightGBMRanker(num_iterations=15, num_leaves=15, min_data_in_leaf=3).fit(t)
+    out = m.transform(t)
+    assert np.corrcoef(out["prediction"], rel)[0, 1] > 0.5
+
+
+def test_native_model_string(data, tmp_path):
+    x, y, _, _ = data
+    t = Table({"features": x[:500], "label": y[:500]})
+    m = LightGBMClassifier(num_iterations=5, num_leaves=7).fit(t)
+    path = str(tmp_path / "model.txt")
+    m.save_native_model(path)
+    b = GBDTBooster.from_json(open(path).read())
+    np.testing.assert_allclose(b.predict(x[:50]),
+                               np.asarray(m.transform(Table({"features": x[:50]}))
+                                          ["probability"])[:, 1], rtol=1e-5)
