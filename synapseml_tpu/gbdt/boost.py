@@ -685,17 +685,20 @@ def train(params: Dict[str, Any], x: np.ndarray, y: np.ndarray,
     top_rate, other_rate = float(p["top_rate"]), float(p["other_rate"])
 
     # -- the jitted per-iteration step --------------------------------------------
-    def make_weights(key, grad_abs, w):
+    def make_weights(key, grad_abs, n_rows):
+        """Bagging/GOSS row mask. Starts from ones: sample weights already live in
+        the objective's grad/hess (multiplying again would square them)."""
+        ones = jnp.ones(n_rows, jnp.float32)
         if use_goss:
             cut = jnp.quantile(grad_abs, 1.0 - top_rate)
             is_top = grad_abs >= cut
             keep_small = jax.random.uniform(key, grad_abs.shape) < (other_rate / max(1e-12, 1.0 - top_rate))
             amp = (1.0 - top_rate) / max(other_rate, 1e-12)
-            return w * jnp.where(is_top, 1.0, jnp.where(keep_small, amp, 0.0))
-        if bf < 1.0 and (bfreq > 0 or boosting == "rf"):
+            return jnp.where(is_top, 1.0, jnp.where(keep_small, amp, 0.0))
+        if bf < 1.0 and bfreq > 0:
             keep = jax.random.uniform(key, grad_abs.shape) < bf
-            return w * keep.astype(w.dtype)
-        return w
+            return keep.astype(jnp.float32)
+        return ones
 
     axis_name = axis if mesh is not None else None
 
@@ -718,7 +721,7 @@ def train(params: Dict[str, Any], x: np.ndarray, y: np.ndarray,
         # never mask every feature
         fmask = jnp.where(fmask.sum() == 0, jnp.ones((d,), jnp.float32), fmask)
 
-        bw = make_weights(key, jnp.abs(g).sum(axis=1), wv.astype(jnp.float32))
+        bw = make_weights(key, jnp.abs(g).sum(axis=1), g.shape[0])
 
         def grow_c(gc, hc):
             return grow_tree(binned, gc, hc, bw, fmask, cfg, axis_name=axis_name)
@@ -740,7 +743,7 @@ def train(params: Dict[str, Any], x: np.ndarray, y: np.ndarray,
 
     if mesh is not None:
         from jax.sharding import NamedSharding, PartitionSpec as Pspec
-        from jax.experimental.shard_map import shard_map
+        from jax import shard_map
 
         n_shards = mesh.shape[axis]
         pad = (-n) % n_shards
@@ -762,7 +765,7 @@ def train(params: Dict[str, Any], x: np.ndarray, y: np.ndarray,
             sharded_iter, mesh=mesh,
             in_specs=(data_spec, data_spec, data_spec, data_spec, rep, rep),
             out_specs=(rep, data_spec),
-            check_rep=False,
+            check_vma=False,
         ))
         dev_put = lambda a, spec: jax.device_put(a, NamedSharding(mesh, spec))
         binned_d = dev_put(binned_np.astype(np.int32), data_spec)
@@ -786,6 +789,9 @@ def train(params: Dict[str, Any], x: np.ndarray, y: np.ndarray,
             raise ValueError("lambdarank eval_set requires matching eval_group")
     else:
         metric_name = p["metric"] or _DEFAULT_METRIC.get(obj_name, "l2")
+        if metric_name not in METRICS:
+            raise ValueError(f"unknown metric {metric_name!r}; "
+                             f"available: {sorted(METRICS)}")
         metric_fn, higher_better = METRICS[metric_name]
     evals: List[Dict[str, Any]] = []
     eval_binned = []
@@ -829,7 +835,10 @@ def train(params: Dict[str, Any], x: np.ndarray, y: np.ndarray,
 
     for it in range(num_iter):
         key, k2 = jax.random.split(key)
-        bkey, k1 = jax.random.split(bkey)
+        # LightGBM re-bags every bagging_freq iterations and reuses the bag
+        # in between; GOSS resamples every iteration
+        period = it if use_goss else (it // max(bfreq, 1))
+        k1 = jax.random.fold_in(bkey, period)
 
         dart_dropped: List[int] = []
         if boosting == "dart" and trees_host and rng.random() >= dart_skip:

@@ -168,9 +168,12 @@ class _LightGBMBase(Estimator):
             # reference batch training: model of batch k seeds batch k+1
             # (``LightGBMBase.scala:46-61``)
             total = int(params["num_iterations"])
-            per = max(1, total // n_batches)
+            base_per, rem = divmod(total, n_batches)
             booster = None
             for b in range(n_batches):
+                per = base_per + (1 if b < rem else 0)
+                if per == 0:
+                    continue
                 lo = b * len(x) // n_batches
                 hi = (b + 1) * len(x) // n_batches
                 params_b = dict(params, num_iterations=per)

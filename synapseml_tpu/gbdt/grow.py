@@ -25,7 +25,7 @@ import numpy as np
 
 from .histogram import histogram
 
-__all__ = ["TreeConfig", "GrownTree", "grow_tree", "predict_binned", "predict_raw_np"]
+__all__ = ["TreeConfig", "GrownTree", "grow_tree", "predict_binned"]
 
 
 class TreeConfig(NamedTuple):
@@ -168,22 +168,3 @@ def predict_binned(tree: GrownTree, binned):
         go_right = (node == p) & (col > tree.bin[s]) & (p >= 0)
         node = jnp.where(go_right, s + 1, node)
     return node
-
-
-def predict_raw_np(parent, feature, threshold, leaf_value, x: np.ndarray) -> np.ndarray:
-    """Host replay over RAW feature values with real-valued thresholds.
-
-    NaN follows the right/greater branch (the missing bin is the top bin; see
-    ``binning.py``).
-    """
-    n = x.shape[0]
-    node = np.zeros(n, dtype=np.int32)
-    for s in range(len(parent)):
-        p = parent[s]
-        if p < 0:
-            continue
-        col = x[:, feature[s]]
-        with np.errstate(invalid="ignore"):
-            go_right = (node == p) & ((col > threshold[s]) | np.isnan(col))
-        node[go_right] = s + 1
-    return leaf_value[node]

@@ -1,0 +1,13 @@
+"""Native (C++) kernel library + loader.
+
+Rebuild of the reference's native-library mechanism: ``NativeLoader``
+(``core/src/main/java/.../core/env/NativeLoader.java`` extracts ``.so`` files from
+the jar and ``System.load``s them). Here the shared object is built once per
+machine from the checked-in C++ sources (``python -m synapseml_tpu.native.build``)
+and loaded with ctypes; every consumer has a pure-numpy fallback so the framework
+works (slower) without the toolchain.
+"""
+
+from .loader import NativeLib, get_lib, murmur3_32, murmur3_32_batch
+
+__all__ = ["NativeLib", "get_lib", "murmur3_32", "murmur3_32_batch"]

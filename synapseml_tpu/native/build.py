@@ -1,0 +1,33 @@
+"""Build the native shared library: ``python -m synapseml_tpu.native.build``.
+
+Compiles ``src/*.cpp`` into ``_smt_native.so`` next to this file with g++ (the
+image's baked-in toolchain; no pybind11 — the ABI is plain C via ctypes).
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+SRC_DIR = os.path.join(HERE, "src")
+OUT = os.path.join(HERE, "_smt_native.so")
+
+
+def build(verbose: bool = True) -> str:
+    sources = sorted(
+        os.path.join(SRC_DIR, f) for f in os.listdir(SRC_DIR) if f.endswith(".cpp")
+    )
+    cmd = [
+        "g++", "-O3", "-fPIC", "-shared", "-std=c++17", "-march=native",
+        *sources, "-o", OUT,
+    ]
+    if verbose:
+        print(" ".join(cmd), file=sys.stderr)
+    subprocess.run(cmd, check=True)
+    return OUT
+
+
+if __name__ == "__main__":
+    build()

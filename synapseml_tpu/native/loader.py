@@ -1,0 +1,142 @@
+"""ctypes loader + numpy fallbacks for the native kernels.
+
+The loader auto-builds the ``.so`` on first use when a toolchain is present
+(reference ``NativeLoader`` extracts-and-loads per JVM; here it is build-and-load
+per machine, cached on disk). All entry points are also implemented in pure
+numpy so the package never hard-requires the native path — parity between the two
+is asserted by tests.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import threading
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+_logger = logging.getLogger("synapseml_tpu.native")
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SO_PATH = os.path.join(_HERE, "_smt_native.so")
+
+
+class NativeLib:
+    """Lazily built+loaded handle to ``_smt_native.so``."""
+
+    _instance: Optional["NativeLib"] = None
+    _lock = threading.Lock()
+
+    def __init__(self, cdll):
+        self.cdll = cdll
+        self.cdll.smt_murmur3_32.restype = ctypes.c_uint32
+        self.cdll.smt_murmur3_32.argtypes = [
+            ctypes.c_char_p, ctypes.c_int64, ctypes.c_uint32,
+        ]
+
+    @classmethod
+    def load(cls) -> Optional["NativeLib"]:
+        with cls._lock:
+            if cls._instance is not None:
+                return cls._instance
+            if not os.path.exists(_SO_PATH):
+                try:
+                    from .build import build
+
+                    build(verbose=False)
+                except Exception as e:  # no toolchain / build failure -> fallback
+                    _logger.info("native build unavailable (%s); using numpy fallback", e)
+                    return None
+            try:
+                cls._instance = NativeLib(ctypes.CDLL(_SO_PATH))
+            except OSError as e:
+                _logger.warning("failed to load %s (%s); using numpy fallback", _SO_PATH, e)
+                return None
+            return cls._instance
+
+
+def get_lib() -> Optional[NativeLib]:
+    return NativeLib.load()
+
+
+# -- murmur3 -----------------------------------------------------------------------
+
+def _murmur3_32_py(data: bytes, seed: int) -> int:
+    """Pure-python MurmurHash3 x86/32 (bit-exact with the C++ kernel)."""
+    c1, c2 = 0xCC9E2D51, 0x1B873593
+    h = seed & 0xFFFFFFFF
+    length = len(data)
+    rounded = length & ~3
+    for i in range(0, rounded, 4):
+        k = int.from_bytes(data[i:i + 4], "little")
+        k = (k * c1) & 0xFFFFFFFF
+        k = ((k << 15) | (k >> 17)) & 0xFFFFFFFF
+        k = (k * c2) & 0xFFFFFFFF
+        h ^= k
+        h = ((h << 13) | (h >> 19)) & 0xFFFFFFFF
+        h = (h * 5 + 0xE6546B64) & 0xFFFFFFFF
+    k = 0
+    tail = length & 3
+    if tail >= 3:
+        k ^= data[rounded + 2] << 16
+    if tail >= 2:
+        k ^= data[rounded + 1] << 8
+    if tail >= 1:
+        k ^= data[rounded]
+        k = (k * c1) & 0xFFFFFFFF
+        k = ((k << 15) | (k >> 17)) & 0xFFFFFFFF
+        k = (k * c2) & 0xFFFFFFFF
+        h ^= k
+    h ^= length
+    h ^= h >> 16
+    h = (h * 0x85EBCA6B) & 0xFFFFFFFF
+    h ^= h >> 13
+    h = (h * 0xC2B2AE35) & 0xFFFFFFFF
+    h ^= h >> 16
+    return h
+
+
+def murmur3_32(data, seed: int = 0) -> int:
+    """Hash one string/bytes value."""
+    if isinstance(data, str):
+        data = data.encode("utf-8")
+    lib = get_lib()
+    if lib is not None:
+        return int(lib.cdll.smt_murmur3_32(data, len(data), seed & 0xFFFFFFFF))
+    return _murmur3_32_py(data, seed)
+
+
+def murmur3_32_batch(strings: Sequence, seeds=0) -> np.ndarray:
+    """Hash a sequence of strings -> uint32 array. ``seeds``: scalar or per-string."""
+    enc: List[bytes] = [
+        s if isinstance(s, bytes) else str(s).encode("utf-8") for s in strings
+    ]
+    n = len(enc)
+    per_seed = not np.isscalar(seeds)
+    lib = get_lib()
+    if lib is None:
+        if per_seed:
+            return np.array(
+                [_murmur3_32_py(b, int(s)) for b, s in zip(enc, seeds)], dtype=np.uint32
+            )
+        return np.array([_murmur3_32_py(b, int(seeds)) for b in enc], dtype=np.uint32)
+    buf = b"".join(enc)
+    offsets = np.zeros(n + 1, dtype=np.int64)
+    np.cumsum([len(b) for b in enc], out=offsets[1:])
+    out = np.empty(n, dtype=np.uint32)
+    if per_seed:
+        seed_arr = np.asarray(seeds, dtype=np.uint32)
+        lib.cdll.smt_murmur3_32_batch_seeded(
+            buf, offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), n,
+            seed_arr.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+        )
+    else:
+        lib.cdll.smt_murmur3_32_batch(
+            buf, offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), n,
+            ctypes.c_uint32(int(seeds) & 0xFFFFFFFF),
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+        )
+    return out

@@ -1,0 +1,77 @@
+// MurmurHash3 x86 32-bit + batch entry points for the VW-style featurizer.
+//
+// The reference ships VW's C++ core (vw-jni) whose feature hashing is murmur3
+// (`vw/.../VowpalWabbitMurmurWithPrefix.scala` wraps it on the Scala side). This is
+// a from-scratch implementation of the public MurmurHash3 algorithm (Austin Appleby,
+// public domain) with a batch API: one contiguous UTF-8 buffer + offsets in, uint32
+// hashes out. Loaded via ctypes (no pybind11 in the image).
+
+#include <cstdint>
+#include <cstring>
+
+static inline uint32_t rotl32(uint32_t x, int8_t r) {
+  return (x << r) | (x >> (32 - r));
+}
+
+static inline uint32_t fmix32(uint32_t h) {
+  h ^= h >> 16;
+  h *= 0x85ebca6b;
+  h ^= h >> 13;
+  h *= 0xc2b2ae35;
+  h ^= h >> 16;
+  return h;
+}
+
+extern "C" {
+
+uint32_t smt_murmur3_32(const uint8_t* data, int64_t len, uint32_t seed) {
+  const int64_t nblocks = len / 4;
+  uint32_t h1 = seed;
+  const uint32_t c1 = 0xcc9e2d51;
+  const uint32_t c2 = 0x1b873593;
+
+  for (int64_t i = 0; i < nblocks; i++) {
+    uint32_t k1;
+    std::memcpy(&k1, data + i * 4, 4);
+    k1 *= c1;
+    k1 = rotl32(k1, 15);
+    k1 *= c2;
+    h1 ^= k1;
+    h1 = rotl32(h1, 13);
+    h1 = h1 * 5 + 0xe6546b64;
+  }
+
+  const uint8_t* tail = data + nblocks * 4;
+  uint32_t k1 = 0;
+  switch (len & 3) {
+    case 3: k1 ^= tail[2] << 16; [[fallthrough]];
+    case 2: k1 ^= tail[1] << 8; [[fallthrough]];
+    case 1:
+      k1 ^= tail[0];
+      k1 *= c1;
+      k1 = rotl32(k1, 15);
+      k1 *= c2;
+      h1 ^= k1;
+  }
+
+  h1 ^= (uint32_t)len;
+  return fmix32(h1);
+}
+
+// Batch: buf holds n concatenated byte strings; offsets has n+1 entries.
+void smt_murmur3_32_batch(const uint8_t* buf, const int64_t* offsets, int64_t n,
+                          uint32_t seed, uint32_t* out) {
+  for (int64_t i = 0; i < n; i++) {
+    out[i] = smt_murmur3_32(buf + offsets[i], offsets[i + 1] - offsets[i], seed);
+  }
+}
+
+// Batch with per-string seeds (namespace-seeded hashing).
+void smt_murmur3_32_batch_seeded(const uint8_t* buf, const int64_t* offsets,
+                                 int64_t n, const uint32_t* seeds, uint32_t* out) {
+  for (int64_t i = 0; i < n; i++) {
+    out[i] = smt_murmur3_32(buf + offsets[i], offsets[i + 1] - offsets[i], seeds[i]);
+  }
+}
+
+}  // extern "C"

@@ -1,0 +1,40 @@
+"""TPU-native online linear engine (the Vowpal-Wabbit equivalent).
+
+Reference: the ``vw/`` module wraps VW's C++ core over JNI — murmur feature hashing
+into namespaces (``vw/.../featurizer/*.scala``), online SGD with adaptive learning
+rates, spanning-tree AllReduce weight averaging at pass boundaries
+(``VowpalWabbitBase.scala:432-460``). TPU design:
+
+- hashing in the native C++ kernel library (``synapseml_tpu/native``), batch API;
+- the learner is minibatched AdaGrad-SGD over a dense 2^b weight vector, jit-compiled
+  (``learner.py``) — the strictly-serial online loop of VW is hostile to an
+  accelerator; minibatching keeps the math (adaptive per-coordinate rates, importance
+  weights) while vectorizing;
+- distributed: each mesh shard passes over its rows, weights are ``pmean``-averaged
+  across the 'data' axis at pass boundaries — exactly VW's AllReduce-per-pass
+  semantics without the rendezvous server.
+"""
+
+from .estimators import (
+    VowpalWabbitClassificationModel,
+    VowpalWabbitClassifier,
+    VowpalWabbitContextualBandit,
+    VowpalWabbitContextualBanditModel,
+    VowpalWabbitRegressionModel,
+    VowpalWabbitRegressor,
+)
+from .featurizer import VowpalWabbitFeaturizer, VowpalWabbitInteractions
+from .learner import LinearLearnerState, train_linear
+
+__all__ = [
+    "VowpalWabbitFeaturizer",
+    "VowpalWabbitInteractions",
+    "VowpalWabbitClassifier",
+    "VowpalWabbitClassificationModel",
+    "VowpalWabbitRegressor",
+    "VowpalWabbitRegressionModel",
+    "VowpalWabbitContextualBandit",
+    "VowpalWabbitContextualBanditModel",
+    "LinearLearnerState",
+    "train_linear",
+]

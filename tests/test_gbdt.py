@@ -326,3 +326,42 @@ def test_native_model_string(data, tmp_path):
     np.testing.assert_allclose(b.predict(x[:50]),
                                np.asarray(m.transform(Table({"features": x[:50]}))
                                           ["probability"])[:, 1], rtol=1e-5)
+
+
+def test_sample_weights_not_squared():
+    """Regression: weights must enter grads once, not again via histograms."""
+    rng = np.random.default_rng(11)
+    n = 800
+    x = rng.normal(size=(n, 2))
+    y = np.where(x[:, 0] > 0, 10.0, 0.0)
+    w = np.where(y > 5, 9.0, 1.0)
+    b = train({"objective": "regression", "num_iterations": 30, "num_leaves": 2,
+               "min_data_in_leaf": 5, "learning_rate": 0.3}, x, y, weight=w)
+    # with a depth-1 tree the model should converge near the weighted leaf means;
+    # check global weighted mean reproduced through base + trees on each side
+    pred_hi = b.predict(x[y > 5][:5])
+    pred_lo = b.predict(x[y <= 5][:5])
+    assert np.all(np.abs(pred_hi - 10.0) < 0.5), pred_hi
+    assert np.all(np.abs(pred_lo - 0.0) < 0.5), pred_lo
+
+
+def test_bagging_freq_reuses_bag():
+    """bagging_freq=k reuses the same bag for k iterations (LightGBM semantics)."""
+    rng = np.random.default_rng(12)
+    x = rng.normal(size=(500, 4))
+    y = (x[:, 0] > 0).astype(float)
+    params = {"objective": "binary", "num_iterations": 6, "num_leaves": 7,
+              "bagging_fraction": 0.5, "bagging_freq": 6, "min_data_in_leaf": 2}
+    b = train(params, x, y)
+    # same bag for all 6 iters + deterministic growth -> trees 0..5 split on the
+    # same feature set drawn from one subsample; just assert training succeeded
+    # and is deterministic across runs
+    b2 = train(params, x, y)
+    np.testing.assert_array_equal(b.feature, b2.feature)
+
+
+def test_unknown_metric_raises():
+    x = np.zeros((10, 2))
+    y = np.zeros(10)
+    with pytest.raises(ValueError, match="unknown metric"):
+        train({"objective": "binary", "metric": "acu", "num_iterations": 1}, x, y)

@@ -72,7 +72,7 @@ def test_psum_over_mesh():
     import jax
     import jax.numpy as jnp
     from jax.sharding import NamedSharding, PartitionSpec as P
-    from jax.experimental.shard_map import shard_map
+    from jax import shard_map
 
     mesh = make_mesh(("data",))
     x = jnp.arange(8.0)
