@@ -1,0 +1,7 @@
+"""Cognitive-service transformers (reference ``cognitive/`` module, SURVEY.md §2.4)."""
+
+from .base import CognitiveServiceBase
+from .services import *  # noqa: F401,F403
+from .services import __all__ as _service_all
+
+__all__ = ["CognitiveServiceBase", *_service_all]

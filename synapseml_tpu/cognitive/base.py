@@ -1,0 +1,139 @@
+"""Cognitive-service transformer base.
+
+Reference: ``cognitive/.../CognitiveServiceBase.scala:271-335`` — every service
+stage assembles a pipeline of [Lambda (pack dynamic params into a struct) ->
+SimpleHTTPTransformer -> DropColumns], with ``ServiceParam``s that hold either a
+static value or a column reference (``setX`` / ``setXCol`` in the reference's
+codegen), subscription-key headers, URL from location+path, and an error column.
+
+``ServiceParam`` here is a light descriptor over two underlying Params
+(``<name>`` and ``<name>_col``): ``svc_value(row)`` resolves per row.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from ..core import Param, Table, Transformer
+from ..io.clients import AsyncHTTPClient
+from ..io.http_schema import HTTPRequestData, HTTPResponseData
+
+__all__ = ["ServiceParamMixin", "CognitiveServiceBase", "service_param"]
+
+
+def service_param(owner_attrs: Dict[str, Any], name: str, doc: str,
+                  default=None) -> None:
+    """Declare a value-or-column service param pair on a class body dict."""
+    owner_attrs[name] = Param(doc + " (static value)", object, default=default)
+    owner_attrs[f"{name}_col"] = Param(doc + " (column name)", str, default=None)
+
+
+class ServiceParamMixin:
+    """Resolution helper for value-or-column params."""
+
+    def svc_value(self, table: Optional[Table], row: Optional[int], name: str):
+        col_name = getattr(self, f"{name}_col", None)
+        if col_name:
+            if table is None or col_name not in table:
+                raise ValueError(
+                    f"{type(self).__name__}({self.uid}): column {col_name!r} "
+                    f"(for service param {name!r}) missing from input")
+            return table[col_name][row]
+        return getattr(self, name, None)
+
+
+class CognitiveServiceBase(Transformer, ServiceParamMixin):
+    """Build per-row requests, post with bounded concurrency, parse, error-split.
+
+    Subclasses define ``url_path``, override ``build_payload(table, row)`` (and
+    optionally ``build_url``/``build_headers``/``parse_response``)."""
+
+    _abstract_stage = True
+
+    subscription_key = Param("service key (static)", object, default=None)
+    subscription_key_col = Param("service key column", str, default=None)
+    url = Param("full endpoint URL (overrides location+path)", str, default="")
+    location = Param("service region, e.g. eastus (reference setLocation)", str,
+                     default="")
+    output_col = Param("parsed output column", str, default="output")
+    error_col = Param("error column", str, default="errors")
+    concurrency = Param("max in-flight requests", int, default=4)
+    timeout = Param("request timeout seconds", float, default=60.0)
+    backoffs = Param("retry backoffs ms", list, default=[100, 500, 1000])
+
+    url_path: str = ""  # subclass service path
+    _service_domain = "api.cognitive.microsoft.com"
+
+    # -- request assembly ----------------------------------------------------------
+
+    def build_url(self, table: Table, row: int) -> str:
+        if self.url:
+            return self.url
+        if not self.location:
+            raise ValueError(
+                f"{type(self).__name__}({self.uid}): set url or location")
+        return f"https://{self.location}.{self._service_domain}{self.url_path}"
+
+    def build_headers(self, table: Table, row: int) -> Dict[str, str]:
+        headers = {"Content-Type": "application/json"}
+        key = self.svc_value(table, row, "subscription_key")
+        if key:
+            headers["Ocp-Apim-Subscription-Key"] = str(key)
+        return headers
+
+    def build_payload(self, table: Table, row: int) -> Optional[Any]:
+        raise NotImplementedError
+
+    def build_request(self, table: Table, row: int) -> Optional[HTTPRequestData]:
+        payload = self.build_payload(table, row)
+        if payload is None:
+            return None
+        if isinstance(payload, bytes):
+            body = payload
+        else:
+            body = json.dumps(payload, default=_np_jsonable).encode()
+        return HTTPRequestData(url=self.build_url(table, row), method="POST",
+                               headers=self.build_headers(table, row), entity=body)
+
+    def parse_response(self, resp: HTTPResponseData) -> Any:
+        if not resp.text:
+            return None
+        try:
+            return json.loads(resp.text)
+        except json.JSONDecodeError:
+            return resp.text
+
+    # -- transform -----------------------------------------------------------------
+
+    def _transform(self, table: Table) -> Table:
+        n = table.num_rows
+        reqs: List[Optional[HTTPRequestData]] = [
+            self.build_request(table, r) for r in range(n)
+        ]
+        client = AsyncHTTPClient(self.concurrency, self.timeout, self.backoffs)
+        responses = client.send_all(reqs)
+        out = np.empty(n, dtype=object)
+        errors = np.empty(n, dtype=object)
+        for i, resp in enumerate(responses):
+            if resp is None:
+                out[i] = None
+                errors[i] = None
+            elif 200 <= resp.status_code < 300:
+                out[i] = self.parse_response(resp)
+                errors[i] = None
+            else:
+                out[i] = None
+                errors[i] = resp.to_dict()
+        return (table.with_column(self.output_col, out)
+                .with_column(self.error_col, errors))
+
+
+def _np_jsonable(v):
+    if isinstance(v, np.ndarray):
+        return v.tolist()
+    if isinstance(v, np.generic):
+        return v.item()
+    raise TypeError(f"not JSON-serializable: {type(v)}")

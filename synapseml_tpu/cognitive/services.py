@@ -1,0 +1,589 @@
+"""The cognitive-service transformer family.
+
+Reference: ``cognitive/src/main/scala/.../cognitive/`` — ~40 transformers over
+HTTP-on-Spark (SURVEY.md §2.4): ``TextAnalytics.scala`` (622 LoC),
+``ComputerVision.scala`` (630), ``Face.scala`` (351), ``TextTranslator.scala``
+(550), ``AnomalyDetection.scala`` (249), ``FormRecognizer.scala`` (353),
+``BingImageSearch.scala`` (309), ``SpeechToText.scala``. Each stage is a thin
+payload/URL builder on :class:`CognitiveServiceBase`; value-or-column service
+params mirror the reference's ``setX``/``setXCol`` pairs.
+"""
+
+from __future__ import annotations
+
+import base64 as _b64
+import json
+import urllib.parse
+from typing import Any, Dict, Optional
+
+from ..core import Param, Table
+from .base import CognitiveServiceBase
+
+__all__ = [
+    # text analytics
+    "TextSentiment", "LanguageDetector", "EntityDetector", "KeyPhraseExtractor",
+    "NER", "PII",
+    # translator
+    "Translate", "Transliterate", "DetectLanguage", "BreakSentence",
+    "DictionaryLookup",
+    # vision
+    "AnalyzeImage", "DescribeImage", "OCR", "ReadImage", "TagImage",
+    "GenerateThumbnails", "RecognizeDomainSpecificContent",
+    # face
+    "DetectFace", "FindSimilarFace", "GroupFaces", "IdentifyFaces", "VerifyFaces",
+    # anomaly
+    "DetectLastAnomaly", "DetectAnomalies", "SimpleDetectAnomalies",
+    # speech / search / form
+    "SpeechToText", "TextToSpeech", "BingImageSearch",
+    "AnalyzeLayout", "AnalyzeReceipts", "AnalyzeBusinessCards",
+    "AnalyzeInvoices", "AnalyzeIDDocuments",
+]
+
+
+# ---------------------------------------------------------------------------------
+# Text analytics (reference TextAnalytics.scala; v3.1 documents API)
+# ---------------------------------------------------------------------------------
+
+class _TextAnalyticsBase(CognitiveServiceBase):
+    _abstract_stage = True
+
+    text = Param("text (static value)", object, default=None)
+    text_col = Param("text column", str, default="text")
+    language = Param("document language (static)", object, default="en")
+    language_col = Param("language column", str, default=None)
+
+    def build_payload(self, table: Table, row: int):
+        text = self.svc_value(table, row, "text")
+        if text is None:
+            return None
+        lang = self.svc_value(table, row, "language")
+        doc: Dict[str, Any] = {"id": "0", "text": str(text)}
+        if lang:
+            doc["language"] = str(lang)
+        return {"documents": [doc]}
+
+
+class TextSentiment(_TextAnalyticsBase):
+    """Reference ``TextSentiment`` (``TextAnalytics.scala``)."""
+
+    url_path = "/text/analytics/v3.1/sentiment"
+    opinion_mining = Param("include opinion mining", bool, default=False)
+
+    def build_url(self, table, row):
+        u = super().build_url(table, row)
+        return u + ("?opinionMining=true" if self.opinion_mining else "")
+
+
+class LanguageDetector(_TextAnalyticsBase):
+    url_path = "/text/analytics/v3.1/languages"
+
+    def build_payload(self, table: Table, row: int):
+        text = self.svc_value(table, row, "text")
+        if text is None:
+            return None
+        return {"documents": [{"id": "0", "text": str(text)}]}
+
+
+class EntityDetector(_TextAnalyticsBase):
+    url_path = "/text/analytics/v3.1/entities/linking"
+
+
+class KeyPhraseExtractor(_TextAnalyticsBase):
+    url_path = "/text/analytics/v3.1/keyPhrases"
+
+
+class NER(_TextAnalyticsBase):
+    url_path = "/text/analytics/v3.1/entities/recognition/general"
+
+
+class PII(_TextAnalyticsBase):
+    url_path = "/text/analytics/v3.1/entities/recognition/pii"
+
+
+# ---------------------------------------------------------------------------------
+# Translator (reference TextTranslator.scala; api.cognitive.microsofttranslator.com)
+# ---------------------------------------------------------------------------------
+
+class _TranslatorBase(CognitiveServiceBase):
+    _abstract_stage = True
+    _service_domain = "api.cognitive.microsofttranslator.com"
+
+    text = Param("text (static)", object, default=None)
+    text_col = Param("text column", str, default="text")
+    api_version = Param("API version", str, default="3.0")
+
+    def _query(self, table: Table, row: int) -> Dict[str, str]:
+        return {"api-version": self.api_version}
+
+    def build_url(self, table, row):
+        if self.url:
+            base = self.url
+        else:
+            base = f"https://{self._service_domain}{self.url_path}"
+        return base + "?" + urllib.parse.urlencode(self._query(table, row),
+                                                   doseq=True)
+
+    def build_headers(self, table, row):
+        h = super().build_headers(table, row)
+        if self.location:  # translator wants the region as its own header
+            h["Ocp-Apim-Subscription-Region"] = self.location
+        return h
+
+    def build_payload(self, table: Table, row: int):
+        text = self.svc_value(table, row, "text")
+        if text is None:
+            return None
+        texts = text if isinstance(text, (list, tuple)) else [text]
+        return [{"Text": str(t)} for t in texts]
+
+
+class Translate(_TranslatorBase):
+    url_path = "/translate"
+    to_language = Param("target language(s)", object, default=["en"])
+    from_language = Param("source language (autodetect if unset)", object,
+                          default=None)
+
+    def _query(self, table, row):
+        q = super()._query(table, row)
+        to = self.to_language
+        q["to"] = list(to) if isinstance(to, (list, tuple)) else [to]
+        if self.from_language:
+            q["from"] = self.from_language
+        return q
+
+
+class Transliterate(_TranslatorBase):
+    url_path = "/transliterate"
+    language = Param("language of the text", object, default="ja")
+    from_script = Param("source script", object, default="Jpan")
+    to_script = Param("target script", object, default="Latn")
+
+    def _query(self, table, row):
+        q = super()._query(table, row)
+        q.update({"language": self.language, "fromScript": self.from_script,
+                  "toScript": self.to_script})
+        return q
+
+
+class DetectLanguage(_TranslatorBase):
+    url_path = "/detect"
+
+
+class BreakSentence(_TranslatorBase):
+    url_path = "/breaksentence"
+
+
+class DictionaryLookup(_TranslatorBase):
+    url_path = "/dictionary/lookup"
+    from_language = Param("source language", object, default="en")
+    to_language = Param("target language", object, default="es")
+
+    def _query(self, table, row):
+        q = super()._query(table, row)
+        q.update({"from": self.from_language, "to": self.to_language})
+        return q
+
+
+# ---------------------------------------------------------------------------------
+# Computer vision (reference ComputerVision.scala; v3.2)
+# ---------------------------------------------------------------------------------
+
+class _VisionBase(CognitiveServiceBase):
+    _abstract_stage = True
+
+    image_url = Param("image URL (static)", object, default=None)
+    image_url_col = Param("image URL column", str, default=None)
+    image_bytes = Param("image bytes (static)", object, default=None)
+    image_bytes_col = Param("image bytes column", str, default=None)
+
+    def build_payload(self, table: Table, row: int):
+        img = self.svc_value(table, row, "image_bytes")
+        if img is not None:
+            return bytes(img)
+        url = self.svc_value(table, row, "image_url")
+        if url is None:
+            return None
+        return {"url": str(url)}
+
+    def build_headers(self, table, row):
+        h = super().build_headers(table, row)
+        if self.svc_value(table, row, "image_bytes") is not None:
+            h["Content-Type"] = "application/octet-stream"
+        return h
+
+
+class AnalyzeImage(_VisionBase):
+    url_path = "/vision/v3.2/analyze"
+    visual_features = Param("features: Categories,Tags,Description,Faces,...",
+                            list, default=["Categories"])
+    details = Param("details: Celebrities,Landmarks", list, default=[])
+    language = Param("result language", object, default="en")
+
+    def build_url(self, table, row):
+        q = {"visualFeatures": ",".join(self.visual_features),
+             "language": self.language}
+        if self.details:
+            q["details"] = ",".join(self.details)
+        return super().build_url(table, row) + "?" + urllib.parse.urlencode(q)
+
+
+class DescribeImage(_VisionBase):
+    url_path = "/vision/v3.2/describe"
+    max_candidates = Param("caption candidates", int, default=1)
+
+    def build_url(self, table, row):
+        return (super().build_url(table, row)
+                + f"?maxCandidates={self.max_candidates}")
+
+
+class OCR(_VisionBase):
+    url_path = "/vision/v3.2/ocr"
+    detect_orientation = Param("detect orientation", bool, default=True)
+
+    def build_url(self, table, row):
+        return (super().build_url(table, row)
+                + f"?detectOrientation={str(self.detect_orientation).lower()}")
+
+
+class ReadImage(_VisionBase):
+    url_path = "/vision/v3.2/read/analyze"
+
+
+class TagImage(_VisionBase):
+    url_path = "/vision/v3.2/tag"
+
+
+class GenerateThumbnails(_VisionBase):
+    url_path = "/vision/v3.2/generateThumbnail"
+    width = Param("thumbnail width", int, default=64)
+    height = Param("thumbnail height", int, default=64)
+    smart_cropping = Param("smart cropping", bool, default=True)
+
+    def build_url(self, table, row):
+        q = {"width": self.width, "height": self.height,
+             "smartCropping": str(self.smart_cropping).lower()}
+        return super().build_url(table, row) + "?" + urllib.parse.urlencode(q)
+
+    def parse_response(self, resp):
+        return resp.entity  # binary thumbnail
+
+
+class RecognizeDomainSpecificContent(_VisionBase):
+    model = Param("domain model, e.g. celebrities", str, default="celebrities")
+
+    def build_url(self, table, row):
+        self_url = self.url
+        if self_url:
+            return self_url
+        return (f"https://{self.location}.{self._service_domain}"
+                f"/vision/v3.2/models/{self.model}/analyze")
+
+
+# ---------------------------------------------------------------------------------
+# Face (reference Face.scala; v1.0)
+# ---------------------------------------------------------------------------------
+
+class DetectFace(_VisionBase):
+    url_path = "/face/v1.0/detect"
+    return_face_id = Param("return face ids", bool, default=True)
+    return_face_landmarks = Param("return landmarks", bool, default=False)
+    return_face_attributes = Param("attribute list", list, default=[])
+
+    def build_url(self, table, row):
+        q = {"returnFaceId": str(self.return_face_id).lower(),
+             "returnFaceLandmarks": str(self.return_face_landmarks).lower()}
+        if self.return_face_attributes:
+            q["returnFaceAttributes"] = ",".join(self.return_face_attributes)
+        return super().build_url(table, row) + "?" + urllib.parse.urlencode(q)
+
+
+class _FaceJSONBase(CognitiveServiceBase):
+    _abstract_stage = True
+
+    def _payload_from_params(self, table, row, names) -> Optional[dict]:
+        out = {}
+        for snake, wire in names.items():
+            v = self.svc_value(table, row, snake)
+            if v is not None:
+                out[wire] = v.tolist() if hasattr(v, "tolist") else v
+        return out or None
+
+
+class FindSimilarFace(_FaceJSONBase):
+    url_path = "/face/v1.0/findsimilars"
+    face_id = Param("query face id (static)", object, default=None)
+    face_id_col = Param("query face id column", str, default=None)
+    face_ids = Param("candidate face ids (static)", object, default=None)
+    face_ids_col = Param("candidate ids column", str, default=None)
+    max_num_of_candidates = Param("max candidates returned", int, default=20)
+
+    def build_payload(self, table, row):
+        p = self._payload_from_params(
+            table, row, {"face_id": "faceId", "face_ids": "faceIds"})
+        if p:
+            p["maxNumOfCandidatesReturned"] = self.max_num_of_candidates
+        return p
+
+
+class GroupFaces(_FaceJSONBase):
+    url_path = "/face/v1.0/group"
+    face_ids = Param("face ids (static)", object, default=None)
+    face_ids_col = Param("face ids column", str, default=None)
+
+    def build_payload(self, table, row):
+        return self._payload_from_params(table, row, {"face_ids": "faceIds"})
+
+
+class IdentifyFaces(_FaceJSONBase):
+    url_path = "/face/v1.0/identify"
+    face_ids = Param("face ids (static)", object, default=None)
+    face_ids_col = Param("face ids column", str, default=None)
+    person_group_id = Param("person group", object, default=None)
+
+    def build_payload(self, table, row):
+        p = self._payload_from_params(table, row, {"face_ids": "faceIds"})
+        if p and self.person_group_id:
+            p["personGroupId"] = self.person_group_id
+        return p
+
+
+class VerifyFaces(_FaceJSONBase):
+    url_path = "/face/v1.0/verify"
+    face_id1 = Param("first face id (static)", object, default=None)
+    face_id1_col = Param("first face id column", str, default=None)
+    face_id2 = Param("second face id (static)", object, default=None)
+    face_id2_col = Param("second face id column", str, default=None)
+
+    def build_payload(self, table, row):
+        return self._payload_from_params(
+            table, row, {"face_id1": "faceId1", "face_id2": "faceId2"})
+
+
+# ---------------------------------------------------------------------------------
+# Anomaly detection (reference AnomalyDetection.scala; v1.0 series API)
+# ---------------------------------------------------------------------------------
+
+class _AnomalyBase(CognitiveServiceBase):
+    _abstract_stage = True
+
+    series = Param("time series [{timestamp, value}, ...] (static)", object,
+                   default=None)
+    series_col = Param("series column", str, default="series")
+    granularity = Param("granularity: yearly|monthly|weekly|daily|hourly|"
+                        "minutely", str, default="monthly")
+    max_anomaly_ratio = Param("max anomaly ratio", float, default=0.25)
+    sensitivity = Param("sensitivity 0-99", int, default=95)
+
+    def build_payload(self, table: Table, row: int):
+        series = self.svc_value(table, row, "series")
+        if series is None:
+            return None
+        pts = [dict(p) for p in series]
+        return {"series": pts, "granularity": self.granularity,
+                "maxAnomalyRatio": self.max_anomaly_ratio,
+                "sensitivity": self.sensitivity}
+
+
+class DetectLastAnomaly(_AnomalyBase):
+    url_path = "/anomalydetector/v1.0/timeseries/last/detect"
+
+
+class DetectAnomalies(_AnomalyBase):
+    url_path = "/anomalydetector/v1.0/timeseries/entire/detect"
+
+
+class SimpleDetectAnomalies(_AnomalyBase):
+    """Reference ``SimpleDetectAnomalies``: rows hold (timestamp, value, group);
+    series are assembled per group and the per-point verdict is joined back."""
+
+    url_path = "/anomalydetector/v1.0/timeseries/entire/detect"
+    timestamp_col = Param("timestamp column", str, default="timestamp")
+    value_col = Param("value column", str, default="value")
+    group_col = Param("series grouping column", str, default="group")
+
+    def _transform(self, table: Table) -> Table:
+        import numpy as np
+
+        self._validate_input(table, self.timestamp_col, self.value_col,
+                             self.group_col)
+        groups = np.asarray(table[self.group_col])
+        ts = table[self.timestamp_col]
+        vals = table[self.value_col]
+        out = np.empty(table.num_rows, dtype=object)
+        errors = np.empty(table.num_rows, dtype=object)
+        from ..io.clients import send_with_retries
+
+        for g in np.unique(groups):
+            rows = np.nonzero(groups == g)[0]
+            order = rows[np.argsort(np.asarray(ts, dtype=object)[rows])]
+            series = [{"timestamp": str(ts[i]), "value": float(vals[i])}
+                      for i in order]
+            payload = {"series": series, "granularity": self.granularity,
+                       "maxAnomalyRatio": self.max_anomaly_ratio,
+                       "sensitivity": self.sensitivity}
+            from ..io.http_schema import HTTPRequestData
+
+            req = HTTPRequestData(
+                url=self.build_url(table, int(order[0])), method="POST",
+                headers=self.build_headers(table, int(order[0])),
+                entity=json.dumps(payload).encode())
+            resp = send_with_retries(req, self.timeout, self.backoffs)
+            if 200 <= resp.status_code < 300:
+                parsed = self.parse_response(resp)
+                if not isinstance(parsed, dict):  # non-JSON 2xx body
+                    for i in order:
+                        out[i] = None
+                        errors[i] = resp.to_dict()
+                    continue
+                flags = parsed.get("isAnomaly", [])
+                for k, i in enumerate(order):
+                    out[i] = {"isAnomaly": flags[k] if k < len(flags) else None}
+                    errors[i] = None
+            else:
+                for i in order:
+                    out[i] = None
+                    errors[i] = resp.to_dict()
+        return (table.with_column(self.output_col, out)
+                .with_column(self.error_col, errors))
+
+
+# ---------------------------------------------------------------------------------
+# Speech (reference SpeechToText.scala / TextToSpeech.scala; REST short-audio API)
+# ---------------------------------------------------------------------------------
+
+class SpeechToText(CognitiveServiceBase):
+    _service_domain = "stt.speech.microsoft.com"
+    url_path = "/speech/recognition/conversation/cognitiveservices/v1"
+
+    audio_data = Param("audio bytes (static)", object, default=None)
+    audio_data_col = Param("audio bytes column", str, default="audio")
+    audio_format = Param("Content-Type of the audio", str,
+                         default="audio/wav; codecs=audio/pcm; samplerate=16000")
+    language = Param("recognition language", object, default="en-US")
+
+    def build_url(self, table, row):
+        base = self.url or (f"https://{self.location}.{self._service_domain}"
+                            f"{self.url_path}")
+        return base + "?" + urllib.parse.urlencode({"language": self.language})
+
+    def build_headers(self, table, row):
+        h = super().build_headers(table, row)
+        h["Content-Type"] = self.audio_format
+        h["Accept"] = "application/json"
+        return h
+
+    def build_payload(self, table: Table, row: int):
+        audio = self.svc_value(table, row, "audio_data")
+        return bytes(audio) if audio is not None else None
+
+
+class TextToSpeech(CognitiveServiceBase):
+    _service_domain = "tts.speech.microsoft.com"
+    url_path = "/cognitiveservices/v1"
+
+    text = Param("text to speak (static)", object, default=None)
+    text_col = Param("text column", str, default="text")
+    voice_name = Param("voice", str, default="en-US-JennyNeural")
+    language = Param("language", str, default="en-US")
+    output_format = Param("X-Microsoft-OutputFormat", str,
+                          default="riff-16khz-16bit-mono-pcm")
+
+    def build_headers(self, table, row):
+        h = super().build_headers(table, row)
+        h["Content-Type"] = "application/ssml+xml"
+        h["X-Microsoft-OutputFormat"] = self.output_format
+        return h
+
+    def build_payload(self, table: Table, row: int):
+        from xml.sax.saxutils import escape, quoteattr
+
+        text = self.svc_value(table, row, "text")
+        if text is None:
+            return None
+        ssml = (f"<speak version='1.0' xml:lang={quoteattr(str(self.language))}>"
+                f"<voice name={quoteattr(str(self.voice_name))}>"
+                f"{escape(str(text))}</voice></speak>")
+        return ssml.encode()
+
+    def parse_response(self, resp):
+        return resp.entity  # audio bytes
+
+
+# ---------------------------------------------------------------------------------
+# Bing image search (reference BingImageSearch.scala)
+# ---------------------------------------------------------------------------------
+
+class BingImageSearch(CognitiveServiceBase):
+    _service_domain = "api.bing.microsoft.com"
+    url_path = "/v7.0/images/search"
+
+    query = Param("search query (static)", object, default=None)
+    query_col = Param("query column", str, default=None)
+    count = Param("results per query", int, default=10)
+    offset = Param("result offset", int, default=0)
+
+    def build_url(self, table, row):
+        base = self.url or f"https://{self._service_domain}{self.url_path}"
+        q = self.svc_value(table, row, "query")
+        return base + "?" + urllib.parse.urlencode(
+            {"q": q, "count": self.count, "offset": self.offset})
+
+    def build_request(self, table, row):
+        from ..io.http_schema import HTTPRequestData
+
+        q = self.svc_value(table, row, "query")
+        if q is None:
+            return None
+        headers = self.build_headers(table, row)
+        headers.pop("Content-Type", None)
+        return HTTPRequestData(url=self.build_url(table, row), method="GET",
+                               headers=headers)
+
+    def build_payload(self, table, row):  # GET carries no body
+        return None
+
+    @staticmethod
+    def download_from_urls(table: Table, url_col: str, out_col: str = "image",
+                           concurrency: int = 8) -> Table:
+        """Reference helper ``BingImageSearch.downloadFromUrls``."""
+        import numpy as np
+
+        from ..io.clients import AsyncHTTPClient
+        from ..io.http_schema import HTTPRequestData
+
+        urls = table[url_col]
+        reqs = [None if u is None else HTTPRequestData(url=str(u), method="GET")
+                for u in urls]
+        resps = AsyncHTTPClient(concurrency=concurrency).send_all(reqs)
+        out = np.empty(len(urls), dtype=object)
+        for i, r in enumerate(resps):
+            out[i] = r.entity if (r is not None and r.status_code == 200) else None
+        return table.with_column(out_col, out)
+
+
+# ---------------------------------------------------------------------------------
+# Form recognizer (reference FormRecognizer.scala; v2.1 analyze APIs)
+# ---------------------------------------------------------------------------------
+
+class _FormRecognizerBase(_VisionBase):
+    _abstract_stage = True
+
+
+class AnalyzeLayout(_FormRecognizerBase):
+    url_path = "/formrecognizer/v2.1/layout/analyze"
+
+
+class AnalyzeReceipts(_FormRecognizerBase):
+    url_path = "/formrecognizer/v2.1/prebuilt/receipt/analyze"
+
+
+class AnalyzeBusinessCards(_FormRecognizerBase):
+    url_path = "/formrecognizer/v2.1/prebuilt/businessCard/analyze"
+
+
+class AnalyzeInvoices(_FormRecognizerBase):
+    url_path = "/formrecognizer/v2.1/prebuilt/invoice/analyze"
+
+
+class AnalyzeIDDocuments(_FormRecognizerBase):
+    url_path = "/formrecognizer/v2.1/prebuilt/idDocument/analyze"

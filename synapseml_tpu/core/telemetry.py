@@ -16,9 +16,15 @@ import threading
 import time
 from typing import Any, Dict, List
 
-__all__ = ["log_stage_call", "recent_events", "clear_events", "BUILD_VERSION"]
+__all__ = ["log_stage_call", "recent_events", "clear_events", "get_logger",
+           "BUILD_VERSION"]
 
 BUILD_VERSION = "0.1.0"
+
+
+def get_logger(name: str) -> logging.Logger:
+    """Namespaced framework logger (``synapseml_tpu.<name>``)."""
+    return logging.getLogger(f"synapseml_tpu.{name}")
 
 _logger = logging.getLogger("synapseml_tpu.telemetry")
 _events: "collections.deque[Dict[str, Any]]" = collections.deque(maxlen=4096)

@@ -1,0 +1,33 @@
+"""IO subsystems: HTTP-on-pipeline client stack + model serving.
+
+Reference: ``core/.../io/http/`` (client stack, SURVEY.md §2.4) and Spark Serving
+(``org/apache/spark/sql/execution/streaming/``).
+"""
+
+from .clients import AsyncHTTPClient, send_request, send_with_retries
+from .http_schema import HTTPRequestData, HTTPResponseData
+from .http_transformers import (
+    CustomInputParser,
+    CustomOutputParser,
+    HTTPTransformer,
+    JSONInputParser,
+    JSONOutputParser,
+    SimpleHTTPTransformer,
+)
+from .serving import (
+    MicroBatchServingEngine,
+    ServingServer,
+    request_to_string,
+    serve,
+    string_to_response,
+)
+
+__all__ = [
+    "HTTPRequestData", "HTTPResponseData",
+    "AsyncHTTPClient", "send_request", "send_with_retries",
+    "HTTPTransformer", "SimpleHTTPTransformer",
+    "JSONInputParser", "JSONOutputParser",
+    "CustomInputParser", "CustomOutputParser",
+    "ServingServer", "MicroBatchServingEngine", "serve",
+    "request_to_string", "string_to_response",
+]

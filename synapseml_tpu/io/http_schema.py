@@ -1,0 +1,65 @@
+"""HTTP request/response data model for Table columns.
+
+Reference: ``core/.../io/http/HTTPSchema.scala`` — Spark-struct codecs for
+``HTTPRequestData``/``HTTPResponseData`` (method, URI, headers, entity, status).
+Here requests/responses are plain dataclasses stored in object columns; the
+``to_dict``/``from_dict`` codecs are the struct⇄row analogue and keep columns
+JSON-friendly for serialization and serving.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+__all__ = ["HTTPRequestData", "HTTPResponseData"]
+
+
+@dataclass
+class HTTPRequestData:
+    url: str
+    method: str = "GET"
+    headers: Dict[str, str] = field(default_factory=dict)
+    entity: Optional[bytes] = None
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "url": self.url, "method": self.method, "headers": dict(self.headers),
+            "entity": self.entity.decode("utf-8", "replace") if self.entity else None,
+        }
+
+    @staticmethod
+    def from_dict(d: Dict[str, Any]) -> "HTTPRequestData":
+        ent = d.get("entity")
+        return HTTPRequestData(
+            url=d["url"], method=d.get("method", "GET"),
+            headers=dict(d.get("headers") or {}),
+            entity=ent.encode("utf-8") if isinstance(ent, str) else ent,
+        )
+
+
+@dataclass
+class HTTPResponseData:
+    status_code: int
+    reason: str = ""
+    headers: Dict[str, str] = field(default_factory=dict)
+    entity: Optional[bytes] = None
+
+    @property
+    def text(self) -> str:
+        return self.entity.decode("utf-8", "replace") if self.entity else ""
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "statusCode": self.status_code, "reason": self.reason,
+            "headers": dict(self.headers), "entity": self.text or None,
+        }
+
+    @staticmethod
+    def from_dict(d: Dict[str, Any]) -> "HTTPResponseData":
+        ent = d.get("entity")
+        return HTTPResponseData(
+            status_code=int(d.get("statusCode", 0)), reason=d.get("reason", ""),
+            headers=dict(d.get("headers") or {}),
+            entity=ent.encode("utf-8") if isinstance(ent, str) else ent,
+        )

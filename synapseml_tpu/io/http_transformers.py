@@ -1,0 +1,210 @@
+"""HTTP pipeline stages: request/response transformers and JSON sugar.
+
+Reference:
+- ``HTTPTransformer`` (``core/.../io/http/HTTPTransformer.scala:92``): request
+  column -> parallel HTTP -> response column, with ``ConcurrencyParams``;
+- ``SimpleHTTPTransformer`` (``SimpleHTTPTransformer.scala:64-150``): builds the
+  JSONInputParser -> HTTPTransformer -> JSONOutputParser pipeline with an error
+  column (``ErrorUtils:31-62``) and optional minibatching;
+- ``Parsers.scala``: JSONInputParser / JSONOutputParser / CustomInput/OutputParser.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Callable, Optional
+
+import numpy as np
+
+from ..core import ComplexParam, Param, Table, Transformer
+from ..core.params import ParamValidators
+from .clients import DEFAULT_BACKOFFS_MS, AsyncHTTPClient
+from .http_schema import HTTPRequestData, HTTPResponseData
+
+__all__ = [
+    "HTTPTransformer", "SimpleHTTPTransformer",
+    "JSONInputParser", "JSONOutputParser",
+    "CustomInputParser", "CustomOutputParser",
+]
+
+
+class _ConcurrencyParams(Transformer):
+    """Reference ``ConcurrencyParams`` (concurrency/timeout/backoffs)."""
+
+    _abstract_stage = True
+
+    concurrency = Param("max in-flight requests per partition", int, default=8,
+                        validator=ParamValidators.gt(0))
+    timeout = Param("per-request timeout seconds", float, default=60.0)
+    backoffs = Param("retry backoffs in ms", list, default=list(DEFAULT_BACKOFFS_MS))
+
+
+class HTTPTransformer(_ConcurrencyParams):
+    """Object column of HTTPRequestData (or dict) -> HTTPResponseData column."""
+
+    input_col = Param("request column", str, default="request")
+    output_col = Param("response column", str, default="response")
+
+    def _transform(self, table: Table) -> Table:
+        self._validate_input(table, self.input_col)
+        col = table[self.input_col]
+        reqs = []
+        for v in col:
+            if v is None:
+                reqs.append(None)
+            elif isinstance(v, HTTPRequestData):
+                reqs.append(v)
+            elif isinstance(v, dict):
+                reqs.append(HTTPRequestData.from_dict(v))
+            else:
+                raise TypeError(
+                    f"HTTPTransformer({self.uid}): request column holds "
+                    f"{type(v).__name__}, expected HTTPRequestData or dict")
+        client = AsyncHTTPClient(self.concurrency, self.timeout, self.backoffs)
+        out = np.empty(len(reqs), dtype=object)
+        out[:] = client.send_all(reqs)
+        return table.with_column(self.output_col, out)
+
+
+class JSONInputParser(Transformer):
+    """Dict/JSON column -> HTTPRequestData column (reference ``JSONInputParser``)."""
+
+    input_col = Param("column of dict/JSON payloads", str, default="input")
+    output_col = Param("request column", str, default="request")
+    url = Param("target URL", str, default="")
+    method = Param("HTTP method", str, default="POST")
+    headers = Param("extra headers", dict, default={})
+
+    def _transform(self, table: Table) -> Table:
+        self._validate_input(table, self.input_col)
+        if not self.url:
+            raise ValueError(f"JSONInputParser({self.uid}): url is not set")
+        headers = {"Content-Type": "application/json", **self.headers}
+        out = np.empty(table.num_rows, dtype=object)
+        col = table[self.input_col]
+        for i, v in enumerate(col):
+            if v is None:
+                out[i] = None
+                continue
+            body = v if isinstance(v, str) else json.dumps(
+                v, default=_np_jsonable)
+            out[i] = HTTPRequestData(url=self.url, method=self.method,
+                                     headers=headers, entity=body.encode())
+        return table.with_column(self.output_col, out)
+
+
+def _np_jsonable(v):
+    if isinstance(v, np.ndarray):
+        return v.tolist()
+    if isinstance(v, np.generic):
+        return v.item()
+    raise TypeError(f"not JSON-serializable: {type(v)}")
+
+
+class JSONOutputParser(Transformer):
+    """HTTPResponseData column -> parsed-JSON column (reference ``JSONOutputParser``)."""
+
+    input_col = Param("response column", str, default="response")
+    output_col = Param("parsed output column", str, default="output")
+
+    def _transform(self, table: Table) -> Table:
+        self._validate_input(table, self.input_col)
+        col = table[self.input_col]
+        out = np.empty(len(col), dtype=object)
+        for i, v in enumerate(col):
+            if v is None:
+                out[i] = None
+                continue
+            resp = v if isinstance(v, HTTPResponseData) else HTTPResponseData.from_dict(v)
+            try:
+                out[i] = json.loads(resp.text) if resp.text else None
+            except json.JSONDecodeError:
+                out[i] = None
+        return table.with_column(self.output_col, out)
+
+
+class CustomInputParser(Transformer):
+    """Row -> HTTPRequestData via a user function (reference ``CustomInputParser``)."""
+
+    input_col = Param("input column", str, default="input")
+    output_col = Param("request column", str, default="request")
+    udf = ComplexParam("value -> HTTPRequestData function", object, default=None)
+
+    def _transform(self, table: Table) -> Table:
+        self._validate_input(table, self.input_col)
+        if self.udf is None:
+            raise ValueError(f"CustomInputParser({self.uid}): udf is not set")
+        out = np.empty(table.num_rows, dtype=object)
+        for i, v in enumerate(table[self.input_col]):
+            out[i] = self.udf(v)
+        return table.with_column(self.output_col, out)
+
+
+class CustomOutputParser(Transformer):
+    """HTTPResponseData -> value via a user function (reference ``CustomOutputParser``)."""
+
+    input_col = Param("response column", str, default="response")
+    output_col = Param("output column", str, default="output")
+    udf = ComplexParam("HTTPResponseData -> value function", object, default=None)
+
+    def _transform(self, table: Table) -> Table:
+        self._validate_input(table, self.input_col)
+        if self.udf is None:
+            raise ValueError(f"CustomOutputParser({self.uid}): udf is not set")
+        out = np.empty(table.num_rows, dtype=object)
+        for i, v in enumerate(table[self.input_col]):
+            out[i] = self.udf(v)
+        return table.with_column(self.output_col, out)
+
+
+class SimpleHTTPTransformer(_ConcurrencyParams):
+    """JSON-in/JSON-out HTTP with an error column.
+
+    Builds (reference ``makePipeline``, ``SimpleHTTPTransformer.scala:115``):
+    JSONInputParser -> HTTPTransformer -> error split -> JSONOutputParser.
+    Rows whose response is not 2xx get the error recorded in ``error_col`` and a
+    None output (``ErrorUtils.addErrorUDF``)."""
+
+    input_col = Param("column of dict/JSON payloads", str, default="input")
+    output_col = Param("parsed output column", str, default="output")
+    error_col = Param("error column", str, default="errors")
+    url = Param("target URL", str, default="")
+    method = Param("HTTP method", str, default="POST")
+    headers = Param("extra headers", dict, default={})
+    flatten_output_batches = Param("if the service returns a JSON list per "
+                                   "request, explode it", bool, default=False)
+    input_parser = ComplexParam("override input parser stage", object, default=None)
+    output_parser = ComplexParam("override output parser stage", object, default=None)
+
+    def _transform(self, table: Table) -> Table:
+        parser = self.input_parser or JSONInputParser(
+            input_col=self.input_col, output_col="__request__", url=self.url,
+            method=self.method, headers=self.headers)
+        http = HTTPTransformer(
+            input_col="__request__", output_col="__response__",
+            concurrency=self.concurrency, timeout=self.timeout,
+            backoffs=self.backoffs)
+        staged = http.transform(parser.transform(table))
+        # error split
+        responses = staged["__response__"]
+        errors = np.empty(len(responses), dtype=object)
+        ok = np.empty(len(responses), dtype=object)
+        for i, r in enumerate(responses):
+            if r is not None and 200 <= r.status_code < 300:
+                ok[i] = r
+                errors[i] = None
+            else:
+                ok[i] = None
+                errors[i] = None if r is None else r.to_dict()
+        staged = staged.with_column("__response__", ok)
+        out_parser = self.output_parser or JSONOutputParser(
+            input_col="__response__", output_col=self.output_col)
+        result = out_parser.transform(staged)
+        result = result.with_column(self.error_col, errors)
+        result = result.drop("__request__", "__response__")
+        if self.flatten_output_batches:
+            from ..stages import Explode
+
+            result = Explode(input_col=self.output_col,
+                             output_col=self.output_col).transform(result)
+        return result

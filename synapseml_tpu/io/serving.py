@@ -1,0 +1,245 @@
+"""Model serving: HTTP sources/sinks and the micro-batch serving engine.
+
+Reference: Spark Serving (SURVEY.md §2.4) —
+- ``HTTPSource``/``DistributedHTTPSource`` (``org/apache/spark/sql/execution/
+  streaming/DistributedHTTPSource.scala:202-423``): per-executor ``JVMSharedServer``
+  web servers (``:87-199``) with batch-keyed request maps; the sink replies on the
+  held-open ``HttpExchange`` (``:144-147``);
+- ``ServingUDFs`` (``request_to_string`` / ``string_to_response``);
+- fluent entry ``spark.readStream.server()...`` (``core/.../io/IOImplicits.scala``).
+
+Here: ``ServingServer`` holds each request's handler thread on a condition
+variable until the pipeline's reply arrives (the HttpExchange analogue);
+``MicroBatchServingEngine`` drains pending requests every ``interval`` into a
+Table, runs the pipeline, and replies row-by-row. ``serve(...)`` is the fluent
+one-liner.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import uuid
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..core import Param, Table, Transformer
+from ..core.telemetry import get_logger
+from ..runtime.shared import shared_singleton
+from .http_schema import HTTPRequestData, HTTPResponseData
+
+__all__ = ["ServingServer", "MicroBatchServingEngine", "serve",
+           "request_to_string", "string_to_response"]
+
+_logger = get_logger("io.serving")
+
+
+class _Pending:
+    __slots__ = ("request", "response", "event")
+
+    def __init__(self, request: HTTPRequestData):
+        self.request = request
+        self.response: Optional[HTTPResponseData] = None
+        self.event = threading.Event()
+
+
+class ServingServer:
+    """Threaded HTTP server holding exchanges open until ``respond`` is called.
+
+    The ``JVMSharedServer`` analogue: requests land in a map keyed by an id;
+    the serving engine drains them with ``get_requests`` and replies with
+    ``respond`` — the handler thread then completes the held-open exchange."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 api_path: str = "/", reply_timeout: float = 30.0):
+        self.reply_timeout = reply_timeout
+        self._pending: Dict[str, _Pending] = {}
+        self._queue: List[str] = []
+        self._lock = threading.Lock()
+        self.requests_received = 0  # JVMSharedServer request counters (:96-105)
+        self.responses_sent = 0
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def _handle(self, method: str):
+                length = int(self.headers.get("Content-Length") or 0)
+                body = self.rfile.read(length) if length else None
+                req = HTTPRequestData(
+                    url=self.path, method=method,
+                    headers=dict(self.headers.items()), entity=body)
+                rid = uuid.uuid4().hex
+                slot = _Pending(req)
+                with outer._lock:
+                    outer._pending[rid] = slot
+                    outer._queue.append(rid)
+                    outer.requests_received += 1
+                if not slot.event.wait(outer.reply_timeout):
+                    with outer._lock:
+                        outer._pending.pop(rid, None)
+                    self.send_error(504, "serving engine timed out")
+                    return
+                resp = slot.response
+                self.send_response(resp.status_code or 200)
+                for k, v in resp.headers.items():
+                    self.send_header(k, v)
+                ent = resp.entity or b""
+                self.send_header("Content-Length", str(len(ent)))
+                self.end_headers()
+                self.wfile.write(ent)
+                with outer._lock:
+                    outer.responses_sent += 1
+
+            def do_GET(self):
+                self._handle("GET")
+
+            def do_POST(self):
+                self._handle("POST")
+
+            def log_message(self, fmt, *args):  # route into framework logging
+                _logger.debug("serving: " + fmt, *args)
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self.host, self.port = self._httpd.server_address[:2]
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        name=f"serving-{self.port}", daemon=True)
+        self._thread.start()
+
+    @property
+    def address(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def get_requests(self, max_n: Optional[int] = None
+                     ) -> List[Tuple[str, HTTPRequestData]]:
+        """Drain up to ``max_n`` queued request ids (the getBatch analogue)."""
+        with self._lock:
+            take = self._queue if max_n is None else self._queue[:max_n]
+            out = [(rid, self._pending[rid].request) for rid in take
+                   if rid in self._pending]
+            del self._queue[:len(take)]
+        return out
+
+    def respond(self, rid: str, response: HTTPResponseData) -> None:
+        with self._lock:
+            slot = self._pending.pop(rid, None)
+        if slot is None:
+            _logger.warning("respond: unknown or timed-out request %s", rid)
+            return
+        slot.response = response
+        slot.event.set()
+
+    def close(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+
+class MicroBatchServingEngine:
+    """Drain -> transform -> reply loop (the structured-streaming microbatch loop).
+
+    The pipeline sees a Table with columns ``id`` (str) and ``request``
+    (HTTPRequestData); it must produce ``reply_col`` holding HTTPResponseData,
+    dicts, or strings (wrapped as 200 text/json)."""
+
+    def __init__(self, server: ServingServer, pipeline: Transformer,
+                 reply_col: str = "reply", interval: float = 0.01,
+                 max_batch: int = 1024):
+        self.server = server
+        self.pipeline = pipeline
+        self.reply_col = reply_col
+        self.interval = interval
+        self.max_batch = max_batch
+        self._stop = threading.Event()
+        self._error: Optional[BaseException] = None
+        self._thread = threading.Thread(target=self._run, name="serving-engine",
+                                        daemon=True)
+        self.batches_processed = 0
+
+    def start(self) -> "MicroBatchServingEngine":
+        self._thread.start()
+        return self
+
+    def _run(self):
+        while not self._stop.is_set():
+            batch = self.server.get_requests(self.max_batch)
+            if not batch:
+                time.sleep(self.interval)
+                continue
+            ids = [rid for rid, _ in batch]
+            reqs = np.empty(len(batch), dtype=object)
+            reqs[:] = [r for _, r in batch]
+            table = Table({"id": np.array(ids, dtype=object), "request": reqs})
+            try:
+                out = self.pipeline.transform(table)
+                replies = out[self.reply_col]
+                out_ids = out["id"]
+            except Exception as e:  # reply 500s rather than hanging clients
+                _logger.exception("serving pipeline failed")
+                for rid in ids:
+                    self.server.respond(rid, HTTPResponseData(
+                        500, "pipeline error", entity=str(e).encode()))
+                self._error = e
+                continue
+            for rid, rep in zip(out_ids, replies):
+                self.server.respond(rid, _coerce_response(rep))
+            self.batches_processed += 1
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=5)
+        self.server.close()
+        if self._error is not None:
+            _logger.warning("serving engine saw pipeline errors; last: %s", self._error)
+
+
+def _coerce_response(rep) -> HTTPResponseData:
+    if isinstance(rep, HTTPResponseData):
+        return rep
+    if rep is None:
+        return HTTPResponseData(204, "no content")
+    if isinstance(rep, (dict, list)):
+        return HTTPResponseData(200, "OK", {"Content-Type": "application/json"},
+                                json.dumps(rep, default=_np_default).encode())
+    if isinstance(rep, bytes):
+        return HTTPResponseData(200, "OK", {}, rep)
+    return HTTPResponseData(200, "OK", {"Content-Type": "text/plain"},
+                            str(rep).encode())
+
+
+def _np_default(v):
+    if isinstance(v, np.ndarray):
+        return v.tolist()
+    if isinstance(v, np.generic):
+        return v.item()
+    raise TypeError(f"not JSON-serializable: {type(v)}")
+
+
+def serve(pipeline: Transformer, host: str = "127.0.0.1", port: int = 0,
+          reply_col: str = "reply", shared: bool = False,
+          reply_timeout: float = 30.0) -> MicroBatchServingEngine:
+    """Fluent entry (the ``spark.readStream.server()...writeStream.server()``
+    analogue). ``shared=True`` reuses one server per (host, port) process-wide
+    via the SharedSingleton pool, like ``JVMSharedServer``."""
+    if shared:
+        if port == 0:
+            raise ValueError("serve(shared=True) needs an explicit port: the "
+                             "singleton is keyed by (host, port) and ephemeral "
+                             "port 0 would alias unrelated services")
+        server = shared_singleton(
+            f"serving:{host}:{port}",
+            lambda: ServingServer(host, port, reply_timeout=reply_timeout))
+    else:
+        server = ServingServer(host, port, reply_timeout=reply_timeout)
+    return MicroBatchServingEngine(server, pipeline, reply_col=reply_col).start()
+
+
+def request_to_string(req: HTTPRequestData) -> str:
+    """Reference ``ServingUDFs.request_to_string``."""
+    return req.entity.decode("utf-8", "replace") if req.entity else ""
+
+
+def string_to_response(s: str, status: int = 200) -> HTTPResponseData:
+    """Reference ``ServingUDFs.string_to_response``."""
+    return HTTPResponseData(status, "OK", {"Content-Type": "text/plain"},
+                            s.encode("utf-8"))

@@ -27,6 +27,7 @@ class NativeLib:
     """Lazily built+loaded handle to ``_smt_native.so``."""
 
     _instance: Optional["NativeLib"] = None
+    _load_failed = False  # cache failures: never retry the compile per call
     _lock = threading.Lock()
 
     def __init__(self, cdll):
@@ -41,6 +42,8 @@ class NativeLib:
         with cls._lock:
             if cls._instance is not None:
                 return cls._instance
+            if cls._load_failed:
+                return None
             if not os.path.exists(_SO_PATH):
                 try:
                     from .build import build
@@ -48,11 +51,13 @@ class NativeLib:
                     build(verbose=False)
                 except Exception as e:  # no toolchain / build failure -> fallback
                     _logger.info("native build unavailable (%s); using numpy fallback", e)
+                    cls._load_failed = True
                     return None
             try:
                 cls._instance = NativeLib(ctypes.CDLL(_SO_PATH))
             except OSError as e:
                 _logger.warning("failed to load %s (%s); using numpy fallback", _SO_PATH, e)
+                cls._load_failed = True
                 return None
             return cls._instance
 

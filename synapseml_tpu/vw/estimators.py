@@ -105,6 +105,7 @@ class _VWBase(Estimator):
             l1=self.l1, l2=self.l2, batch_size=self.batch_size,
         )
         h.update(parse_vw_args(self.pass_through_args))
+        h.pop("hash_seed", None)  # featurizer concern; train_linear has no such arg
         return h
 
     def _gather(self, table: Table):
@@ -172,7 +173,8 @@ class VowpalWabbitClassificationModel(Model):
         if not isinstance(st, LinearLearnerState):
             st = LinearLearnerState(*st)
         raw = predict_linear(st, idx, val)
-        prob = predict_linear(st, idx, val, link="logistic")
+        prob = np.where(raw >= 0, 1 / (1 + np.exp(-np.abs(raw))),
+                        np.exp(-np.abs(raw)) / (1 + np.exp(-np.abs(raw))))
         pick = (prob >= 0.5).astype(int)
         labels = np.asarray(self.labels)
         out = table.with_column(self.raw_prediction_col,

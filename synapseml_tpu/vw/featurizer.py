@@ -119,9 +119,16 @@ class VowpalWabbitFeaturizer(Transformer):
                 all_i[r].append(ci[r])
                 all_v[r].append(cv[r])
         out = np.empty(n, dtype=object)
+        dedupe = not self.sum_collisions
         for r in range(n):
-            out[r] = (np.concatenate(all_i[r]).astype(np.uint32),
-                      np.concatenate(all_v[r]).astype(np.float32))
+            ri = np.concatenate(all_i[r]).astype(np.uint32)
+            rv = np.concatenate(all_v[r]).astype(np.float32)
+            if dedupe and len(ri):
+                # last wins: keep the final occurrence of each index
+                _, last = np.unique(ri[::-1], return_index=True)
+                keep = np.sort(len(ri) - 1 - last)
+                ri, rv = ri[keep], rv[keep]
+            out[r] = (ri, rv)
         return table.with_column(self.output_col, out, meta=sparse_meta())
 
 

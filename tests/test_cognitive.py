@@ -1,0 +1,193 @@
+"""Cognitive transformer tests against a local stub service.
+
+The reference's cognitive suites call live Azure endpoints with CI-vault keys
+(SURVEY.md §4 — the FLAKY shards); this environment is zero-egress, so a stub
+server verifies URL construction, key headers, payload shape, response parsing,
+and the error column.
+"""
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+import pytest
+
+from synapseml_tpu.core import Table
+from synapseml_tpu.cognitive import (
+    AnalyzeImage,
+    BingImageSearch,
+    DetectAnomalies,
+    DetectFace,
+    KeyPhraseExtractor,
+    LanguageDetector,
+    SimpleDetectAnomalies,
+    TextSentiment,
+    Translate,
+    VerifyFaces,
+)
+
+RECORDED = []
+
+
+@pytest.fixture(scope="module")
+def stub():
+    """Records every request; replies with a canned body per path."""
+
+    class H(BaseHTTPRequestHandler):
+        def _go(self, method):
+            n = int(self.headers.get("Content-Length") or 0)
+            body = self.rfile.read(n) if n else b""
+            RECORDED.append({
+                "method": method, "path": self.path,
+                "headers": dict(self.headers.items()), "body": body,
+            })
+            if "/fail" in self.path:
+                self.send_error(401, "bad key")
+                return
+            if "sentiment" in self.path:
+                out = {"documents": [{"id": "0", "sentiment": "positive"}]}
+            elif "languages" in self.path:
+                out = {"documents": [{"id": "0", "detectedLanguage": {"iso6391Name": "fr"}}]}
+            elif "translate" in self.path:
+                out = [{"translations": [{"text": "hola", "to": "es"}]}]
+            elif "detect" in self.path and "anomaly" in self.path:
+                out = {"isAnomaly": [False, False, True]}
+            else:
+                out = {"ok": True}
+            data = json.dumps(out).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+        def do_POST(self):
+            self._go("POST")
+
+        def do_GET(self):
+            self._go("GET")
+
+        def log_message(self, *a):
+            pass
+
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), H)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    yield f"http://127.0.0.1:{httpd.server_address[1]}"
+    httpd.shutdown()
+
+
+def test_text_sentiment(stub):
+    t = Table({"text": np.array(["i love tpus", "meh"], dtype=object)})
+    ts = TextSentiment(subscription_key="k123", url=stub + "/sentiment",
+                       output_col="sentiment")
+    out = ts.transform(t)
+    assert out["sentiment"][0]["documents"][0]["sentiment"] == "positive"
+    assert out["errors"][0] is None
+    # concurrent sends: locate each recorded body (arrival order is unordered)
+    bodies = [json.loads(r["body"]) for r in RECORDED[-2:]]
+    texts = {b["documents"][0]["text"] for b in bodies}
+    assert texts == {"i love tpus", "meh"}
+    assert all(b["documents"][0]["language"] == "en" for b in bodies)
+    assert RECORDED[-1]["headers"].get("Ocp-Apim-Subscription-Key") == "k123"
+
+
+def test_language_detector_and_key_col(stub):
+    t = Table({"text": np.array(["bonjour"], dtype=object),
+               "key": np.array(["rowkey"], dtype=object)})
+    ld = LanguageDetector(subscription_key_col="key", url=stub + "/languages")
+    out = ld.transform(t)
+    lang = out["output"][0]["documents"][0]["detectedLanguage"]["iso6391Name"]
+    assert lang == "fr"
+    assert RECORDED[-1]["headers"].get("Ocp-Apim-Subscription-Key") == "rowkey"
+
+
+def test_error_column_on_auth_failure(stub):
+    t = Table({"text": np.array(["x"], dtype=object)})
+    ts = KeyPhraseExtractor(subscription_key="bad", url=stub + "/fail",
+                            backoffs=[])
+    out = ts.transform(t)
+    assert out["output"][0] is None
+    assert out["errors"][0]["statusCode"] == 401
+
+
+def test_translate_query_params(stub):
+    t = Table({"text": np.array(["hello"], dtype=object)})
+    tr = Translate(subscription_key="k", url=stub + "/translate",
+                   to_language=["es", "fr"], location="eastus")
+    out = tr.transform(t)
+    assert out["output"][0][0]["translations"][0]["text"] == "hola"
+    req = RECORDED[-1]
+    assert "to=es" in req["path"] and "to=fr" in req["path"]
+    assert req["headers"].get("Ocp-Apim-Subscription-Region") == "eastus"
+    assert json.loads(req["body"]) == [{"Text": "hello"}]
+
+
+def test_analyze_image_url_and_bytes(stub):
+    t = Table({"img": np.array(["http://images/x.jpg"], dtype=object)})
+    ai = AnalyzeImage(subscription_key="k", url=stub + "/vision",
+                      image_url_col="img", visual_features=["Tags", "Faces"])
+    ai.transform(t)
+    req = RECORDED[-1]
+    assert json.loads(req["body"]) == {"url": "http://images/x.jpg"}
+    raw = np.empty(1, dtype=object)
+    raw[0] = b"\x89PNGdata"
+    t2 = Table({"imgb": raw})
+    AnalyzeImage(subscription_key="k", url=stub + "/vision",
+                 image_bytes_col="imgb").transform(t2)
+    req = RECORDED[-1]
+    assert req["body"] == b"\x89PNGdata"
+    assert req["headers"]["Content-Type"] == "application/octet-stream"
+
+
+def test_face_stages(stub):
+    raw = np.empty(1, dtype=object)
+    raw[0] = b"imgbytes"
+    DetectFace(subscription_key="k", url=stub + "/face",
+               image_bytes_col="i", return_face_attributes=["age"]).transform(
+        Table({"i": raw}))
+    assert "returnFaceAttributes=age" in RECORDED[-1]["path"]
+    VerifyFaces(subscription_key="k", url=stub + "/verify",
+                face_id1="a", face_id2="b").transform(Table({"x": np.zeros(1)}))
+    assert json.loads(RECORDED[-1]["body"]) == {"faceId1": "a", "faceId2": "b"}
+
+
+def test_anomaly_detection(stub):
+    series = np.empty(1, dtype=object)
+    series[0] = [{"timestamp": f"2024-01-0{i+1}T00:00:00Z", "value": v}
+                 for i, v in enumerate([1.0, 1.1, 9.9])]
+    out = DetectAnomalies(subscription_key="k",
+                          url=stub + "/anomalydetector/detect",
+                          series_col="series").transform(Table({"series": series}))
+    assert out["output"][0]["isAnomaly"] == [False, False, True]
+    body = json.loads(RECORDED[-1]["body"])
+    assert body["granularity"] == "monthly" and len(body["series"]) == 3
+
+
+def test_simple_detect_anomalies_grouping(stub):
+    t = Table({
+        "timestamp": np.array([f"2024-01-0{i}T00:00:00Z" for i in (1, 2, 3, 1, 2, 3)],
+                              dtype=object),
+        "value": np.array([1.0, 1.1, 9.9, 2.0, 2.1, 2.0]),
+        "group": np.array(["a", "a", "a", "b", "b", "b"], dtype=object),
+    })
+    out = SimpleDetectAnomalies(subscription_key="k",
+                                url=stub + "/anomalydetector/detect").transform(t)
+    assert out["output"][2]["isAnomaly"] is True
+    assert out["output"][0]["isAnomaly"] is False
+
+
+def test_bing_image_search_get(stub):
+    t = Table({"q": np.array(["tpu chips"], dtype=object)})
+    BingImageSearch(subscription_key="k", url=stub + "/images",
+                    query_col="q", count=3).transform(t)
+    req = RECORDED[-1]
+    assert req["method"] == "GET"
+    assert "q=tpu+chips" in req["path"] and "count=3" in req["path"]
+
+
+def test_missing_column_for_service_param(stub):
+    t = Table({"other": np.zeros(2)})
+    ts = TextSentiment(subscription_key="k", url=stub, text_col="nope")
+    with pytest.raises(ValueError, match="nope"):
+        ts.transform(t)

@@ -1,0 +1,243 @@
+"""HTTP client/transformer + serving tests against real local servers.
+
+Reference suite analogues: `core/src/test/.../io/split1/HTTPTransformerSuite` and
+`split2/{HTTPSuite,DistributedHTTPSuite}.scala` (spin up real servers, hit them
+with sync/async clients, fault-tolerance).
+"""
+
+import json
+import threading
+import time
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+import pytest
+
+from synapseml_tpu.core import Table, Transformer, Param
+from synapseml_tpu.io import (
+    AsyncHTTPClient,
+    HTTPRequestData,
+    HTTPResponseData,
+    HTTPTransformer,
+    JSONInputParser,
+    JSONOutputParser,
+    SimpleHTTPTransformer,
+    send_request,
+    send_with_retries,
+    serve,
+    string_to_response,
+)
+
+
+@pytest.fixture(scope="module")
+def echo_server():
+    """JSON echo server; /fail404 404s; /flaky fails twice per path then succeeds."""
+    flaky_counts = {}
+    lock = threading.Lock()
+
+    class H(BaseHTTPRequestHandler):
+        def do_POST(self):
+            n = int(self.headers.get("Content-Length") or 0)
+            body = self.rfile.read(n)
+            if self.path.startswith("/fail404"):
+                self.send_error(404, "nope")
+                return
+            if self.path.startswith("/flaky"):
+                with lock:
+                    c = flaky_counts.get(self.path, 0) + 1
+                    flaky_counts[self.path] = c
+                if c <= 2:
+                    self.send_error(503, "warming up")
+                    return
+            payload = json.loads(body or b"{}")
+            out = json.dumps({"echo": payload, "n": len(body)}).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(out)))
+            self.end_headers()
+            self.wfile.write(out)
+
+        def log_message(self, *a):
+            pass
+
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), H)
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    yield f"http://127.0.0.1:{httpd.server_address[1]}"
+    httpd.shutdown()
+
+
+def test_send_request_roundtrip(echo_server):
+    resp = send_request(HTTPRequestData(
+        url=echo_server + "/x", method="POST",
+        headers={"Content-Type": "application/json"}, entity=b'{"a": 1}'))
+    assert resp.status_code == 200
+    assert json.loads(resp.text) == {"echo": {"a": 1}, "n": 8}
+
+
+def test_http_error_as_response(echo_server):
+    resp = send_request(HTTPRequestData(url=echo_server + "/fail404",
+                                        method="POST", entity=b"{}"))
+    assert resp.status_code == 404
+
+
+def test_connection_error_as_response():
+    resp = send_request(HTTPRequestData(url="http://127.0.0.1:9/", method="POST"))
+    assert resp.status_code == 0
+    assert "connection error" in resp.reason
+
+
+def test_retries_eventually_succeed(echo_server):
+    resp = send_with_retries(
+        HTTPRequestData(url=echo_server + "/flaky/a", method="POST", entity=b"{}"),
+        backoffs_ms=(10, 10, 10))
+    assert resp.status_code == 200  # failed twice, third retry lands
+
+
+def test_async_client_order_preserved(echo_server):
+    reqs = [HTTPRequestData(url=echo_server + "/x", method="POST",
+                            headers={"Content-Type": "application/json"},
+                            entity=json.dumps({"i": i}).encode())
+            for i in range(20)]
+    reqs[3] = None  # None passes through
+    out = AsyncHTTPClient(concurrency=5).send_all(reqs)
+    assert out[3] is None
+    for i, resp in enumerate(out):
+        if i == 3:
+            continue
+        assert json.loads(resp.text)["echo"]["i"] == i
+
+
+def test_http_transformer(echo_server):
+    reqs = np.empty(3, dtype=object)
+    for i in range(3):
+        reqs[i] = HTTPRequestData(url=echo_server, method="POST",
+                                  entity=json.dumps({"i": i}).encode())
+    t = Table({"request": reqs})
+    out = HTTPTransformer(input_col="request", output_col="response").transform(t)
+    assert all(r.status_code == 200 for r in out["response"])
+
+
+def test_simple_http_transformer_with_errors(echo_server):
+    payloads = np.empty(4, dtype=object)
+    payloads[:] = [{"q": 1}, {"q": 2}, {"q": 3}, {"q": 4}]
+    t = Table({"input": payloads})
+    # two good rows, then swap the URL per-row is not supported -> use fail url for all
+    good = SimpleHTTPTransformer(input_col="input", output_col="out",
+                                 url=echo_server + "/ok").transform(t)
+    assert all(v["echo"]["q"] == i + 1 for i, v in enumerate(good["out"]))
+    assert all(e is None for e in good["errors"])
+    bad = SimpleHTTPTransformer(input_col="input", output_col="out",
+                                url=echo_server + "/fail404",
+                                backoffs=[]).transform(t)
+    assert all(v is None for v in bad["out"])
+    assert all(e["statusCode"] == 404 for e in bad["errors"])
+
+
+def test_json_parsers(echo_server):
+    t = Table({"input": np.array([{"a": 1}], dtype=object)})
+    st = JSONInputParser(input_col="input", output_col="req", url=echo_server)
+    tt = st.transform(t)
+    assert isinstance(tt["req"][0], HTTPRequestData)
+    resp = np.empty(1, dtype=object)
+    resp[0] = HTTPResponseData(200, "OK", {}, b'{"x": [1, 2]}')
+    parsed = JSONOutputParser(input_col="resp", output_col="out").transform(
+        Table({"resp": resp}))
+    assert parsed["out"][0] == {"x": [1, 2]}
+
+
+# -- serving ------------------------------------------------------------------------
+
+class _UppercaseReply(Transformer):
+    """Test pipeline: reply with the uppercased request body."""
+
+    def _transform(self, table):
+        reqs = table["request"]
+        out = np.empty(len(reqs), dtype=object)
+        for i, r in enumerate(reqs):
+            body = (r.entity or b"").decode()
+            out[i] = string_to_response(body.upper())
+        return table.with_column("reply", out)
+
+
+def test_serving_end_to_end():
+    engine = serve(_UppercaseReply(), port=0)
+    try:
+        url = engine.server.address
+        req = urllib.request.Request(url, data=b"hello tpu", method="POST")
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            assert resp.read() == b"HELLO TPU"
+        # concurrent clients
+        results = []
+
+        def hit(i):
+            r = urllib.request.Request(url, data=f"msg{i}".encode(), method="POST")
+            with urllib.request.urlopen(r, timeout=10) as resp:
+                results.append(resp.read().decode())
+
+        threads = [threading.Thread(target=hit, args=(i,)) for i in range(16)]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        assert sorted(results) == sorted(f"MSG{i}" for i in range(16))
+        assert engine.server.requests_received == 17
+        assert engine.server.responses_sent == 17
+    finally:
+        engine.stop()
+
+
+class _BoomReply(Transformer):
+    def _transform(self, table):
+        raise RuntimeError("boom")
+
+
+def test_serving_pipeline_error_returns_500():
+    engine = serve(_BoomReply(), port=0)
+    try:
+        req = urllib.request.Request(engine.server.address, data=b"x", method="POST")
+        try:
+            urllib.request.urlopen(req, timeout=10)
+            raise AssertionError("expected HTTP error")
+        except urllib.error.HTTPError as e:
+            assert e.code == 500
+            assert b"boom" in e.read()
+    finally:
+        engine.stop()
+
+
+def test_serving_json_pipeline_with_model():
+    """Pipeline: JSON request -> GBDT model score -> JSON reply (the reference's
+    flagship serving demo shape)."""
+    from synapseml_tpu.gbdt import LightGBMClassifier
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(500, 4))
+    y = (x[:, 0] > 0).astype(float)
+    model = LightGBMClassifier(num_iterations=10, num_leaves=7).fit(
+        Table({"features": x, "label": y}))
+
+    class ScoreReply(Transformer):
+        def _transform(self, table):
+            reqs = table["request"]
+            feats = np.array([json.loads(r.entity)["features"] for r in reqs])
+            scored = model.transform(Table({"features": feats}))
+            out = np.empty(len(reqs), dtype=object)
+            for i in range(len(reqs)):
+                out[i] = {"probability": float(scored["probability"][i, 1]),
+                          "prediction": float(scored["prediction"][i])}
+            return table.with_column("reply", out)
+
+    engine = serve(ScoreReply(), port=0)
+    try:
+        req = urllib.request.Request(
+            engine.server.address,
+            data=json.dumps({"features": [2.0, 0.0, 0.0, 0.0]}).encode(),
+            method="POST", headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=15) as resp:
+            body = json.loads(resp.read())
+        assert body["prediction"] == 1.0
+        assert body["probability"] > 0.5
+    finally:
+        engine.stop()
