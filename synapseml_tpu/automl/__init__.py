@@ -1,0 +1,13 @@
+"""Hyperparameter tuning + model selection (reference ``core/.../automl/``)."""
+
+from .stages import (
+    BestModel, DefaultHyperparams, DiscreteHyperParam, FindBestModel, GridSpace,
+    HyperparamBuilder, RandomSpace, RangeHyperParam, TuneHyperparameters,
+    TuneHyperparametersModel,
+)
+
+__all__ = [
+    "DiscreteHyperParam", "RangeHyperParam", "HyperparamBuilder", "GridSpace",
+    "RandomSpace", "DefaultHyperparams", "TuneHyperparameters",
+    "TuneHyperparametersModel", "FindBestModel", "BestModel",
+]

@@ -1,0 +1,253 @@
+"""Hyperparameter search + model selection.
+
+Reference: ``core/.../automl/`` (773 LoC) — ``TuneHyperparameters.scala:36-225``
+(thread-pool-parallel random/grid search with train/validation metric
+selection), ``ParamSpace.scala`` (``GridSpace``/``RandomSpace``),
+``HyperparamBuilder``, ``DefaultHyperparams``, ``FindBestModel.scala``.
+"""
+
+from __future__ import annotations
+
+import copy
+from concurrent.futures import ThreadPoolExecutor
+from itertools import product
+from typing import Any, Dict, Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..core import ComplexParam, Estimator, Model, Param, Table, Transformer
+from ..gbdt.boost import METRICS
+
+__all__ = [
+    "DiscreteHyperParam", "RangeHyperParam", "HyperparamBuilder",
+    "GridSpace", "RandomSpace", "DefaultHyperparams",
+    "TuneHyperparameters", "TuneHyperparametersModel",
+    "FindBestModel", "BestModel",
+]
+
+
+class DiscreteHyperParam:
+    """A finite set of candidate values (reference ``DiscreteHyperParam``)."""
+
+    def __init__(self, values: Sequence):
+        self.values = list(values)
+
+    def sample(self, rng) -> Any:
+        return self.values[rng.integers(0, len(self.values))]
+
+
+class RangeHyperParam:
+    """A numeric range, sampled uniformly (reference ``RangeHyperParam``)."""
+
+    def __init__(self, low, high, is_int: Optional[bool] = None):
+        self.low, self.high = low, high
+        self.is_int = (isinstance(low, (int, np.integer))
+                       and isinstance(high, (int, np.integer))
+                       if is_int is None else is_int)
+
+    def sample(self, rng) -> Any:
+        if self.is_int:
+            return int(rng.integers(self.low, self.high + 1))
+        return float(rng.uniform(self.low, self.high))
+
+    def grid(self, n: int = 5) -> List:
+        vals = np.linspace(self.low, self.high, n)
+        return [int(round(v)) for v in vals] if self.is_int else [float(v) for v in vals]
+
+
+class HyperparamBuilder:
+    """Collects (param name -> space) pairs (reference ``HyperparamBuilder``)."""
+
+    def __init__(self):
+        self._spaces: Dict[str, Any] = {}
+
+    def add_hyperparam(self, name: str, space) -> "HyperparamBuilder":
+        self._spaces[name] = space
+        return self
+
+    def build(self) -> Dict[str, Any]:
+        return dict(self._spaces)
+
+
+class GridSpace:
+    """Cartesian product of discrete spaces (reference ``GridSpace``)."""
+
+    def __init__(self, spaces: Dict[str, Any], range_points: int = 5):
+        self.spaces = spaces
+        self.range_points = range_points
+
+    def param_maps(self) -> Iterator[Dict[str, Any]]:
+        names = list(self.spaces)
+        value_lists = []
+        for n in names:
+            sp = self.spaces[n]
+            if isinstance(sp, DiscreteHyperParam):
+                value_lists.append(sp.values)
+            elif isinstance(sp, RangeHyperParam):
+                value_lists.append(sp.grid(self.range_points))
+            else:
+                value_lists.append(list(sp))
+        for combo in product(*value_lists):
+            yield dict(zip(names, combo))
+
+
+class RandomSpace:
+    """Random draws from each space (reference ``RandomSpace``)."""
+
+    def __init__(self, spaces: Dict[str, Any], seed: int = 0):
+        self.spaces = spaces
+        self.rng = np.random.default_rng(seed)
+
+    def param_maps(self) -> Iterator[Dict[str, Any]]:
+        while True:
+            out = {}
+            for n, sp in self.spaces.items():
+                if isinstance(sp, (DiscreteHyperParam, RangeHyperParam)):
+                    out[n] = sp.sample(self.rng)
+                else:
+                    out[n] = sp[self.rng.integers(0, len(sp))]
+            yield out
+
+
+class DefaultHyperparams:
+    """Per-learner default search spaces (reference ``DefaultHyperparams``)."""
+
+    @staticmethod
+    def lightgbm() -> Dict[str, Any]:
+        return {
+            "num_leaves": DiscreteHyperParam([15, 31, 63]),
+            "learning_rate": RangeHyperParam(0.05, 0.3),
+            "num_iterations": DiscreteHyperParam([50, 100]),
+        }
+
+    @staticmethod
+    def vw() -> Dict[str, Any]:
+        return {
+            "learning_rate": RangeHyperParam(0.1, 1.0),
+            "num_passes": DiscreteHyperParam([1, 3, 5]),
+        }
+
+
+def _auc_metric(y, score, w):
+    return METRICS["auc"][0](y, score, w)
+
+
+_EVAL = {
+    "auc": (True, "classification"),
+    "accuracy": (True, "classification"),
+    "rmse": (False, "regression"),
+    "l1": (False, "regression"),
+    "l2": (False, "regression"),
+}
+
+
+def _evaluate(model, val: Table, metric: str, label_col: str) -> float:
+    scored = model.transform(val)
+    y = np.asarray(scored[label_col])
+    higher, kind = _EVAL[metric]
+    if kind == "classification":
+        if metric == "auc":
+            prob = np.asarray(scored["probability"])
+            score = prob[:, 1] if prob.ndim == 2 else prob
+            classes = np.unique(y)
+            y_bin = (y == classes[-1]).astype(np.float64)
+            return _auc_metric(y_bin, score.astype(np.float64), np.ones(len(y)))
+        pred = scored["prediction"]
+        return float(np.mean([a == b for a, b in zip(y.tolist(), pred.tolist())]))
+    pred = np.asarray(scored["prediction"], np.float64)
+    yv = y.astype(np.float64)
+    fn, _ = METRICS[metric]
+    return fn(yv, pred, np.ones(len(yv)))
+
+
+class TuneHyperparameters(Estimator):
+    """Parallel random/grid search over estimator param spaces
+    (reference ``TuneHyperparameters.scala:36-225``; executor pool ``:97-122``)."""
+
+    models = ComplexParam("estimator (or list) to tune", object, default=None)
+    hyperparams = ComplexParam("param name -> space dict (HyperparamBuilder."
+                               "build())", object, default=None)
+    search_mode = Param("random | grid", str, default="random")
+    number_of_runs = Param("evaluations for random search", int, default=10)
+    parallelism = Param("concurrent fits", int, default=4)
+    evaluation_metric = Param("auc | accuracy | rmse | l1 | l2", str, default="auc")
+    label_col = Param("label column", str, default="label")
+    train_ratio = Param("train fraction (rest validates)", float, default=0.75)
+    seed = Param("seed", int, default=0)
+
+    def _fit(self, table: Table) -> "TuneHyperparametersModel":
+        if self.models is None or self.hyperparams is None:
+            raise ValueError(f"TuneHyperparameters({self.uid}): set models and "
+                             f"hyperparams")
+        estimators = self.models if isinstance(self.models, list) else [self.models]
+        train, val = table.random_split([self.train_ratio, 1 - self.train_ratio],
+                                        seed=self.seed)
+        if self.search_mode == "grid":
+            space = GridSpace(self.hyperparams)
+            maps = list(space.param_maps())
+        else:
+            space = RandomSpace(self.hyperparams, seed=self.seed)
+            it = space.param_maps()
+            maps = [next(it) for _ in range(self.number_of_runs)]
+
+        higher, _ = _EVAL[self.evaluation_metric]
+        jobs: List[Tuple[Any, Dict[str, Any]]] = [
+            (est, pm) for est in estimators for pm in maps
+        ]
+
+        def run(job):
+            est, pm = job
+            cand = copy.deepcopy(est)
+            for k, v in pm.items():
+                cand.set(k, v)
+            m = cand.fit(train)
+            metric = _evaluate(m, val, self.evaluation_metric, self.label_col)
+            return m, pm, metric
+
+        with ThreadPoolExecutor(max_workers=self.parallelism) as pool:
+            results = list(pool.map(run, jobs))
+        best = max(results, key=lambda r: r[2] if higher else -r[2])
+        model, params, metric = best
+        return TuneHyperparametersModel(
+            best_model=model, best_params=params, best_metric=float(metric),
+            history=[{"params": p, "metric": float(m)} for _, p, m in results])
+
+
+class TuneHyperparametersModel(Model):
+    best_model = ComplexParam("winning fitted model", object, default=None)
+    best_params = ComplexParam("winning param map", object, default=None)
+    best_metric = Param("winning validation metric", float, default=0.0)
+    history = ComplexParam("all (params, metric) evaluations", object, default=[])
+
+    def _transform(self, table: Table) -> Table:
+        return self.best_model.transform(table)
+
+
+class FindBestModel(Estimator):
+    """Pick the best of several FITTED models on an evaluation table
+    (reference ``FindBestModel.scala``)."""
+
+    models = ComplexParam("list of fitted models", object, default=None)
+    evaluation_metric = Param("auc | accuracy | rmse | l1 | l2", str, default="auc")
+    label_col = Param("label column", str, default="label")
+
+    def _fit(self, table: Table) -> "BestModel":
+        if not self.models:
+            raise ValueError(f"FindBestModel({self.uid}): models is empty")
+        higher, _ = _EVAL[self.evaluation_metric]
+        scored = [
+            (m, _evaluate(m, table, self.evaluation_metric, self.label_col))
+            for m in self.models
+        ]
+        best, metric = max(scored, key=lambda r: r[1] if higher else -r[1])
+        return BestModel(best_model=best, best_metric=float(metric),
+                         all_metrics=[float(v) for _, v in scored])
+
+
+class BestModel(Model):
+    best_model = ComplexParam("winning model", object, default=None)
+    best_metric = Param("winning metric", float, default=0.0)
+    all_metrics = ComplexParam("metric per candidate", object, default=[])
+
+    def _transform(self, table: Table) -> Table:
+        return self.best_model.transform(table)

@@ -1,0 +1,15 @@
+"""Auto-featurization (reference ``core/.../featurize/``, SURVEY.md §2.3)."""
+
+from .stages import (
+    CleanMissingData, CleanMissingDataModel, CountSelector, CountSelectorModel,
+    DataConversion, Featurize, FeaturizeModel, IndexToValue, ValueIndexer,
+    ValueIndexerModel,
+)
+from .text import MultiNGram, PageSplitter, TextFeaturizer, TextFeaturizerModel
+
+__all__ = [
+    "CleanMissingData", "CleanMissingDataModel", "ValueIndexer",
+    "ValueIndexerModel", "IndexToValue", "DataConversion", "CountSelector",
+    "CountSelectorModel", "Featurize", "FeaturizeModel",
+    "TextFeaturizer", "TextFeaturizerModel", "MultiNGram", "PageSplitter",
+]
