@@ -101,3 +101,16 @@ def test_clear_shared_pool_keeps_locks():
     clear_shared_pool("t2-")
     assert "t2-key" in _key_locks  # lock retained, value cleared
     assert shared_singleton("t2-key", lambda: 2) == 2
+
+
+def test_graft_entry_dryrun_multichip_in_process():
+    """The driver's multi-chip gate: with 8 visible devices the impl runs
+    in-process; with fewer it must self-provision a virtual CPU mesh (the
+    subprocess path is exercised by the driver itself)."""
+    import sys
+    sys.path.insert(0, "/root/repo")
+    try:
+        import __graft_entry__ as g
+        g.dryrun_multichip(8)
+    finally:
+        sys.path.remove("/root/repo")
