@@ -239,7 +239,14 @@ class FeaturizeModel(Model):
                 parts.append(out)
             else:  # hash: token-hash strings into a fixed space
                 dim = 1 << spec["bits"]
-                dim = min(dim, 4096)  # dense assembly cap; big spaces stay sparse upstream
+                if dim > 4096:
+                    import warnings
+                    warnings.warn(
+                        f"hash space 2^{spec['bits']} exceeds the dense-assembly "
+                        "cap of 4096; indices are folded into 4096 dims (higher "
+                        "collision rate). Use VowpalWabbitFeaturizer for a true "
+                        "sparse space.", stacklevel=2)
+                    dim = 4096
                 out = np.zeros((n, dim), np.float64)
                 for r, v in enumerate(col.tolist()):
                     if v is None:

@@ -661,6 +661,16 @@ def train(params: Dict[str, Any], x: np.ndarray, y: np.ndarray,
     boosting = p["boosting"]
     if boosting not in ("gbdt", "goss", "dart", "rf"):
         raise ValueError(f"boosting must be gbdt|goss|dart|rf, got {boosting!r}")
+    if boosting == "dart" and int(p["early_stopping_round"]) > 0:
+        # DART keeps rescaling earlier trees after best_iteration, so truncated
+        # prediction can't reproduce the margins that early stopping evaluated;
+        # LightGBM disallows the combination for the same reason. We train all
+        # iterations and never set best_iteration (no truncation).
+        import warnings
+        warnings.warn("early_stopping_round is ignored with boosting='dart': "
+                      "DART rescales earlier trees after the best iteration, so "
+                      "truncating at best_iteration is not reproducible",
+                      stacklevel=2)
     if boosting == "rf" and not (float(p["bagging_fraction"]) < 1.0
                                  and int(p["bagging_freq"]) > 0):
         # without bagging every rf tree sees identical gradients -> T copies of
@@ -806,7 +816,7 @@ def train(params: Dict[str, Any], x: np.ndarray, y: np.ndarray,
                                eraw0))
     best_metric = -np.inf if higher_better else np.inf
     best_iter = 0
-    patience = int(p["early_stopping_round"])
+    patience = 0 if boosting == "dart" else int(p["early_stopping_round"])
     min_delta = float(p["early_stopping_min_delta"])
 
     # dart state

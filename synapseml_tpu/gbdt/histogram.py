@@ -55,10 +55,13 @@ def _hist_onehot(binned, ghc, n_bins, chunk):
 
     def body(acc, xs):
         b, g = xs
-        onehot = (b[:, :, None] == bins).astype(jnp.bfloat16)  # (chunk, d, B)
+        # One-hot is exactly representable in bf16; the grad/hess/count panel
+        # stays f32 so per-row gradients aren't quantized (split gains then
+        # match the f32 scatter path — TPU and CPU grow identical trees).
+        onehot = (b[:, :, None] == bins).astype(jnp.float32)  # (chunk, d, B)
         # (d*B, chunk) @ (chunk, 3) on the MXU, f32 accumulation
         contrib = jax.lax.dot_general(
-            onehot, g.astype(jnp.bfloat16),
+            onehot, g,
             (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )  # (d, B, 3)

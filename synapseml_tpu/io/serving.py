@@ -83,8 +83,15 @@ class ServingServer:
                     return
                 resp = slot.response
                 self.send_response(resp.status_code or 200)
+                # Content-Length is computed below; hop-by-hop headers are the
+                # server's to manage (RFC 7230 §6.1) — forwarding either from a
+                # pipeline-supplied response would emit duplicates/mis-framing.
+                skip = {"content-length", "transfer-encoding", "connection",
+                        "keep-alive", "upgrade", "proxy-authenticate",
+                        "proxy-authorization", "te", "trailer"}
                 for k, v in resp.headers.items():
-                    self.send_header(k, v)
+                    if k.lower() not in skip:
+                        self.send_header(k, v)
                 ent = resp.entity or b""
                 self.send_header("Content-Length", str(len(ent)))
                 self.end_headers()

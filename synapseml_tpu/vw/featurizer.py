@@ -136,11 +136,20 @@ class VowpalWabbitInteractions(Transformer):
     """Quadratic feature crosses between sparse columns
     (reference ``VowpalWabbitInteractions.scala``; VW ``-q``/``--interactions``).
 
-    Cross indices combine the paired feature hashes with VW's multiply-and-mix;
-    values multiply."""
+    Cross indices combine the paired feature hashes with VW's FNV-1 scheme
+    ``(h1 * 16777619) ^ h2`` (reference ``VowpalWabbitInteractions.scala``
+    ``fnvPrime``), masked to ``2^num_bits``; values multiply. With
+    ``sum_collisions`` (reference ``sumCollisions``) colliding cross indices are
+    merged by summing their values."""
 
     input_cols = Param("sparse columns to cross (2+)", list, default=[])
     output_col = Param("output sparse column", str, default="interactions")
+    num_bits = Param("mask cross indices into 2^b space (reference numBits)", int,
+                     default=30, validator=ParamValidators.in_range(1, 32))
+    sum_collisions = Param("sum values of colliding cross indices "
+                           "(reference sumCollisions)", bool, default=True)
+
+    _FNV_PRIME = np.uint64(16777619)
 
     def _transform(self, table: Table) -> Table:
         cols = self.input_cols
@@ -148,6 +157,7 @@ class VowpalWabbitInteractions(Transformer):
             raise ValueError(f"{type(self).__name__}({self.uid}): need >= 2 input_cols")
         self._validate_input(table, *cols)
         n = table.num_rows
+        mask = np.uint64((1 << self.num_bits) - 1)
         out = np.empty(n, dtype=object)
         for r in range(n):
             idx, val = None, None
@@ -156,10 +166,16 @@ class VowpalWabbitInteractions(Transformer):
                 if idx is None:
                     idx, val = ci.astype(np.uint64), cv.astype(np.float32)
                 else:
-                    # VW-style quadratic combine: h = h1 * magic + h2
-                    cross = (idx[:, None] * np.uint64(0x5BD1E995)
-                             + ci[None, :].astype(np.uint64))
+                    # FNV-1: h = (h1 * prime) ^ h2, matching the reference
+                    cross = ((idx[:, None] * self._FNV_PRIME)
+                             ^ ci[None, :].astype(np.uint64))
                     idx = (cross & np.uint64(0xFFFFFFFF)).ravel()
                     val = (val[:, None] * cv[None, :]).ravel()
+            idx = idx & mask
+            if self.sum_collisions and len(idx):
+                uniq, inv = np.unique(idx, return_inverse=True)
+                sums = np.zeros(len(uniq), np.float32)
+                np.add.at(sums, inv, val)
+                idx, val = uniq, sums
             out[r] = (idx.astype(np.uint32), val.astype(np.float32))
         return table.with_column(self.output_col, out, meta=sparse_meta())
