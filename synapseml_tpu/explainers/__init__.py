@@ -1,0 +1,27 @@
+"""Model-agnostic local explainers (LIME, KernelSHAP, ICE) + superpixels.
+
+TPU-native rebuild of the reference's flagship explainability stack
+(``core/.../explainers/``, 2,660 LoC, plus the v1 ``lime/`` package): batched
+sample generation, ONE model call per explainer invocation, and all per-row
+weighted lasso / least-squares fits vmapped into a single JAX kernel.
+"""
+
+from .base import KernelSHAPBase, LIMEBase, LocalExplainer
+from .ice import ICECategoricalFeature, ICENumericFeature, ICETransformer
+from .lime import ImageLIME, TabularLIME, TextLIME, VectorLIME
+from .regression import RegressionResult, fit_regression, fit_regression_batch
+from .samplers import effective_num_samples, kernel_shap_coalitions
+from .shap import ImageSHAP, TabularSHAP, TextSHAP, VectorSHAP
+from .stats import ContinuousFeatureStats, DiscreteFeatureStats, collect_feature_stats
+from .superpixel import SuperpixelData, SuperpixelTransformer, mask_image, slic_superpixels
+
+__all__ = [
+    "LocalExplainer", "LIMEBase", "KernelSHAPBase",
+    "TabularLIME", "VectorLIME", "TextLIME", "ImageLIME",
+    "TabularSHAP", "VectorSHAP", "TextSHAP", "ImageSHAP",
+    "ICETransformer", "ICECategoricalFeature", "ICENumericFeature",
+    "SuperpixelTransformer", "SuperpixelData", "slic_superpixels", "mask_image",
+    "RegressionResult", "fit_regression", "fit_regression_batch",
+    "ContinuousFeatureStats", "DiscreteFeatureStats", "collect_feature_stats",
+    "effective_num_samples", "kernel_shap_coalitions",
+]

@@ -1,0 +1,360 @@
+"""Explainer tests: regression core, LIME/SHAP fidelity + additivity, ICE, superpixels.
+
+Mirrors the reference's explainer suites (``core/src/test/.../explainers/``):
+local fidelity of LIME on linear models, SHAP additivity
+(sum of contributions + intercept == model output at the instance), and
+behavioral checks on a fitted LightGBMClassifier.
+"""
+
+import numpy as np
+import pytest
+
+from synapseml_tpu.core import Table, Transformer, Param
+from synapseml_tpu.explainers import (
+    ICETransformer, ImageLIME, ImageSHAP, TabularLIME, TabularSHAP, TextLIME,
+    TextSHAP, VectorLIME, VectorSHAP, fit_regression, fit_regression_batch,
+    kernel_shap_coalitions, effective_num_samples, slic_superpixels, mask_image,
+)
+
+
+class _LinearVecModel(Transformer):
+    """probability := sigmoid-free linear score of the features vector."""
+
+    input_col = Param("in", str, default="features")
+    beta = Param("coefficients", list, default=[])
+    bias = Param("bias", float, default=0.0)
+
+    def _transform(self, t):
+        x = np.asarray(t[self.input_col], np.float64)
+        y = x @ np.asarray(self.beta) + self.bias
+        return t.with_column("probability", y)
+
+
+class _LinearColsModel(Transformer):
+    input_cols = Param("in", list, default=[])
+    beta = Param("coefficients", list, default=[])
+
+    def _transform(self, t):
+        y = sum(b * np.asarray(t[c], np.float64)
+                for c, b in zip(self.input_cols, self.beta))
+        return t.with_column("probability", np.asarray(y))
+
+
+# -- regression core ----------------------------------------------------------------
+
+
+def test_weighted_least_squares_exact():
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(200, 4))
+    beta = np.array([1.5, -2.0, 0.5, 3.0])
+    y = X @ beta + 0.7
+    res = fit_regression(X, y, alpha=0.0)
+    np.testing.assert_allclose(res.coefficients, beta, atol=1e-3)
+    np.testing.assert_allclose(res.intercept, 0.7, atol=1e-3)
+    assert res.r_squared > 0.999
+
+
+def test_weights_downweight_outliers():
+    rng = np.random.default_rng(1)
+    X = rng.normal(size=(100, 2))
+    y = X @ np.array([1.0, 2.0])
+    y_out = y.copy()
+    y_out[:10] += 50.0                      # corrupted rows
+    w = np.ones(100)
+    w[:10] = 1e-8
+    res = fit_regression(X, y_out, w)
+    np.testing.assert_allclose(res.coefficients, [1.0, 2.0], atol=1e-3)
+
+
+def test_lasso_shrinks_irrelevant():
+    rng = np.random.default_rng(2)
+    X = rng.normal(size=(300, 6))
+    y = 2.0 * X[:, 0] - 1.0 * X[:, 1]       # features 2..5 irrelevant
+    res = fit_regression(X, y, alpha=0.05)
+    assert abs(res.coefficients[0]) > 1.0
+    assert np.all(np.abs(res.coefficients[2:]) < 0.05)
+
+
+def test_zero_variance_column_zero_coef():
+    rng = np.random.default_rng(3)
+    X = rng.normal(size=(50, 3))
+    X[:, 1] = 0.0
+    y = X[:, 0]
+    for alpha in (0.0, 0.01):
+        res = fit_regression(X, y, alpha=alpha)
+        assert abs(res.coefficients[1]) < 1e-6
+
+
+def test_batch_matches_single():
+    rng = np.random.default_rng(4)
+    X = rng.normal(size=(3, 80, 4))
+    Y = rng.normal(size=(3, 80, 2))
+    w = rng.random((3, 80)) + 0.5
+    batch = fit_regression_batch(X, Y, w, alpha=0.0)
+    for i in range(3):
+        for t in range(2):
+            single = fit_regression(X[i], Y[i, :, t], w[i], alpha=0.0)
+            np.testing.assert_allclose(batch.coefficients[i, t],
+                                       single.coefficients, atol=1e-4)
+            np.testing.assert_allclose(batch.r_squared[i, t],
+                                       single.r_squared, atol=1e-4)
+
+
+# -- coalition sampler --------------------------------------------------------------
+
+
+def test_effective_num_samples_clamps():
+    assert effective_num_samples(None, 5) == 2 ** 5      # capped by 2^m
+    assert effective_num_samples(3, 8) == 10             # raised to m+2
+    assert effective_num_samples(None, 100) == 2 * 100 + 2048
+
+
+def test_coalitions_structure():
+    rng = np.random.default_rng(0)
+    S, w = kernel_shap_coalitions(rng, 6, 40, inf_weight=1e8)
+    assert S.shape == (40, 6)
+    np.testing.assert_allclose(S[0], 0)                  # empty coalition
+    np.testing.assert_allclose(S[1], 1)                  # full coalition
+    assert w[0] == w[1] == 1e8
+    assert np.all((S == 0) | (S == 1))
+    sizes = S[2:].sum(1)
+    assert np.all((sizes >= 1) & (sizes <= 5))           # strict subsets
+
+
+# -- vector LIME / SHAP -------------------------------------------------------------
+
+
+def test_vector_lime_recovers_linear_model():
+    rng = np.random.default_rng(5)
+    beta = [2.0, -3.0, 0.0, 1.0]
+    X = rng.normal(size=(6, 4))
+    t = Table({"features": X})
+    model = _LinearVecModel(beta=beta, bias=0.5)
+    lime = VectorLIME(model=model, target_col="probability", num_samples=300,
+                      output_col="weights", seed=1)
+    out = lime.transform(t)
+    for i in range(6):
+        np.testing.assert_allclose(out["weights"][i][0], beta, atol=0.15)
+        assert out["r2"][i][0] > 0.99
+
+
+def test_vector_shap_additivity_and_values():
+    """For a linear model f and background B: phi_j = beta_j*(x_j - mean(B_j)),
+    intercept = f(mean(B)); sum(phi) + intercept = f(x)."""
+    rng = np.random.default_rng(6)
+    beta = np.array([1.0, -2.0, 3.0])
+    X = rng.normal(size=(4, 3))
+    bgX = rng.normal(size=(16, 3))
+    model = _LinearVecModel(beta=list(beta), bias=0.25)
+    shap = VectorSHAP(model=model, target_col="probability",
+                      background_data=Table({"features": bgX}),
+                      output_col="shap", seed=2)
+    out = shap.transform(Table({"features": X}))
+    bg_mean = bgX.mean(0)
+    for i in range(4):
+        row = out["shap"][i][0]              # (1 + k): intercept first
+        intercept, phi = row[0], row[1:]
+        fx = X[i] @ beta + 0.25
+        np.testing.assert_allclose(intercept + phi.sum(), fx, atol=1e-3)
+        np.testing.assert_allclose(phi, beta * (X[i] - bg_mean), atol=1e-3)
+        assert out["r2"][i][0] > 0.999
+
+
+# -- tabular LIME / SHAP ------------------------------------------------------------
+
+
+def test_tabular_lime_continuous_and_categorical():
+    rng = np.random.default_rng(7)
+    n = 8
+    a = rng.normal(size=n)
+    cat = np.array(["x", "y"] * (n // 2), dtype=object)
+
+    class M(Transformer):
+        def _transform(self, t):
+            bonus = (t["c"].astype(object) == "x").astype(np.float64)
+            return t.with_column("probability",
+                                 2.0 * np.asarray(t["a"], np.float64) + 5.0 * bonus)
+
+    bg = Table({"a": rng.normal(size=100),
+                "c": np.array(["x"] * 50 + ["y"] * 50, dtype=object)})
+    lime = TabularLIME(model=M(), input_cols=["a", "c"], categorical_cols=["c"],
+                       background_data=bg, target_col="probability",
+                       num_samples=400, seed=3)
+    out = lime.transform(Table({"a": a, "c": cat}))
+    for i in range(n):
+        coefs = out["explanation"][i][0]
+        assert abs(coefs[0] - 2.0) < 0.3          # continuous slope
+        # categorical state is 1 when the sample matches the row's own value:
+        # for "x" rows the match coefficient is +5, for "y" rows -5
+        expected = 5.0 if cat[i] == "x" else -5.0
+        assert abs(coefs[1] - expected) < 1.0
+
+
+def test_tabular_shap_additivity():
+    rng = np.random.default_rng(8)
+    cols = ["f0", "f1", "f2"]
+    beta = [1.0, 2.0, -1.5]
+    X = {c: rng.normal(size=5) for c in cols}
+    bg = {c: rng.normal(size=12) for c in cols}
+    model = _LinearColsModel(input_cols=cols, beta=beta)
+    shap = TabularSHAP(model=model, input_cols=cols, target_col="probability",
+                       background_data=Table(bg), output_col="shap", seed=4)
+    out = shap.transform(Table(X))
+    for i in range(5):
+        row = out["shap"][i][0]
+        fx = sum(b * X[c][i] for c, b in zip(cols, beta))
+        np.testing.assert_allclose(row[0] + row[1:].sum(), fx, atol=1e-3)
+
+
+# -- text -----------------------------------------------------------------------
+
+
+class _TokenScoreModel(Transformer):
+    """Scores rows by presence of the token 'good' (value 3) minus 'bad' (2)."""
+
+    def _transform(self, t):
+        y = np.asarray([3.0 * (("good" in v)) - 2.0 * (("bad" in v))
+                        for v in t["tokens"]])
+        return t.with_column("probability", y)
+
+
+def test_text_lime_finds_salient_tokens():
+    t = Table({"tokens": np.array([["the", "good", "movie"],
+                                   ["a", "bad", "plot", "twist"]], dtype=object)})
+    lime = TextLIME(model=_TokenScoreModel(), target_col="probability",
+                    num_samples=400, seed=5)
+    out = lime.transform(t)
+    w0 = out["explanation"][0][0]
+    assert len(w0) == 3
+    assert np.argmax(w0) == 1                    # 'good'
+    w1 = out["explanation"][1][0]
+    assert len(w1) == 4
+    assert np.argmin(w1) == 1                    # 'bad'
+
+
+def test_text_shap_additivity():
+    t = Table({"tokens": np.array([["good", "day"], ["bad", "good", "day"]],
+                                  dtype=object)})
+    shap = TextSHAP(model=_TokenScoreModel(), target_col="probability", seed=6)
+    out = shap.transform(t)
+    # row 0: f(full)=3, phi_good should carry it
+    row = out["explanation"][0][0]
+    np.testing.assert_allclose(row[0] + row[1:].sum(), 3.0, atol=1e-3)
+    assert np.argmax(row[1:]) == 0
+    row1 = out["explanation"][1][0]
+    np.testing.assert_allclose(row1[0] + row1[1:].sum(), 1.0, atol=1e-3)
+
+
+# -- image ----------------------------------------------------------------------
+
+
+def test_slic_superpixels_partition_image():
+    rng = np.random.default_rng(9)
+    img = rng.random((32, 32, 3))
+    spd = slic_superpixels(img, cell_size=8)
+    total = sum(len(c) for c in spd.clusters)
+    assert total == 32 * 32                       # exact partition
+    assert 4 <= len(spd) <= 32
+    masked = mask_image(img, spd, np.zeros(len(spd)))
+    np.testing.assert_allclose(masked, 0.0)
+    kept = mask_image(img, spd, np.ones(len(spd)))
+    np.testing.assert_allclose(kept, img)
+
+
+class _BrightRegionModel(Transformer):
+    """Scores by mean brightness of the top-left 8x8 patch."""
+
+    def _transform(self, t):
+        y = np.asarray([float(np.mean(img[:8, :8])) for img in t["image"]])
+        return t.with_column("probability", y)
+
+
+def test_image_lime_highlights_informative_region():
+    img = np.zeros((16, 16, 3))
+    img[:8, :8] = 1.0
+    t = Table({"image": np.array([img], dtype=object)})
+    lime = ImageLIME(model=_BrightRegionModel(), target_col="probability",
+                     cell_size=8.0, num_samples=200, seed=7)
+    out = lime.transform(t)
+    spd = slic_superpixels(img, 8.0)
+    coefs = out["explanation"][0][0]
+    # the superpixels covering the bright patch must dominate
+    covers = np.array([np.any((c[:, 0] < 8) & (c[:, 1] < 8))
+                       for c in spd.clusters])
+    assert coefs[covers].max() > 5 * max(np.abs(coefs[~covers]).max(), 1e-9)
+
+
+def test_image_shap_additivity():
+    img = np.zeros((16, 16, 1))
+    img[:8, :8] = 1.0
+    t = Table({"image": np.array([img], dtype=object)})
+    shap = ImageSHAP(model=_BrightRegionModel(), target_col="probability",
+                     cell_size=8.0, seed=8)
+    out = shap.transform(t)
+    row = out["explanation"][0][0]
+    np.testing.assert_allclose(row[0] + row[1:].sum(), 1.0, atol=1e-3)
+
+
+# -- ICE ------------------------------------------------------------------------
+
+
+def test_ice_individual_linear():
+    rng = np.random.default_rng(10)
+    t = Table({"a": rng.normal(size=6), "b": rng.normal(size=6)})
+    model = _LinearColsModel(input_cols=["a", "b"], beta=[2.0, 1.0])
+    ice = ICETransformer(model=model, target_col="probability",
+                         numeric_features=[{"name": "a", "num_splits": 4,
+                                            "range_min": 0.0, "range_max": 1.0}])
+    out = ice.transform(t)
+    dep = out["a_dependence"][0]
+    vals = sorted(dep.keys())
+    np.testing.assert_allclose(vals, [0.0, 0.25, 0.5, 0.75, 1.0])
+    ys = np.array([dep[v][0] for v in vals])
+    np.testing.assert_allclose(np.diff(ys), 2.0 * 0.25, atol=1e-9)
+
+
+def test_ice_average_pdp_and_categorical():
+    t = Table({"a": np.array([0.0, 1.0, 2.0, 3.0]),
+               "c": np.array(["u", "u", "v", "w"], dtype=object)})
+
+    class M(Transformer):
+        def _transform(self, tt):
+            y = np.asarray(tt["a"], np.float64) + \
+                (tt["c"].astype(object) == "u") * 10.0
+            return tt.with_column("probability", np.asarray(y, np.float64))
+
+    ice = ICETransformer(model=M(), target_col="probability", kind="average",
+                         categorical_features=[{"name": "c", "num_top_values": 2}])
+    out = ice.transform(t)
+    dep = out["c_dependence"][0]
+    assert set(dep.keys()) == {"u", "v"}          # top-2 by frequency
+    np.testing.assert_allclose(dep["u"][0] - dep["v"][0], 10.0)
+
+
+# -- on a real fitted model ---------------------------------------------------------
+
+
+def test_shap_explains_lightgbm_classifier():
+    from synapseml_tpu.gbdt import LightGBMClassifier
+
+    rng = np.random.default_rng(11)
+    n = 400
+    X = rng.normal(size=(n, 4))
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(int)   # features 2,3 are noise
+    t = Table({"features": X, "label": y})
+    model = LightGBMClassifier(num_iterations=20, num_leaves=7).fit(t)
+
+    inst = Table({"features": X[:4], "label": y[:4]})
+    bg = Table({"features": X[:24], "label": y[:24]})
+    shap = VectorSHAP(model=model, input_col="features", target_col="probability",
+                      target_classes=[1], background_data=bg, seed=12)
+    out = shap.transform(inst)
+    phis = np.stack([out["explanation"][i][0][1:] for i in range(4)])
+    informative = np.abs(phis[:, :2]).mean()
+    noise = np.abs(phis[:, 2:]).mean()
+    assert informative > 3 * noise
+    # additivity vs the actual predicted probability
+    probs = model.transform(inst)["probability"]
+    for i in range(4):
+        row = out["explanation"][i][0]
+        np.testing.assert_allclose(row.sum(), probs[i][1], atol=0.05)
