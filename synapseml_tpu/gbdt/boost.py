@@ -333,7 +333,8 @@ class GBDTBooster:
                  bin_: np.ndarray, gain: np.ndarray, leaf_value: np.ndarray,
                  leaf_hess: np.ndarray, tree_scale: np.ndarray,
                  boosting: str = "gbdt", best_iteration: Optional[int] = None,
-                 feature_names: Optional[List[str]] = None):
+                 feature_names: Optional[List[str]] = None,
+                 cat_set: Optional[np.ndarray] = None):
         self.mapper = mapper
         self.objective = objective
         self.num_class = num_class
@@ -349,6 +350,9 @@ class GBDTBooster:
         self.boosting = boosting
         self.best_iteration = best_iteration
         self.feature_names = feature_names
+        # (T, C, L-1, B) int8 category-membership sets for categorical splits
+        # (split stores bin == -1); None when the model has no categorical splits
+        self.cat_set = cat_set
 
     # -- prediction ----------------------------------------------------------------
 
@@ -362,32 +366,65 @@ class GBDTBooster:
             t = self.num_trees
         return t
 
-    def _leaf_of(self, x: np.ndarray, t: int, c: int) -> np.ndarray:
-        node = np.zeros(x.shape[0], dtype=np.int32)
-        par, feat, thr = self.parent[t, c], self.feature[t, c], self.threshold[t, c]
+    def _binned(self, x: np.ndarray) -> np.ndarray:
+        """Bin raw features; all split decisions happen on bins (bit-identical
+        with training; NaN lands in the missing bin and follows the right
+        branch, matching the float-threshold semantics)."""
+        return self.mapper.transform(np.asarray(x, dtype=np.float64))
+
+    def _leaf_of_binned(self, binned: np.ndarray, t: int, c: int) -> np.ndarray:
+        node = np.zeros(binned.shape[0], dtype=np.int32)
+        par, feat, bins = self.parent[t, c], self.feature[t, c], self.bin[t, c]
+        cat = self.cat_set[t, c] if self.cat_set is not None else None
         for s in range(par.shape[0]):
             p = par[s]
             if p < 0:
                 continue
-            col = x[:, feat[s]]
-            with np.errstate(invalid="ignore"):
-                go_right = (node == p) & (np.isnan(col) | (col > thr[s]))
+            col = binned[:, feat[s]]
+            if bins[s] < 0:  # categorical split: left = in-set
+                go_left = cat[s][col] > 0
+            else:
+                go_left = col <= bins[s]
+            go_right = (node == p) & ~go_left
             node[go_right] = s + 1
         return node
 
-    def raw_predict(self, x: np.ndarray, num_iteration: Optional[int] = None) -> np.ndarray:
-        """Raw margin, shape (n,) or (n, C) for multiclass."""
+    def _leaf_of(self, x: np.ndarray, t: int, c: int) -> np.ndarray:
+        return self._leaf_of_binned(self._binned(x), t, c)
+
+    def raw_predict(self, x: np.ndarray, num_iteration: Optional[int] = None,
+                    backend: str = "auto") -> np.ndarray:
+        """Raw margin, shape (n,) or (n, C) for multiclass.
+
+        ``backend``: 'device' replays all trees in one jitted scan (the default
+        for non-trivial batches — reference predict runs in the C++ core,
+        ``LightGBMBooster.scala:510,529``), 'host' uses the numpy loop, 'auto'
+        picks by batch size.
+        """
         x = np.asarray(x, dtype=np.float64)
         T = self._used_trees(num_iteration)
         n = x.shape[0]
-        out = np.tile(self.base_score, (n, 1)).astype(np.float64)  # (n, C)
-        for t in range(T):
-            sc = self.tree_scale[t]
-            for c in range(self.num_class):
-                leaf = self._leaf_of(x, t, c)
-                out[:, c] += self.leaf_value[t, c][leaf] * sc
+        binned = self._binned(x)
+        base = np.tile(self.base_score, (n, 1)).astype(np.float64)
+        if T == 0:
+            out = base
+        elif backend == "device" or (backend == "auto" and n * T >= 2048):
+            from .device_predict import device_raw_scores
+
+            scores = device_raw_scores(
+                binned, self.parent[:T], self.feature[:T], self.bin[:T],
+                self.leaf_value[:T], self.tree_scale[:T],
+                self.cat_set[:T] if self.cat_set is not None else None)
+            out = base + np.asarray(scores, np.float64)
+        else:
+            out = base.copy()
+            for t in range(T):
+                sc = self.tree_scale[t]
+                for c in range(self.num_class):
+                    leaf = self._leaf_of_binned(binned, t, c)
+                    out[:, c] += self.leaf_value[t, c][leaf] * sc
         if self.boosting == "rf" and T > 0:
-            out = np.tile(self.base_score, (n, 1)) + (out - self.base_score) / T
+            out = np.tile(self.base_score, (n, 1)) + (out - base) / T
         return out[:, 0] if self.num_class == 1 else out
 
     def predict(self, x: np.ndarray, num_iteration: Optional[int] = None) -> np.ndarray:
@@ -407,25 +444,40 @@ class GBDTBooster:
             return np.exp(raw)
         return raw
 
-    def predict_leaf(self, x: np.ndarray, num_iteration: Optional[int] = None) -> np.ndarray:
+    def predict_leaf(self, x: np.ndarray, num_iteration: Optional[int] = None,
+                     backend: str = "auto") -> np.ndarray:
         """Leaf index per (row, tree*class) — reference ``predictLeaf``."""
         x = np.asarray(x, dtype=np.float64)
         T = self._used_trees(num_iteration)
-        out = np.empty((x.shape[0], T * self.num_class), dtype=np.int32)
+        n = x.shape[0]
+        binned = self._binned(x)
+        if T and (backend == "device" or (backend == "auto" and n * T >= 2048)):
+            from .device_predict import device_leaf_indices
+
+            leaves = device_leaf_indices(
+                binned, self.parent[:T], self.feature[:T], self.bin[:T],
+                self.cat_set[:T] if self.cat_set is not None else None)  # (T,C,n)
+            return np.ascontiguousarray(
+                np.transpose(leaves, (2, 0, 1)).reshape(n, T * self.num_class))
+        out = np.empty((n, T * self.num_class), dtype=np.int32)
         k = 0
         for t in range(T):
             for c in range(self.num_class):
-                out[:, k] = self._leaf_of(x, t, c)
+                out[:, k] = self._leaf_of_binned(binned, t, c)
                 k += 1
         return out
 
-    def predict_contrib(self, x: np.ndarray, num_iteration: Optional[int] = None) -> np.ndarray:
-        """Per-feature contributions + expected value (last column), Saabas method.
+    def predict_contrib(self, x: np.ndarray, num_iteration: Optional[int] = None,
+                        approximate: bool = False) -> np.ndarray:
+        """Per-feature contributions + expected value (last column).
 
-        The reference's ``featuresShap`` (``LightGBMBooster.scala``) uses exact
-        TreeSHAP inside the C++ core; this is the path-attribution approximation
-        (exact for trees where each feature appears once per path).
+        Default is EXACT TreeSHAP (Lundberg's path algorithm, matching the
+        reference's ``featuresShap`` / C++ TreeSHAP at
+        ``LightGBMBooster.scala:510,529``); ``approximate=True`` selects the
+        cheaper Saabas path attribution.
         """
+        if not approximate:
+            return self._predict_contrib_shap(x, num_iteration)
         x = np.asarray(x, dtype=np.float64)
         T = self._used_trees(num_iteration)
         n, d = x.shape
@@ -469,6 +521,29 @@ class GBDTBooster:
                     cur = new
         return out[0] if C == 1 else out
 
+    def _predict_contrib_shap(self, x: np.ndarray,
+                              num_iteration: Optional[int] = None) -> np.ndarray:
+        """Exact TreeSHAP over all trees; additivity: row sum == raw_predict."""
+        from .treeshap import build_explicit_tree, expected_value, tree_shap
+
+        x = np.asarray(x, dtype=np.float64)
+        binned = self._binned(x)
+        T = self._used_trees(num_iteration)
+        n, d = x.shape
+        C = self.num_class
+        out = np.zeros((C, n, d + 1), dtype=np.float64)
+        out[:, :, d] = self.base_score[:, None]
+        for t in range(T):
+            sc = self.tree_scale[t] * (1.0 / T if self.boosting == "rf" else 1.0)
+            for c in range(C):
+                root = build_explicit_tree(
+                    self.parent[t, c], self.feature[t, c], self.bin[t, c],
+                    self.leaf_value[t, c], self.leaf_hess[t, c],
+                    self.cat_set[t, c] if self.cat_set is not None else None)
+                out[c, :, :d] += sc * tree_shap(root, binned, d)
+                out[c, :, d] += sc * expected_value(root)
+        return out[0] if C == 1 else out
+
     def feature_importance(self, importance_type: str = "split",
                            num_iteration: Optional[int] = None) -> np.ndarray:
         """'split' counts or 'gain' sums per feature — reference
@@ -498,6 +573,7 @@ class GBDTBooster:
             "objective": self.objective, "num_class": self.num_class,
             "boosting": self.boosting, "best_iteration": self.best_iteration,
             "feature_names": self.feature_names, "mapper": self.mapper.to_dict(),
+            "cat_set": self.cat_set,
         }
 
     @staticmethod
@@ -520,6 +596,8 @@ class GBDTBooster:
             boosting=d.get("boosting", "gbdt"),
             best_iteration=d.get("best_iteration"),
             feature_names=list(d["feature_names"]) if d.get("feature_names") else None,
+            cat_set=(np.asarray(d["cat_set"], dtype=np.int8)
+                     if d.get("cat_set") is not None else None),
         )
 
     def to_json(self) -> str:
@@ -540,6 +618,7 @@ class GBDTBooster:
                 for k in ("parent", "feature", "threshold", "bin", "gain",
                           "leaf_value", "leaf_hess")
             },
+            "cat_set": self.cat_set.tolist() if self.cat_set is not None else None,
         })
 
     @staticmethod
@@ -563,6 +642,8 @@ class GBDTBooster:
             boosting=d.get("boosting", "gbdt"),
             best_iteration=d.get("best_iteration"),
             feature_names=d.get("feature_names"),
+            cat_set=(np.asarray(d["cat_set"], dtype=np.int8)
+                     if d.get("cat_set") is not None else None),
         )
 
 
@@ -982,4 +1063,19 @@ def _merge_boosters(a: GBDTBooster, b: GBDTBooster) -> GBDTBooster:
         leaf_hess=np.concatenate([a.leaf_hess, b.leaf_hess]),
         tree_scale=np.concatenate([a.tree_scale, b.tree_scale]),
         boosting=b.boosting, best_iteration=None, feature_names=b.feature_names,
+        cat_set=_merge_cat_sets(a, b),
     )
+
+
+def _merge_cat_sets(a: GBDTBooster, b: GBDTBooster):
+    if a.cat_set is None and b.cat_set is None:
+        return None
+
+    def expand(x: GBDTBooster):
+        if x.cat_set is not None:
+            return x.cat_set
+        other = a.cat_set if x is b else b.cat_set
+        shape = (x.parent.shape[0],) + other.shape[1:]
+        return np.zeros(shape, dtype=np.int8)
+
+    return np.concatenate([expand(a), expand(b)])

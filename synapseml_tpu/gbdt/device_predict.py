@@ -1,0 +1,147 @@
+"""Device-resident batched booster inference.
+
+Reference analogue: ``LightGBMBooster.predictForMat/score`` dispatching into the
+C++ predictor (``LightGBMBooster.scala:510,529``). TPU design: the trained
+model is a stack of replay-list trees (T, C, S) — prediction replays every
+split of every tree with vectorized gathers, scanning over trees so the raw
+score accumulates in a fixed (n, C) buffer. One jit per (T, C, S, n-bucket)
+shape; rows are padded to the next power-of-two bucket to bound recompiles.
+
+All decisions happen on BINNED features (int comparisons + category-set
+lookups), exactly matching training — so device and host predictions are
+bit-identical, and categorical splits need no float thresholds.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+from typing import Optional, Tuple
+
+import numpy as np
+
+__all__ = ["device_leaf_indices", "device_raw_scores"]
+
+
+@lru_cache(maxsize=64)
+def _leaf_kernel(T: int, C: int, S: int, has_cat: bool):
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    def one_tree(binned, par, feat, bins, cat_set):
+        # par/feat/bins (S,); cat_set (S, B) int8 or (S, 1) dummy
+        n = binned.shape[0]
+
+        def step(node, s):
+            p = par[s]
+            col = jnp.take(binned, feat[s], axis=1)
+            if has_cat:
+                in_set = jnp.take(cat_set[s], col) > 0
+                is_cat = bins[s] < 0
+                go_left = jnp.where(is_cat, in_set, col <= bins[s])
+            else:
+                go_left = col <= bins[s]
+            go_right = (node == p) & (p >= 0) & ~go_left
+            return jnp.where(go_right, s + 1, node), None
+
+        node, _ = lax.scan(step, jnp.zeros(n, jnp.int32), jnp.arange(S))
+        return node
+
+    @jax.jit
+    def kernel(binned, parent, feature, bins, cat_set):
+        # parent (T,C,S) ... -> leaf index (T, C, n)
+        per_class = jax.vmap(jax.vmap(
+            lambda p, f, b, cs: one_tree(binned, p, f, b, cs)))
+        return per_class(parent, feature, bins, cat_set)
+
+    return kernel
+
+
+@lru_cache(maxsize=64)
+def _score_kernel(T: int, C: int, S: int, has_cat: bool):
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    def one_tree(binned, par, feat, bins, cat_set, leaf_value):
+        n = binned.shape[0]
+
+        def step(node, s):
+            p = par[s]
+            col = jnp.take(binned, feat[s], axis=1)
+            if has_cat:
+                in_set = jnp.take(cat_set[s], col) > 0
+                is_cat = bins[s] < 0
+                go_left = jnp.where(is_cat, in_set, col <= bins[s])
+            else:
+                go_left = col <= bins[s]
+            go_right = (node == p) & (p >= 0) & ~go_left
+            return jnp.where(go_right, s + 1, node), None
+
+        node, _ = lax.scan(step, jnp.zeros(n, jnp.int32), jnp.arange(S))
+        return jnp.take(leaf_value, node)  # (n,)
+
+    @jax.jit
+    def kernel(binned, parent, feature, bins, cat_set, leaf_value, scale):
+        # scan over trees: acc (n, C) += scale_t * leaf_values
+        n = binned.shape[0]
+
+        def body(acc, xs):
+            par, feat, bins_t, cs, lv, sc = xs
+            vals = jax.vmap(lambda p, f, b, c, v: one_tree(binned, p, f, b, c, v))(
+                par, feat, bins_t, cs, lv)        # (C, n)
+            return acc + sc * vals.T, None
+
+        acc, _ = lax.scan(
+            body, jnp.zeros((n, C), jnp.float32),
+            (parent, feature, bins, cat_set, leaf_value,
+             scale.astype(jnp.float32)))
+        return acc
+
+    return kernel
+
+
+def _pad_rows(binned: np.ndarray) -> Tuple[np.ndarray, int]:
+    """Pad row count up to a power-of-two bucket (>=256) to bound recompiles."""
+    n = binned.shape[0]
+    bucket = 256
+    while bucket < n:
+        bucket *= 2
+    if bucket == n:
+        return binned, n
+    pad = np.zeros((bucket - n, binned.shape[1]), dtype=binned.dtype)
+    return np.concatenate([binned, pad], axis=0), n
+
+
+def _cat_or_dummy(cat_set: Optional[np.ndarray], T: int, C: int, S: int):
+    if cat_set is None:
+        return np.zeros((T, C, S, 1), dtype=np.int8), False
+    return cat_set, True
+
+
+def device_leaf_indices(binned: np.ndarray, parent: np.ndarray,
+                        feature: np.ndarray, bins: np.ndarray,
+                        cat_set: Optional[np.ndarray] = None) -> np.ndarray:
+    """(n, d) binned -> (T, C, n) leaf index, computed on device."""
+    T, C, S = parent.shape
+    cs, has_cat = _cat_or_dummy(cat_set, T, C, S)
+    padded, n = _pad_rows(np.ascontiguousarray(binned, dtype=np.int32))
+    k = _leaf_kernel(T, C, S, has_cat)
+    out = k(padded, parent.astype(np.int32), feature.astype(np.int32),
+            bins.astype(np.int32), cs.astype(np.int8))
+    return np.asarray(out)[:, :, :n]
+
+
+def device_raw_scores(binned: np.ndarray, parent: np.ndarray,
+                      feature: np.ndarray, bins: np.ndarray,
+                      leaf_value: np.ndarray, scale: np.ndarray,
+                      cat_set: Optional[np.ndarray] = None) -> np.ndarray:
+    """(n, d) binned -> (n, C) sum over trees of scale_t * leaf_value."""
+    T, C, S = parent.shape
+    cs, has_cat = _cat_or_dummy(cat_set, T, C, S)
+    padded, n = _pad_rows(np.ascontiguousarray(binned, dtype=np.int32))
+    k = _score_kernel(T, C, S, has_cat)
+    out = k(padded, parent.astype(np.int32), feature.astype(np.int32),
+            bins.astype(np.int32), cs.astype(np.int8),
+            leaf_value.astype(np.float32), np.asarray(scale, np.float64))
+    return np.asarray(out)[:n]

@@ -365,3 +365,90 @@ def test_unknown_metric_raises():
     y = np.zeros(10)
     with pytest.raises(ValueError, match="unknown metric"):
         train({"objective": "binary", "metric": "acu", "num_iterations": 1}, x, y)
+
+
+# -- device predict + exact TreeSHAP (round 2) ---------------------------------------
+
+
+def test_device_predict_matches_host():
+    rng = np.random.default_rng(50)
+    x = rng.normal(size=(500, 6))
+    y = (x[:, 0] * 2 + x[:, 1] - 0.5 * x[:, 2] > 0).astype(np.float64)
+    booster = train({"objective": "binary", "num_iterations": 12, "num_leaves": 15},
+                    x, y)
+    ph = booster.raw_predict(x, backend="host")
+    pd_ = booster.raw_predict(x, backend="device")
+    np.testing.assert_allclose(ph, pd_, rtol=1e-5, atol=1e-5)
+    lh = booster.predict_leaf(x, backend="host")
+    ld = booster.predict_leaf(x, backend="device")
+    np.testing.assert_array_equal(lh, ld)
+
+
+def test_device_predict_matches_host_multiclass():
+    rng = np.random.default_rng(51)
+    x = rng.normal(size=(300, 5))
+    y = np.argmax(x[:, :3], axis=1).astype(np.float64)
+    booster = train({"objective": "multiclass", "num_class": 3,
+                     "num_iterations": 6, "num_leaves": 7}, x, y)
+    np.testing.assert_allclose(booster.raw_predict(x, backend="host"),
+                               booster.raw_predict(x, backend="device"),
+                               rtol=1e-5, atol=1e-5)
+
+
+def _brute_shapley(booster, x_row, binned_row, d):
+    """Exact Shapley by subset enumeration with cover-weighted conditional
+    expectation — the gold standard TreeSHAP must match."""
+    from itertools import combinations
+    from math import factorial
+    from synapseml_tpu.gbdt.treeshap import build_explicit_tree
+
+    def cond_exp(root, known):
+        def rec(node):
+            if node.left is None:
+                return node.value
+            if node.feature in known:
+                go_left = binned_row[node.feature] <= node.bin
+                return rec(node.left if go_left else node.right)
+            wl = node.left.cover / node.cover
+            return wl * rec(node.left) + (1 - wl) * rec(node.right)
+        return rec(root)
+
+    total = np.zeros(d)
+    for t in range(booster.num_trees):
+        root = build_explicit_tree(
+            booster.parent[t, 0], booster.feature[t, 0], booster.bin[t, 0],
+            booster.leaf_value[t, 0], booster.leaf_hess[t, 0])
+        sc = booster.tree_scale[t]
+        for i in range(d):
+            others = [j for j in range(d) if j != i]
+            phi = 0.0
+            for r in range(d):
+                for S in combinations(others, r):
+                    w = factorial(len(S)) * factorial(d - len(S) - 1) / factorial(d)
+                    phi += w * (cond_exp(root, set(S) | {i}) - cond_exp(root, set(S)))
+            total[i] += sc * phi
+    return total
+
+
+def test_treeshap_matches_bruteforce():
+    rng = np.random.default_rng(52)
+    x = rng.normal(size=(200, 4))
+    y = x[:, 0] * 2 + x[:, 1] * x[:, 2]
+    booster = train({"objective": "regression", "num_iterations": 3,
+                     "num_leaves": 8, "min_data_in_leaf": 5}, x, y)
+    contrib = booster.predict_contrib(x[:3])
+    binned = booster.mapper.transform(x[:3])
+    for i in range(3):
+        brute = _brute_shapley(booster, x[i], binned[i], 4)
+        np.testing.assert_allclose(contrib[i, :4], brute, atol=1e-6)
+
+
+def test_treeshap_additivity():
+    rng = np.random.default_rng(53)
+    x = rng.normal(size=(400, 6))
+    y = (x[:, 0] + x[:, 1] ** 2 > 1).astype(np.float64)
+    booster = train({"objective": "binary", "num_iterations": 10,
+                     "num_leaves": 15}, x, y)
+    contrib = booster.predict_contrib(x[:50])
+    raw = booster.raw_predict(x[:50], backend="host")
+    np.testing.assert_allclose(contrib.sum(axis=1), raw, atol=1e-5)
