@@ -21,15 +21,26 @@ __all__ = ["BinMapper"]
 
 
 class BinMapper:
-    """Fit per-feature quantile bin edges; transform float matrices to int8/16 bins."""
+    """Fit per-feature quantile bin edges; transform float matrices to int8/16 bins.
 
-    def __init__(self, max_bin: int = 255, sample_cnt: int = 200_000, seed: int = 0):
+    ``categorical_features`` lists column indices treated as categories: each
+    distinct value (by descending count, up to ``max_bin``) gets its own bin,
+    unseen values and NaN map to the missing bin, and the grower uses
+    sorted-set splits instead of threshold splits for them (reference:
+    LightGBM categorical handling exercised by ``VerifyLightGBMClassifier``
+    "categorical handling").
+    """
+
+    def __init__(self, max_bin: int = 255, sample_cnt: int = 200_000, seed: int = 0,
+                 categorical_features: Optional[List[int]] = None):
         if max_bin < 2:
             raise ValueError(f"max_bin must be >= 2, got {max_bin}")
         self.max_bin = int(max_bin)
         self.sample_cnt = int(sample_cnt)
         self.seed = seed
+        self.categorical_features = sorted(set(categorical_features or []))
         self.upper_edges: Optional[List[np.ndarray]] = None  # per-feature ascending edges
+        self.cat_values: dict = {}  # feature -> ascending array of category values
         self.n_features: Optional[int] = None
 
     @property
@@ -51,9 +62,18 @@ class BinMapper:
         else:
             sample = x
         edges: List[np.ndarray] = []
+        self.cat_values = {}
         for j in range(d):
             col = sample[:, j]
             col = col[np.isfinite(col)]
+            if j in self.categorical_features:
+                vals, counts = np.unique(col, return_counts=True)
+                if len(vals) > self.max_bin:  # keep the most frequent categories
+                    keep = np.argsort(-counts, kind="stable")[: self.max_bin]
+                    vals = vals[keep]
+                self.cat_values[j] = np.sort(vals)
+                edges.append(np.array([np.inf]))  # placeholder, unused for cat
+                continue
             if col.size == 0:
                 edges.append(np.array([np.inf]))
                 continue
@@ -83,6 +103,15 @@ class BinMapper:
         out = np.empty((n, d), dtype=np.int32)
         for j in range(d):
             col = x[:, j]
+            if j in self.cat_values:
+                vals = self.cat_values[j]
+                idx = np.searchsorted(vals, col)
+                idx = np.clip(idx, 0, max(len(vals) - 1, 0))
+                known = np.isfinite(col) & (len(vals) > 0)
+                if len(vals):
+                    known &= vals[idx] == col
+                out[:, j] = np.where(known, idx, self.missing_bin)
+                continue
             out[:, j] = np.searchsorted(self.upper_edges[j], col, side="left")
             miss = ~np.isfinite(col)
             # +inf searches past the last edge; clamp, then stamp NaN into its bin
@@ -95,7 +124,11 @@ class BinMapper:
         return self.fit(x).transform(x)
 
     def bin_upper_value(self, feature: int, b: np.ndarray) -> np.ndarray:
-        """Raw-value threshold for split 'bin <= b' (used by tree predict on raw x)."""
+        """Raw-value threshold for split 'bin <= b' (used by tree predict on raw x).
+
+        NaN for categorical features (their splits are set-based, not threshold)."""
+        if feature in self.cat_values:
+            return np.full(np.shape(b), np.nan) if np.ndim(b) else np.nan
         ue = self.upper_edges[feature]
         return ue[np.clip(b, 0, len(ue) - 1)]
 
@@ -105,12 +138,17 @@ class BinMapper:
             "sample_cnt": self.sample_cnt,
             "seed": self.seed,
             "upper_edges": [e.tolist() for e in (self.upper_edges or [])],
+            "categorical_features": self.categorical_features,
+            "cat_values": {str(k): v.tolist() for k, v in self.cat_values.items()},
         }
 
     @staticmethod
     def from_dict(d: dict) -> "BinMapper":
-        m = BinMapper(max_bin=d["max_bin"], sample_cnt=d["sample_cnt"], seed=d["seed"])
+        m = BinMapper(max_bin=d["max_bin"], sample_cnt=d["sample_cnt"], seed=d["seed"],
+                      categorical_features=d.get("categorical_features"))
         if d.get("upper_edges"):
             m.upper_edges = [np.asarray(e) for e in d["upper_edges"]]
             m.n_features = len(m.upper_edges)
+        m.cat_values = {int(k): np.asarray(v)
+                        for k, v in (d.get("cat_values") or {}).items()}
         return m

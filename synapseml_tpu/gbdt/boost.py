@@ -478,6 +478,9 @@ class GBDTBooster:
         """
         if not approximate:
             return self._predict_contrib_shap(x, num_iteration)
+        if self.cat_set is not None:
+            raise ValueError("approximate (Saabas) contributions don't support "
+                             "categorical splits; use approximate=False")
         x = np.asarray(x, dtype=np.float64)
         T = self._used_trees(num_iteration)
         n, d = x.shape
@@ -658,6 +661,8 @@ _DEFAULTS = dict(
     bagging_fraction=1.0, bagging_freq=0, boosting="gbdt",
     top_rate=0.2, other_rate=0.1,         # goss
     drop_rate=0.1, max_drop=50, skip_drop=0.5,  # dart
+    categorical_feature=None, cat_smooth=10.0, max_cat_threshold=32,
+    parallelism="data_parallel", top_k=20,
     num_class=1, seed=0, bagging_seed=3, metric=None, early_stopping_round=0,
     early_stopping_min_delta=0.0, hist_method="auto", hist_chunk=2048,
     alpha=0.9, tweedie_variance_power=1.5, verbose=0,
@@ -724,11 +729,19 @@ def train(params: Dict[str, Any], x: np.ndarray, y: np.ndarray,
             sigma=float(p["sigmoid"]))
     else:
         init_fn, grad_fn = _resolve_objective(p)
+    cat_features = sorted(set(p["categorical_feature"] or []))
+    if any(not isinstance(c, (int, np.integer)) for c in cat_features):
+        if not feature_names:
+            raise ValueError("categorical_feature names require feature_names")
+        cat_features = sorted(feature_names.index(c) if isinstance(c, str) else int(c)
+                              for c in cat_features)
     if mapper is None:
         if init_booster is not None:
             mapper = init_booster.mapper
         else:
-            mapper = BinMapper(max_bin=int(p["max_bin"]), seed=int(p["seed"])).fit(x)
+            mapper = BinMapper(max_bin=int(p["max_bin"]), seed=int(p["seed"]),
+                               categorical_features=cat_features).fit(x)
+    has_cat = bool(mapper.categorical_features)
     binned_np = mapper.transform(x)
 
     if init_booster is not None:
@@ -760,6 +773,10 @@ def train(params: Dict[str, Any], x: np.ndarray, y: np.ndarray,
                          "bagging_freq > 0")
     lr = float(p["learning_rate"]) if boosting != "rf" else 1.0
 
+    parallelism = p["parallelism"]
+    if parallelism not in ("data_parallel", "data", "voting_parallel", "voting"):
+        raise ValueError(f"parallelism must be data_parallel|voting_parallel, "
+                         f"got {parallelism!r}")
     cfg = TreeConfig(
         n_bins=mapper.n_bins, num_leaves=int(p["num_leaves"]),
         lambda_l1=float(p["lambda_l1"]), lambda_l2=float(p["lambda_l2"]),
@@ -767,7 +784,15 @@ def train(params: Dict[str, Any], x: np.ndarray, y: np.ndarray,
         min_sum_hessian=float(p["min_sum_hessian_in_leaf"]),
         min_gain_to_split=float(p["min_gain_to_split"]),
         hist_method=p["hist_method"], hist_chunk=int(p["hist_chunk"]),
+        cat_smooth=float(p["cat_smooth"]),
+        max_cat_threshold=int(p["max_cat_threshold"]),
+        parallelism="voting" if parallelism.startswith("voting") else "data",
+        top_k=int(p["top_k"]),
     )
+    cat_mask_np = None
+    if has_cat:
+        cat_mask_np = np.zeros(d, np.float32)
+        cat_mask_np[list(mapper.categorical_features)] = 1.0
     L = cfg.num_leaves
     ff = float(p["feature_fraction"])
     bf = float(p["bagging_fraction"])
@@ -814,8 +839,11 @@ def train(params: Dict[str, Any], x: np.ndarray, y: np.ndarray,
 
         bw = make_weights(key, jnp.abs(g).sum(axis=1), g.shape[0])
 
+        cmask = (jnp.asarray(cat_mask_np) if cat_mask_np is not None else None)
+
         def grow_c(gc, hc):
-            return grow_tree(binned, gc, hc, bw, fmask, cfg, axis_name=axis_name)
+            return grow_tree(binned, gc, hc, bw, fmask, cfg,
+                             axis_name=axis_name, cat_mask=cmask)
 
         if C == 1:
             tree, node = grow_c(g[:, 0], h[:, 0])
@@ -912,10 +940,13 @@ def train(params: Dict[str, Any], x: np.ndarray, y: np.ndarray,
     def predict_tree_binned(tr, binned_mat, c):
         node = np.zeros(binned_mat.shape[0], dtype=np.int32)
         par, feat, bins = tr.parent[c], tr.feature[c], tr.bin[c]
+        cat = tr.cat_set[c]
         for s in range(par.shape[0]):
             if par[s] < 0:
                 continue
-            go_right = (node == par[s]) & (binned_mat[:, feat[s]] > bins[s])
+            col = binned_mat[:, feat[s]]
+            go_left = cat[s][col] > 0 if bins[s] < 0 else col <= bins[s]
+            go_right = (node == par[s]) & ~go_left
             node[go_right] = s + 1
         return tr.leaf_value[c][node]
 
@@ -1008,6 +1039,10 @@ def train(params: Dict[str, Any], x: np.ndarray, y: np.ndarray,
     gain = np.stack([t.gain for t in trees_host]) if T else np.zeros((0, C, L - 1), np.float32)
     leaf_value = np.stack([t.leaf_value for t in trees_host]) if T else np.zeros((0, C, L), np.float32)
     leaf_hess = np.stack([t.leaf_hess for t in trees_host]) if T else np.zeros((0, C, L), np.float32)
+    cat_stack = None
+    if has_cat:
+        cat_stack = (np.stack([t.cat_set for t in trees_host]).astype(np.int8)
+                     if T else np.zeros((0, C, L - 1, mapper.n_bins), np.int8))
     threshold = np.zeros(parent.shape, dtype=np.float64)
     for t in range(T):
         for c in range(C):
@@ -1024,6 +1059,7 @@ def train(params: Dict[str, Any], x: np.ndarray, y: np.ndarray,
         boosting=boosting,
         best_iteration=best_iter if (patience and eval_binned) else None,
         feature_names=list(feature_names) if feature_names else None,
+        cat_set=cat_stack,
     )
     if init_booster is not None and init_booster.num_trees:
         booster = _merge_boosters(init_booster, booster)

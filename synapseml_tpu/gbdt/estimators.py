@@ -10,9 +10,12 @@ Rebuild of ``lightgbm/src/main/scala/.../lightgbm/``:
 Params keep the reference names (snake_case): the shared surface of
 ``params/LightGBMParams.scala`` — boosting_type, num_iterations, learning_rate,
 num_leaves, max_bin, bagging/feature fractions, lambdas, early stopping, etc.
-``parallelism``/``use_barrier_execution_mode`` are accepted for API parity; actual
-distribution is the ``mesh`` param (rows shard over the mesh 'data' axis, histogram
-``psum`` replacing the reference's socket ring — see ``boost.py``).
+``use_barrier_execution_mode`` is accepted for API parity (SPMD shard_map is
+gang-scheduled by construction); distribution is the ``mesh`` param (rows shard
+over the mesh 'data' axis). ``parallelism='data_parallel'`` allreduces full
+histograms (psum replacing the reference's socket ring); ``'voting_parallel'``
+runs the PV-tree vote + candidate-only reduce (reference
+``LightGBMParams.scala:16-30``) — see ``grow.py``.
 """
 
 from __future__ import annotations
@@ -86,8 +89,24 @@ class _LightGBMBase(Estimator):
     max_drop = Param("dart: max trees dropped per iteration", int, default=50)
     skip_drop = Param("dart: probability of skipping dropout", float, default=0.5)
     metric = Param("eval metric name ('' = objective default)", str, default="")
-    parallelism = Param("data_parallel | voting_parallel (API parity; execution is "
-                        "mesh-psum either way)", str, default="data_parallel")
+    parallelism = Param("data_parallel (full histogram allreduce) | "
+                        "voting_parallel (PV-tree: top-k feature vote + "
+                        "candidate-only reduce)", str, default="data_parallel",
+                        validator=ParamValidators.in_list(
+                            ["data_parallel", "voting_parallel"]))
+    top_k = Param("voting_parallel: local vote size (global select 2k; "
+                  "reference topK)", int, default=20,
+                  validator=ParamValidators.gt(0))
+    categorical_slot_names = Param("feature names treated as categorical "
+                                   "(reference categoricalSlotNames)", list,
+                                   default=[])
+    categorical_slot_indexes = Param("feature indices treated as categorical "
+                                     "(reference categoricalSlotIndexes)", list,
+                                     default=[])
+    cat_smooth = Param("categorical split smoothing (reference catSmooth)",
+                       float, default=10.0)
+    max_cat_threshold = Param("max categories in the left set of a categorical "
+                              "split (reference maxCatThreshold)", int, default=32)
     use_barrier_execution_mode = Param("accepted for API parity (gang scheduling is "
                                        "implicit in SPMD)", bool, default=False)
     num_batches = Param("split training into k sequential batches with model "
@@ -125,6 +144,12 @@ class _LightGBMBase(Estimator):
             "metric": self.metric or None,
             "seed": self.seed,
             "bagging_seed": self.bagging_seed,
+            "parallelism": self.parallelism,
+            "top_k": self.top_k,
+            "categorical_feature": (list(self.categorical_slot_indexes)
+                                    + list(self.categorical_slot_names)) or None,
+            "cat_smooth": self.cat_smooth,
+            "max_cat_threshold": self.max_cat_threshold,
         }
 
     def _split_validation(self, table: Table):

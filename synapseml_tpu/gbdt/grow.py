@@ -1,7 +1,9 @@
 """Leaf-wise tree growth, fully jit-compiled.
 
-Reference analogue: the C++ ``SerialTreeLearner``/``DataParallelTreeLearner`` driven
-per-iteration from ``TrainUtils.trainCore`` (``TrainUtils.scala:92-160``). TPU design:
+Reference analogue: the C++ ``SerialTreeLearner``/``DataParallelTreeLearner``/
+``VotingParallelTreeLearner`` driven per-iteration from ``TrainUtils.trainCore``
+(``TrainUtils.scala:92-160``; parallelism modes ``LightGBMParams.scala:16-30``).
+TPU design:
 
 - fixed shapes everywhere: ``num_leaves`` slots, ``lax.fori_loop`` over the
   ``num_leaves - 1`` split steps; an inert step (gain <= min_gain) records parent -1;
@@ -11,14 +13,21 @@ per-iteration from ``TrainUtils.trainCore`` (``TrainUtils.scala:92-160``). TPU d
 - leaf-wise like LightGBM: each step splits the best-gain leaf anywhere in the tree;
 - parent-subtract: each step computes ONE masked histogram (the new right child) and
   derives the left side by subtraction — same trick as LightGBM's sibling subtract;
-- distributed: pass ``axis_name`` and every histogram is ``psum``-reduced over that
+- distributed ``parallelism='data'``: every histogram is ``psum``-reduced over the
   mesh axis, so all shards take identical split decisions (the reference ships
-  histogram buffers over its TCP ring for the same purpose).
+  histogram buffers over its TCP ring for the same purpose);
+- distributed ``parallelism='voting'`` (LightGBM PV-tree): histograms stay LOCAL;
+  each shard votes for its top-k features per leaf, votes are psum'd, and only the
+  globally top-2k features' histograms are allreduced — comm volume drops from
+  (L,d,B,3) to (L,2k,B,3) per step;
+- categorical splits (LightGBM many-vs-many): a categorical feature's bins are
+  sorted by grad/hess ratio and the best sorted-prefix becomes the left-going
+  category SET, stored as a (B,) membership row (``cat_set``); the replay list
+  marks such splits with ``bin == -1``.
 """
 
 from __future__ import annotations
 
-from functools import partial
 from typing import NamedTuple, Optional
 
 import numpy as np
@@ -40,17 +49,26 @@ class TreeConfig(NamedTuple):
     min_gain_to_split: float = 0.0
     hist_method: str = "auto"
     hist_chunk: int = 2048
+    cat_smooth: float = 10.0
+    max_cat_threshold: int = 32
+    parallelism: str = "data"   # 'data' | 'voting'
+    top_k: int = 20             # voting: local vote size (global select = 2k)
 
 
 class GrownTree(NamedTuple):
-    """Replay-list tree: split ``s`` turns leaf ``parent[s]`` into (parent[s], s+1)."""
+    """Replay-list tree: split ``s`` turns leaf ``parent[s]`` into (parent[s], s+1).
+
+    ``bin[s] >= 0``: numeric split 'bin <= b goes left'. ``bin[s] == -1``:
+    categorical split; row goes left iff ``cat_set[s, row_bin] == 1``.
+    """
 
     parent: "np.ndarray"  # (L-1,) int32; -1 = inert step
     feature: "np.ndarray"  # (L-1,) int32
-    bin: "np.ndarray"  # (L-1,) int32 — split is 'bin <= b goes left'
+    bin: "np.ndarray"  # (L-1,) int32
     gain: "np.ndarray"  # (L-1,) f32
     leaf_value: "np.ndarray"  # (L,) f32  (unshrunk; learning rate applied by caller)
     leaf_hess: "np.ndarray"  # (L,) f32 — leaf hessian mass (cover), for contribs
+    cat_set: "np.ndarray"  # (L-1, B) int8 — left-going category membership
 
 
 def _thresh_l1(g, l1):
@@ -60,11 +78,12 @@ def _thresh_l1(g, l1):
 
 
 def grow_tree(binned, grad, hess, row_weight, feature_mask, cfg: TreeConfig,
-              axis_name: Optional[str] = None):
+              axis_name: Optional[str] = None, cat_mask=None):
     """Grow one tree. Returns (GrownTree of device arrays, node_of_row (n,) int32).
 
     ``binned`` (n, d) int32; ``grad``/``hess``/``row_weight`` (n,) f32;
-    ``feature_mask`` (d,) f32 in {0,1} (feature_fraction sampling).
+    ``feature_mask`` (d,) f32 in {0,1} (feature_fraction sampling);
+    ``cat_mask`` (d,) f32 in {0,1} — categorical features (None = all numeric).
     """
     import jax
     import jax.numpy as jnp
@@ -73,55 +92,135 @@ def grow_tree(binned, grad, hess, row_weight, feature_mask, cfg: TreeConfig,
     n, d = binned.shape
     L, B = cfg.num_leaves, cfg.n_bins
     l1, l2 = cfg.lambda_l1, cfg.lambda_l2
+    has_cat = cat_mask is not None
+    voting = cfg.parallelism == "voting" and axis_name is not None
+    if voting:
+        k_local = min(cfg.top_k, d)
+        k_global = min(2 * cfg.top_k, d)
 
     def hist_of(weight):
         h = histogram(binned, grad, hess, weight, B,
                       method=cfg.hist_method, chunk=cfg.hist_chunk)
-        if axis_name is not None:
+        if axis_name is not None and not voting:
             h = lax.psum(h, axis_name)
         return h
 
     def gain_term(G, H):
         return _thresh_l1(G, l1) ** 2 / (H + l2)
 
+    def gain_table(hists, fmask_sel):
+        """(..., d_sel, B, 3) histograms -> (..., d_sel, B) split-gain table.
+
+        For numeric features entry b is the 'bin <= b' threshold split; for
+        categorical features entry b is the sorted-prefix of length b+1.
+        """
+        G, H, C = hists[..., 0], hists[..., 1], hists[..., 2]
+        GT = G.sum(-1, keepdims=True)
+        HT = H.sum(-1, keepdims=True)
+        CT = C.sum(-1, keepdims=True)
+        pos = jnp.arange(B)
+
+        def split_gain(GL, HL, CL, extra_valid):
+            GR, HR, CR = GT - GL, HT - HL, CT - CL
+            g = gain_term(GL, HL) + gain_term(GR, HR) - gain_term(GT, HT)
+            valid = (
+                (pos < B - 1)
+                & (CL >= cfg.min_data_in_leaf)
+                & (CR >= cfg.min_data_in_leaf)
+                & (HL >= cfg.min_sum_hessian)
+                & (HR >= cfg.min_sum_hessian)
+                & extra_valid
+                & (fmask_sel[..., None] > 0)
+            )
+            return jnp.where(valid, g, -jnp.inf)
+
+        gain_num = split_gain(jnp.cumsum(G, -1), jnp.cumsum(H, -1),
+                              jnp.cumsum(C, -1), True)
+        if not has_cat:
+            return gain_num
+        ratio = G / (H + cfg.cat_smooth)
+        order = jnp.argsort(-ratio, axis=-1)
+        Gs = jnp.take_along_axis(G, order, -1)
+        Hs = jnp.take_along_axis(H, order, -1)
+        Cs = jnp.take_along_axis(C, order, -1)
+        gain_cat = split_gain(jnp.cumsum(Gs, -1), jnp.cumsum(Hs, -1),
+                              jnp.cumsum(Cs, -1),
+                              pos + 1 <= cfg.max_cat_threshold)
+        return gain_num, gain_cat
+
+    def combined_gain(hists, fmask_sel, cmask_sel):
+        g = gain_table(hists, fmask_sel)
+        if not has_cat:
+            return g
+        gain_num, gain_cat = g
+        return jnp.where(cmask_sel[..., None] > 0, gain_cat, gain_num)
+
     def best_splits(hists, n_active):
-        """Best (gain, feature, bin) per leaf from its histogram. (L,) each."""
-        G = hists[..., 0]  # (L, d, B)
-        H = hists[..., 1]
-        C = hists[..., 2]
-        GL = jnp.cumsum(G, axis=-1)
-        HL = jnp.cumsum(H, axis=-1)
-        CL = jnp.cumsum(C, axis=-1)
-        GT = GL[..., -1:]
-        HT = HL[..., -1:]
-        CT = CL[..., -1:]
-        GR, HR, CR = GT - GL, HT - HL, CT - CL
-        gain = gain_term(GL, HL) + gain_term(GR, HR) - gain_term(GT, HT)
-        valid = (
-            (jnp.arange(B) < B - 1)  # split point must leave a non-empty right range
-            & (CL >= cfg.min_data_in_leaf)
-            & (CR >= cfg.min_data_in_leaf)
-            & (HL >= cfg.min_sum_hessian)
-            & (HR >= cfg.min_sum_hessian)
-            & (feature_mask[None, :, None] > 0)
-        )
-        gain = jnp.where(valid, gain, -jnp.inf)
-        flat = gain.reshape(L, d * B)
+        """Best (gain, feature, bin) per leaf. (L,) each.
+
+        ``hists`` (L, d, B, 3) — fully reduced in 'data' mode, local in
+        'voting' mode (reduction of candidates happens here).
+        """
+        if not voting:
+            gain = combined_gain(hists, feature_mask,
+                                 cat_mask if has_cat else None)   # (L, d, B)
+            flat = gain.reshape(L, d * B)
+            idx = jnp.argmax(flat, axis=-1)
+            best_gain = jnp.take_along_axis(flat, idx[:, None], axis=-1)[:, 0]
+            active = jnp.arange(L) < n_active
+            return (jnp.where(active, best_gain, -jnp.inf),
+                    idx // B, idx % B)
+
+        # -- voting-parallel (PV-tree): vote -> select -> reduce candidates ----
+        local_gain = combined_gain(hists, feature_mask,
+                                   cat_mask if has_cat else None)  # (L, d, B)
+        per_feat = local_gain.max(-1)                              # (L, d)
+        topk_idx = lax.top_k(per_feat, k_local)[1]                 # (L, k)
+        votes = jnp.zeros((L, d)).at[jnp.arange(L)[:, None], topk_idx].add(1.0)
+        votes = lax.psum(votes, axis_name)
+        # deterministic global selection on every shard
+        sel = lax.top_k(votes, k_global)[1]                        # (L, 2k)
+        cand = jnp.take_along_axis(
+            hists, sel[:, :, None, None], axis=1)                  # (L, 2k, B, 3)
+        cand = lax.psum(cand, axis_name)
+        fmask_sel = jnp.take(feature_mask, sel)                    # (L, 2k)
+        cmask_sel = jnp.take(cat_mask, sel) if has_cat else None
+        gain = combined_gain(cand, fmask_sel, cmask_sel)           # (L, 2k, B)
+        flat = gain.reshape(L, k_global * B)
         idx = jnp.argmax(flat, axis=-1)
         best_gain = jnp.take_along_axis(flat, idx[:, None], axis=-1)[:, 0]
+        feat = jnp.take_along_axis(sel, (idx // B)[:, None], axis=1)[:, 0]
         active = jnp.arange(L) < n_active
-        return jnp.where(active, best_gain, -jnp.inf), idx // B, idx % B
+        return jnp.where(active, best_gain, -jnp.inf), feat, idx % B
+
+    def split_detail(hists, l, f_sel, b_sel):
+        """Left-membership over bins for the chosen split (B,) bool, plus the
+        categorical flag. Uses the REDUCED histogram row so every shard derives
+        the same category set."""
+        row = jnp.take(jnp.take(hists, l, axis=0), f_sel, axis=0)  # (B, 3)
+        if voting:
+            row = lax.psum(row, axis_name)
+        if has_cat:
+            is_cat = jnp.take(cat_mask, f_sel) > 0
+            ratio = row[:, 0] / (row[:, 1] + cfg.cat_smooth)
+            rank = jnp.argsort(jnp.argsort(-ratio))
+            in_set_cat = rank <= b_sel
+            in_set_num = jnp.arange(B) <= b_sel
+            return jnp.where(is_cat, in_set_cat, in_set_num), is_cat
+        return jnp.arange(B) <= b_sel, jnp.zeros((), jnp.bool_)
 
     def step(s, state):
-        node, hists, parent, feat, bin_, gains = state
+        node, hists, parent, feat, bin_, gains, cat_sets = state
         leaf_gain, leaf_f, leaf_b = best_splits(hists, s + 1)
         l = jnp.argmax(leaf_gain)
         g_best = leaf_gain[l]
         ok = g_best > jnp.maximum(cfg.min_gain_to_split, 0.0)
         f_sel = leaf_f[l]
         b_sel = leaf_b[l]
+        in_set, is_cat = split_detail(hists, l, f_sel, b_sel)
         col = jnp.take(binned, f_sel, axis=1)
-        went_right = (node == l) & (col > b_sel) & ok
+        go_left = jnp.take(in_set, col)
+        went_right = (node == l) & ~go_left & ok
         node = jnp.where(went_right, s + 1, node)
         child = hist_of(row_weight * went_right.astype(jnp.float32))
         hists = jnp.where(
@@ -131,9 +230,12 @@ def grow_tree(binned, grad, hess, row_weight, feature_mask, cfg: TreeConfig,
         )
         parent = parent.at[s].set(jnp.where(ok, l, -1).astype(jnp.int32))
         feat = feat.at[s].set(f_sel.astype(jnp.int32))
-        bin_ = bin_.at[s].set(b_sel.astype(jnp.int32))
+        bin_ = bin_.at[s].set(
+            jnp.where(is_cat, -1, b_sel).astype(jnp.int32))
         gains = gains.at[s].set(jnp.where(ok, g_best, 0.0).astype(jnp.float32))
-        return node, hists, parent, feat, bin_, gains
+        cat_sets = cat_sets.at[s].set(
+            (in_set & is_cat & ok).astype(jnp.int8))
+        return node, hists, parent, feat, bin_, gains, cat_sets
 
     root_hist = hist_of(row_weight)
     hists0 = jnp.zeros((L, d, B, 3), dtype=jnp.float32).at[0].set(root_hist)
@@ -144,15 +246,20 @@ def grow_tree(binned, grad, hess, row_weight, feature_mask, cfg: TreeConfig,
         jnp.zeros(L - 1, dtype=jnp.int32),
         jnp.zeros(L - 1, dtype=jnp.int32),
         jnp.zeros(L - 1, dtype=jnp.float32),
+        jnp.zeros((L - 1, B), dtype=jnp.int8),
     )
-    node, hists, parent, feat, bin_, gains = lax.fori_loop(0, L - 1, step, state0)
+    node, hists, parent, feat, bin_, gains, cat_sets = lax.fori_loop(
+        0, L - 1, step, state0)
 
     # leaf totals: sum over bins of any one feature covers every row exactly once
     G_leaf = hists[:, 0, :, 0].sum(-1)
     H_leaf = hists[:, 0, :, 1].sum(-1)
+    if voting:
+        G_leaf = lax.psum(G_leaf, axis_name)
+        H_leaf = lax.psum(H_leaf, axis_name)
     leaf_value = -_thresh_l1(G_leaf, l1) / (H_leaf + l2)
     leaf_value = jnp.where(H_leaf > 0, leaf_value, 0.0)
-    return GrownTree(parent, feat, bin_, gains, leaf_value, H_leaf), node
+    return GrownTree(parent, feat, bin_, gains, leaf_value, H_leaf, cat_sets), node
 
 
 def predict_binned(tree: GrownTree, binned):
@@ -165,6 +272,9 @@ def predict_binned(tree: GrownTree, binned):
     for s in range(L1):
         p = tree.parent[s]
         col = jnp.take(binned, tree.feature[s], axis=1)
-        go_right = (node == p) & (col > tree.bin[s]) & (p >= 0)
+        is_cat = tree.bin[s] < 0
+        go_left_cat = jnp.take(tree.cat_set[s], col) > 0
+        go_left = jnp.where(is_cat, go_left_cat, col <= tree.bin[s])
+        go_right = (node == p) & ~go_left & (p >= 0)
         node = jnp.where(go_right, s + 1, node)
     return node

@@ -452,3 +452,92 @@ def test_treeshap_additivity():
     contrib = booster.predict_contrib(x[:50])
     raw = booster.raw_predict(x[:50], backend="host")
     np.testing.assert_allclose(contrib.sum(axis=1), raw, atol=1e-5)
+
+
+# -- categorical features (round 2) --------------------------------------------------
+
+
+def test_categorical_split_beats_numeric_encoding():
+    """A target keyed to a scattered category set is learnable in one split
+    with categorical handling but needs many threshold splits without."""
+    rng = np.random.default_rng(60)
+    n = 2000
+    cats = rng.integers(0, 20, size=n).astype(np.float64)
+    hot = np.isin(cats, [1, 5, 7, 11, 16, 19])
+    y = hot.astype(np.float64)
+    x = np.stack([cats, rng.normal(size=n)], axis=1)
+
+    params = {"objective": "binary", "num_iterations": 4, "num_leaves": 4,
+              "min_data_in_leaf": 5, "categorical_feature": [0]}
+    b_cat = train(params, x, y)
+    acc_cat = ((b_cat.predict(x) > 0.5) == (y > 0.5)).mean()
+    assert acc_cat > 0.99
+
+    b_num = train({**params, "categorical_feature": None}, x, y)
+    acc_num = ((b_num.predict(x) > 0.5) == (y > 0.5)).mean()
+    assert acc_cat >= acc_num
+
+
+def test_categorical_roundtrip_and_device_predict():
+    rng = np.random.default_rng(61)
+    n = 800
+    cats = rng.integers(0, 12, size=n).astype(np.float64)
+    y = np.isin(cats, [2, 3, 9]).astype(np.float64) + 0.1 * rng.normal(size=n)
+    x = np.stack([cats, rng.normal(size=n)], axis=1)
+    b = train({"objective": "regression", "num_iterations": 5, "num_leaves": 6,
+               "min_data_in_leaf": 5, "categorical_feature": [0]}, x, y)
+    assert b.cat_set is not None
+    # host == device on categorical models
+    np.testing.assert_allclose(b.raw_predict(x, backend="host"),
+                               b.raw_predict(x, backend="device"),
+                               rtol=1e-5, atol=1e-5)
+    # JSON model-string round trip preserves category sets
+    b2 = GBDTBooster.from_json(b.to_json())
+    np.testing.assert_allclose(b.predict(x), b2.predict(x), rtol=1e-6)
+    # unseen category at predict time -> missing bin, no crash
+    x_unseen = np.array([[99.0, 0.0]])
+    assert np.isfinite(b.predict(x_unseen)).all()
+
+
+def test_categorical_treeshap_additivity():
+    rng = np.random.default_rng(62)
+    n = 600
+    cats = rng.integers(0, 8, size=n).astype(np.float64)
+    x = np.stack([cats, rng.normal(size=n)], axis=1)
+    y = np.isin(cats, [1, 4]).astype(np.float64) + x[:, 1]
+    b = train({"objective": "regression", "num_iterations": 4, "num_leaves": 6,
+               "min_data_in_leaf": 5, "categorical_feature": [0]}, x, y)
+    contrib = b.predict_contrib(x[:20])
+    raw = b.raw_predict(x[:20], backend="host")
+    np.testing.assert_allclose(contrib.sum(axis=1), raw, atol=1e-5)
+
+
+# -- voting parallel (round 2) -------------------------------------------------------
+
+
+def test_voting_parallel_trains_accurately(eight_device_mesh):
+    rng = np.random.default_rng(63)
+    n, d = 4096, 24
+    x = rng.normal(size=(n, d))
+    y = (x[:, 3] + 0.7 * x[:, 11] - 0.5 * x[:, 17] > 0).astype(np.float64)
+    params = {"objective": "binary", "num_iterations": 10, "num_leaves": 15,
+              "min_data_in_leaf": 5}
+    b_vote = train({**params, "parallelism": "voting_parallel", "top_k": 4},
+                   x, y, mesh=eight_device_mesh)
+    acc = ((b_vote.predict(x) > 0.5) == (y > 0.5)).mean()
+    assert acc > 0.93
+    # informative features must be the ones the voted trees split on
+    used = set(b_vote.feature[b_vote.parent >= 0].tolist())
+    assert {3, 11, 17} & used
+
+
+def test_voting_parallel_single_replica_matches_data_parallel():
+    """Without a mesh, voting degenerates to the exact data_parallel tree."""
+    rng = np.random.default_rng(64)
+    x = rng.normal(size=(500, 8))
+    y = x[:, 0] - x[:, 5]
+    params = {"objective": "regression", "num_iterations": 3, "num_leaves": 7,
+              "min_data_in_leaf": 5}
+    b_d = train({**params, "parallelism": "data_parallel"}, x, y)
+    b_v = train({**params, "parallelism": "voting_parallel"}, x, y)
+    np.testing.assert_allclose(b_d.predict(x), b_v.predict(x), rtol=1e-6)
