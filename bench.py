@@ -1,11 +1,17 @@
-"""Headline benchmark: ONNX ResNet-50 inference throughput, images/sec/chip.
+"""Headline benchmarks over the BASELINE.json north-star configs.
 
-BASELINE.json config #1 (ImageFeaturizer ResNet-50 ONNX). The reference has no
-published TPU numbers (``published: {}``), so ``vs_baseline`` is null.
+Configs (BASELINE.md "North-star targets"):
+  #1 ResNet-50 ONNX inference             -> images/sec/chip (+ MFU)
+  #2 LightGBMClassifier, Adult-scale      -> train rows/sec (32k x 14, 100 iters)
+  #3 ONNXModel BERT-base seq class.       -> sequences/sec (+ MFU)
+  #4 LightGBMRegressor, HIGGS-scale       -> train rows/sec (11M x 28 on TPU)
+  #5 ViT-B/16 -> GBDT pipeline            -> images/sec end-to-end
 
-Prints exactly one JSON line:
-    {"metric": "resnet50_onnx_images_per_sec_per_chip", "value": N,
-     "unit": "images/sec/chip", "vs_baseline": null}
+Prints exactly ONE JSON line: the headline metric (config #1) plus an
+``extra`` dict carrying every config's number and the FLOPs-based MFU
+estimates. MFU = achieved_flops / peak_flops, with peak looked up from the
+device kind (null when unknown). The reference publishes no TPU numbers
+(``published: {}``), so ``vs_baseline`` is null.
 """
 
 from __future__ import annotations
@@ -16,45 +22,192 @@ import time
 
 import numpy as np
 
+# bf16 peak FLOPs by TPU generation (public figures); None -> MFU not reported
+PEAK_FLOPS = {
+    "v5litepod": 197e12, "v5lite": 197e12, "v5e": 197e12,
+    "v5p": 459e12, "v5": 459e12,
+    "v6e": 918e12, "v6lite": 918e12,
+    "v4": 275e12, "v3": 123e12, "v2": 45e12,
+}
 
-def main() -> None:
+
+def _peak_flops(dev) -> float | None:
+    kind = (getattr(dev, "device_kind", "") or "").lower().replace(" ", "")
+    for k, v in PEAK_FLOPS.items():  # ordered most-specific first
+        if k in kind:
+            return v
+    return None
+
+
+def _timed(fn, sync, warmup: int = 2, iters: int = 10):
+    """Chain iterations through a device-side accumulator and sync ONCE — the
+    dependency chain keeps the device busy back-to-back and is immune to
+    async-dispatch quirks on tunneled backends."""
+    for _ in range(warmup):
+        sync(fn())
+    t0 = time.perf_counter()
+    acc = None
+    for _ in range(iters):
+        out = fn()
+        acc = out if acc is None else acc + out
+    sync(acc)
+    return (time.perf_counter() - t0) / iters
+
+
+def bench_resnet50(platform, peak):
     import jax
+    import jax.numpy as jnp
 
     from synapseml_tpu.models.zoo import build_model_bytes
     from synapseml_tpu.onnx.importer import OnnxFunction
 
     fn = OnnxFunction(build_model_bytes("ResNet50"), dtype_policy="bfloat16")
-
-    platform = jax.devices()[0].platform
-    batch = 128 if platform != "cpu" else 16
+    batch = 128 if platform != "cpu" else 8
     rng = np.random.default_rng(0)
-    # Device-resident input: measures engine throughput, not host-link bandwidth.
     data = jax.device_put(rng.normal(size=(batch, 3, 224, 224)).astype(np.float32))
 
-    import jax.numpy as jnp
+    def run():
+        return fn({"data": data})["logits"].sum()
 
-    def run(iters):
-        # Chain every iteration into a device-side accumulator and sync ONCE at
-        # the end — immune to async-dispatch / block_until_ready quirks on
-        # tunneled backends.
-        acc = jnp.zeros(())
-        t0 = time.perf_counter()
-        for _ in range(iters):
-            out = fn({"data": data})
-            acc = acc + out["logits"].sum()
-        float(acc)
-        return time.perf_counter() - t0
+    iters = 30 if platform != "cpu" else 2
+    dt = _timed(run, lambda o: float(o), warmup=3, iters=iters)
+    ips = batch / dt
+    flops_per_img = 4.09e9 * 2  # ~4.09 GMACs fwd (He et al. / v1.5)
+    mfu = ips * flops_per_img / peak if peak else None
+    return {"images_per_sec_per_chip": round(ips, 2),
+            "mfu": round(mfu, 4) if mfu else None}
 
-    run(3)  # warmup: model compile + accumulator graph compile
-    iters = 30 if platform != "cpu" else 3
-    dt = run(iters)
 
-    images_per_sec = batch * iters / dt
+def bench_bert(platform, peak):
+    import jax
+
+    from synapseml_tpu.models.zoo import build_model_bytes
+    from synapseml_tpu.onnx.importer import OnnxFunction
+
+    L, H, FFN, S = 12, 768, 3072, 128
+    fn = OnnxFunction(build_model_bytes("BERTBase"), dtype_policy="bfloat16")
+    batch = 64 if platform != "cpu" else 4
+    rng = np.random.default_rng(1)
+    ids = jax.device_put(rng.integers(0, 30000, size=(batch, S)).astype(np.int64))
+    mask = jax.device_put(np.ones((batch, S), dtype=np.int64))
+
+    def run():
+        out = fn({"input_ids": ids, "attention_mask": mask})
+        return next(iter(out.values())).sum()
+
+    iters = 20 if platform != "cpu" else 2
+    dt = _timed(run, lambda o: float(o), warmup=3, iters=iters)
+    sps = batch / dt
+    # matmul MACs per layer: qkv+out 4H^2 per token + ffn 2*H*FFN per token
+    # + attention scores/values 2*S*H per token
+    macs_per_seq = L * S * (4 * H * H + 2 * H * FFN + 2 * S * H)
+    mfu = sps * macs_per_seq * 2 / peak if peak else None
+    return {"sequences_per_sec_per_chip": round(sps, 2), "seq_len": S,
+            "mfu": round(mfu, 4) if mfu else None}
+
+
+def bench_gbdt_adult(platform):
+    from synapseml_tpu.gbdt.boost import train
+
+    n, d = (32561, 14) if platform != "cpu" else (8192, 14)
+    rng = np.random.default_rng(2)
+    x = rng.normal(size=(n, d))
+    y = (x[:, 0] + 0.5 * x[:, 3] - 0.3 * x[:, 7] + 0.2 * rng.normal(size=n)
+         > 0).astype(np.float64)
+    iters = 100 if platform != "cpu" else 10
+
+    params = {"objective": "binary", "num_iterations": iters, "num_leaves": 31,
+              "max_bin": 255}
+    # 2-iteration warmup populates the XLA compilation cache; the timed train
+    # runs iterations fully pipelined on device (no per-iter host sync)
+    train({**params, "num_iterations": 2}, x, y)
+    t0 = time.perf_counter()
+    train(params, x, y)
+    dt = time.perf_counter() - t0
+    return {"train_rows_per_sec": round(n * iters / dt, 0), "rows": n,
+            "iterations": iters}
+
+
+def bench_gbdt_higgs(platform):
+    from synapseml_tpu.gbdt.boost import train
+
+    n, d = (11_000_000, 28) if platform != "cpu" else (200_000, 28)
+    iters = 10
+    rng = np.random.default_rng(3)
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    y = (x[:, 0] + 0.4 * x[:, 5] > 0).astype(np.float64)
+
+    params = {"objective": "regression", "num_iterations": iters, "num_leaves": 31,
+              "max_bin": 63, "hist_chunk": 8192}
+    train({**params, "num_iterations": 2}, x, y)
+    t0 = time.perf_counter()
+    train(params, x, y)
+    dt = time.perf_counter() - t0
+    return {"train_rows_per_sec": round(n * iters / dt, 0), "rows": n,
+            "iterations": iters}
+
+
+def bench_vit_gbdt(platform, peak):
+    import jax
+
+    from synapseml_tpu.gbdt.boost import train
+    from synapseml_tpu.models.zoo import build_model_bytes
+    from synapseml_tpu.onnx.importer import OnnxFunction
+
+    fn = OnnxFunction(build_model_bytes("ViTB16"), dtype_policy="bfloat16")
+    batch = 64 if platform != "cpu" else 4
+    rng = np.random.default_rng(4)
+    data = jax.device_put(rng.normal(size=(batch, 3, 224, 224)).astype(np.float32))
+
+    # fit a small booster on ViT features once (pipeline setup)
+    feats = np.asarray(fn({"data": data})["features"], np.float64)
+    yb = (feats[:, 0] > np.median(feats[:, 0])).astype(np.float64)
+    booster = train({"objective": "binary", "num_iterations": 10,
+                     "num_leaves": 15, "min_data_in_leaf": 2}, feats, yb)
+
+    def run():
+        # featurize -> device binning -> device tree scan: zero host transfers
+        f = fn({"data": data})["features"]
+        return booster.predict_device(f).sum()
+
+    iters = 10 if platform != "cpu" else 2
+    dt = _timed(run, lambda o: float(o), warmup=2, iters=iters)
+    ips = batch / dt
+    mfu = ips * 17.6e9 * 2 / peak if peak else None  # ViT-B/16 ~17.6 GMACs/img
+    return {"images_per_sec_end_to_end": round(ips, 2),
+            "mfu_vit_only": round(mfu, 4) if mfu else None}
+
+
+def main() -> None:
+    import jax
+
+    dev = jax.devices()[0]
+    platform = dev.platform
+    peak = _peak_flops(dev)
+
+    extra = {"device_kind": getattr(dev, "device_kind", platform),
+             "peak_bf16_flops": peak}
+    headline = None
+    for key, fn in [
+        ("resnet50_onnx", lambda: bench_resnet50(platform, peak)),
+        ("gbdt_adult_scale", lambda: bench_gbdt_adult(platform)),
+        ("bert_base_onnx", lambda: bench_bert(platform, peak)),
+        ("gbdt_higgs_scale", lambda: bench_gbdt_higgs(platform)),
+        ("vit_to_gbdt_pipeline", lambda: bench_vit_gbdt(platform, peak)),
+    ]:
+        try:
+            extra[key] = fn()
+        except Exception as e:  # record, keep benching
+            extra[key] = {"error": f"{type(e).__name__}: {e}"}
+        if key == "resnet50_onnx" and "images_per_sec_per_chip" in extra[key]:
+            headline = extra[key]["images_per_sec_per_chip"]
+
     print(json.dumps({
         "metric": "resnet50_onnx_images_per_sec_per_chip",
-        "value": round(images_per_sec, 2),
+        "value": headline,
         "unit": "images/sec/chip",
         "vs_baseline": None,
+        "extra": extra,
     }))
 
 

@@ -444,6 +444,56 @@ class GBDTBooster:
             return np.exp(raw)
         return raw
 
+    def raw_predict_device(self, x, num_iteration: Optional[int] = None):
+        """Fully on-device raw margin for a device-resident float feature array.
+
+        Chains device binning (``device_predict.device_bin``) into the jitted
+        tree scan with NO host transfer — the path that keeps multi-stage
+        pipelines (e.g. ViT featurizer -> GBDT, BASELINE config #5) resident on
+        the chip. Numeric features only (categorical needs the host value->code
+        map). Returns a jax array (n, C).
+        """
+        import jax.numpy as jnp
+
+        from .device_predict import _score_kernel, device_bin, pack_edges
+
+        if self.mapper.cat_values:
+            raise ValueError("raw_predict_device supports numeric features only; "
+                             "use raw_predict for categorical models")
+        T = self._used_trees(num_iteration)
+        edges, lens = pack_edges(self.mapper)
+        binned = device_bin(x, jnp.asarray(edges), jnp.asarray(lens),
+                            self.mapper.missing_bin)
+        if T == 0:
+            return jnp.tile(jnp.asarray(self.base_score, jnp.float32),
+                            (binned.shape[0], 1))
+        k = _score_kernel(T, self.num_class, self.parent.shape[2], False)
+        scores = k(binned, self.parent[:T].astype(np.int32),
+                   self.feature[:T].astype(np.int32),
+                   self.bin[:T].astype(np.int32),
+                   np.zeros((T, self.num_class, self.parent.shape[2], 1), np.int8),
+                   self.leaf_value[:T].astype(np.float32),
+                   np.asarray(self.tree_scale[:T], np.float64))
+        out = scores + jnp.asarray(self.base_score, jnp.float32)[None, :]
+        if self.boosting == "rf" and T > 0:
+            out = jnp.asarray(self.base_score, jnp.float32)[None, :] + \
+                (out - jnp.asarray(self.base_score, jnp.float32)[None, :]) / T
+        return out
+
+    def predict_device(self, x, num_iteration: Optional[int] = None):
+        """On-device transformed prediction (sigmoid/softmax/exp per objective)."""
+        import jax
+        import jax.numpy as jnp
+
+        raw = self.raw_predict_device(x, num_iteration)
+        if self.objective == "binary":
+            return jax.nn.sigmoid(raw[:, 0])
+        if self.objective in ("multiclass", "softmax"):
+            return jax.nn.softmax(raw, axis=1)
+        if self.objective in ("poisson", "tweedie"):
+            return jnp.exp(raw[:, 0] if self.num_class == 1 else raw)
+        return raw[:, 0] if self.num_class == 1 else raw
+
     def predict_leaf(self, x: np.ndarray, num_iteration: Optional[int] = None,
                      backend: str = "auto") -> np.ndarray:
         """Leaf index per (row, tree*class) — reference ``predictLeaf``."""
@@ -955,6 +1005,13 @@ def train(params: Dict[str, Any], x: np.ndarray, y: np.ndarray,
     num_iter = int(p["num_iterations"])
     stopped_early = False
 
+    # Only dart bookkeeping, per-iteration eval, and user callbacks need the
+    # tree on the HOST mid-loop. Without them, keep trees as device buffers and
+    # pull everything once after the loop — iterations then pipeline
+    # back-to-back on the device with no per-iteration host round-trip (the
+    # round-trip dominates wall time on tunneled/remote backends).
+    sync_each_iter = bool(eval_binned) or boosting == "dart" or bool(callbacks)
+
     for it in range(num_iter):
         key, k2 = jax.random.split(key)
         # LightGBM re-bags every bagging_freq iterations and reuses the bag
@@ -976,8 +1033,11 @@ def train(params: Dict[str, Any], x: np.ndarray, y: np.ndarray,
                 raw_d = _reput(raw_np, raw_d)
 
         trees, raw_d = step(binned_d, y_d, w_d, raw_d, k1, k2)
-        tree_np = jax.tree.map(np.asarray, trees)
-        trees_host.append(tree_np)
+        if sync_each_iter:
+            tree_np = jax.tree.map(np.asarray, trees)
+            trees_host.append(tree_np)
+        else:
+            trees_host.append(trees)  # device buffers; converted after the loop
 
         scale = 1.0
         if boosting == "dart" and dart_dropped:
@@ -1032,6 +1092,20 @@ def train(params: Dict[str, Any], x: np.ndarray, y: np.ndarray,
             break
 
     # -- assemble host model --------------------------------------------------------
+    if not sync_each_iter and trees_host:
+        if mesh is None:
+            # stack per-field ON DEVICE first: one transfer per field instead
+            # of fields*T tiny transfers (each costs a full RPC round-trip on
+            # tunneled backends — this is the difference between ~1s and ~80s
+            # for a 100-iteration model)
+            stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *trees_host)
+            stacked_np = jax.device_get(stacked)
+            trees_host = [jax.tree.map(lambda a, i=i: a[i], stacked_np)
+                          for i in range(len(trees_host))]
+        else:
+            # mesh outputs carry shard_map shardings; stacking mixed-sharded
+            # arrays is not supported — pull per tree (replicated, local)
+            trees_host = [jax.tree.map(np.asarray, t) for t in trees_host]
     T = len(trees_host)
     parent = np.stack([t.parent for t in trees_host]) if T else np.zeros((0, C, L - 1), np.int32)
     feature = np.stack([t.feature for t in trees_host]) if T else np.zeros((0, C, L - 1), np.int32)

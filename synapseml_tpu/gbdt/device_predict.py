@@ -145,3 +145,36 @@ def device_raw_scores(binned: np.ndarray, parent: np.ndarray,
             bins.astype(np.int32), cs.astype(np.int8),
             leaf_value.astype(np.float32), np.asarray(scale, np.float64))
     return np.asarray(out)[:n]
+
+
+def pack_edges(mapper) -> Tuple[np.ndarray, np.ndarray]:
+    """Per-feature upper edges -> padded (d, Emax) f32 matrix + (d,) edge counts.
+
+    Padding is +inf, which never compares below a finite value, so the device
+    bin computation needs no per-feature masking.
+    """
+    edges = mapper.upper_edges
+    emax = max(len(e) for e in edges)
+    out = np.full((len(edges), emax), np.inf, dtype=np.float32)
+    lens = np.empty(len(edges), dtype=np.int32)
+    for j, e in enumerate(edges):
+        out[j, : len(e)] = e
+        lens[j] = len(e)
+    return out, lens
+
+
+def device_bin(x, edges, lens, missing_bin: int):
+    """(n, d) float features -> (n, d) int32 bins, entirely on device.
+
+    Matches ``BinMapper.transform`` bit-for-bit for numeric features:
+    ``searchsorted(edges, v, 'left')`` == count of edges strictly below ``v``,
+    clamped to the feature's last bin; non-finite values land in the missing
+    bin. (Categorical features need the host value->code map — callers fall
+    back to the host path when the mapper has any.)
+    """
+    import jax.numpy as jnp
+
+    x = jnp.asarray(x)
+    below = (edges[None, :, :] < x[:, :, None]).sum(-1).astype(jnp.int32)
+    bins = jnp.minimum(below, lens[None, :] - 1)
+    return jnp.where(jnp.isfinite(x), bins, missing_bin).astype(jnp.int32)

@@ -1,0 +1,177 @@
+"""Accuracy-ratchet harness: deterministic datasets + standard training configs.
+
+Mirrors the reference's benchmark regression tests
+(``core/src/test/.../benchmarks/Benchmarks.scala:15-80`` +
+``lightgbm/src/test/resources/benchmarks/benchmarks_VerifyLightGBMClassifier.csv``):
+metric values measured once are committed to CSV with a per-metric precision,
+and the test suite re-trains and asserts each value within that precision —
+a silent quality regression fails CI.
+
+Datasets are synthetic but DETERMINISTIC (fixed seeds, fixed generators), the
+environment's substitute for the reference's committed CSV datasets
+(zero-egress: no downloads).
+"""
+
+from __future__ import annotations
+
+import csv
+import os
+from typing import Dict, Tuple
+
+import numpy as np
+
+BENCH_DIR = os.path.join(os.path.dirname(__file__), "benchmarks")
+
+
+# -- deterministic datasets ---------------------------------------------------------
+
+def _ds_linear(seed=101, n=2000, d=10):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, d))
+    logit = x[:, 0] * 2 - x[:, 1] + 0.5 * x[:, 2] + 0.5 * rng.normal(size=n)
+    return x, (logit > 0).astype(np.float64)
+
+
+def _ds_xor(seed=102, n=2000):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, 6))
+    y = ((x[:, 0] > 0) ^ (x[:, 1] > 0)).astype(np.float64)
+    flip = rng.random(n) < 0.05
+    return x, np.where(flip, 1 - y, y)
+
+
+def _ds_imbalanced(seed=103, n=3000):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, 8))
+    y = (x[:, 0] + x[:, 3] > 1.8).astype(np.float64)  # ~10% positive
+    return x, y
+
+
+def _ds_categorical(seed=104, n=2500):
+    rng = np.random.default_rng(seed)
+    cats = rng.integers(0, 16, size=n).astype(np.float64)
+    x = np.stack([cats, rng.normal(size=n), rng.normal(size=n)], axis=1)
+    y = (np.isin(cats, [1, 3, 7, 12]) | (x[:, 1] > 1.2)).astype(np.float64)
+    return x, y
+
+
+def _ds_friedman(seed=105, n=2000):
+    rng = np.random.default_rng(seed)
+    x = rng.random((n, 10))
+    y = (10 * np.sin(np.pi * x[:, 0] * x[:, 1]) + 20 * (x[:, 2] - 0.5) ** 2
+         + 10 * x[:, 3] + 5 * x[:, 4] + rng.normal(size=n))
+    return x, y
+
+
+def _ds_peaks(seed=106, n=2000):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, 5))
+    y = x[:, 0] ** 2 - np.abs(x[:, 1]) + 0.3 * rng.normal(size=n)
+    return x, y
+
+
+CLF_DATASETS: Dict[str, Tuple] = {
+    "linear10": _ds_linear, "xor": _ds_xor,
+    "imbalanced": _ds_imbalanced, "categorical16": _ds_categorical,
+}
+REG_DATASETS: Dict[str, Tuple] = {"friedman": _ds_friedman, "peaks": _ds_peaks}
+
+CLF_VARIANTS = {
+    "gbdt": {"boosting": "gbdt"},
+    "rf": {"boosting": "rf", "bagging_fraction": 0.7, "bagging_freq": 1},
+    "dart": {"boosting": "dart"},
+    "goss": {"boosting": "goss"},
+}
+
+
+def _split(x, y, seed=7):
+    rng = np.random.default_rng(seed)
+    idx = rng.permutation(len(y))
+    cut = int(len(y) * 0.75)
+    tr, te = idx[:cut], idx[cut:]
+    return x[tr], y[tr], x[te], y[te]
+
+
+def auc(y_true, score):
+    order = np.argsort(score, kind="stable")
+    ranks = np.empty(len(score))
+    ranks[order] = np.arange(1, len(score) + 1)
+    pos = y_true > 0.5
+    n_pos, n_neg = pos.sum(), (~pos).sum()
+    return (ranks[pos].sum() - n_pos * (n_pos + 1) / 2) / (n_pos * n_neg)
+
+
+def measure_classifier(dataset: str, variant: str) -> float:
+    from synapseml_tpu.gbdt.boost import train
+
+    x, y = CLF_DATASETS[dataset]()
+    xtr, ytr, xte, yte = _split(x, y)
+    params = {"objective": "binary", "num_iterations": 50, "num_leaves": 15,
+              "min_data_in_leaf": 10, "seed": 0, **CLF_VARIANTS[variant]}
+    if dataset == "categorical16":
+        params["categorical_feature"] = [0]
+    b = train(params, xtr, ytr)
+    return float(auc(yte, b.predict(xte)))
+
+
+def measure_regressor(dataset: str, variant: str) -> float:
+    from synapseml_tpu.gbdt.boost import train
+
+    x, y = REG_DATASETS[dataset]()
+    xtr, ytr, xte, yte = _split(x, y)
+    params = {"objective": "regression", "num_iterations": 60, "num_leaves": 15,
+              "min_data_in_leaf": 10, "seed": 0, **CLF_VARIANTS[variant]}
+    b = train(params, xtr, ytr)
+    return float(np.sqrt(np.mean((b.predict(xte) - yte) ** 2)))
+
+
+def measure_train_classifier(dataset: str) -> float:
+    """TrainClassifier AUC (reference benchmarks_VerifyTrainClassifier.csv)."""
+    from synapseml_tpu.core import Table
+    from synapseml_tpu.gbdt import LightGBMClassifier
+    from synapseml_tpu.train import TrainClassifier
+
+    x, y = CLF_DATASETS[dataset]()
+    xtr, ytr, xte, yte = _split(x, y)
+    tc = TrainClassifier(model=LightGBMClassifier(num_iterations=30, num_leaves=15),
+                         label_col="label")
+    fitted = tc.fit(Table({"features": x_cols(xtr), "label": ytr}))
+    out = fitted.transform(Table({"features": x_cols(xte), "label": yte}))
+    prob = out["probability"]
+    score = np.asarray([v[1] for v in prob] if prob.dtype == object
+                       else prob[:, 1])
+    return float(auc(yte, score))
+
+
+def x_cols(x):
+    return np.asarray(x, np.float64)
+
+
+def measure_tune(dataset: str) -> float:
+    """TuneHyperparameters best metric (reference benchmarks_VerifyTuneHyperparameters.csv)."""
+    from synapseml_tpu.automl import TuneHyperparameters
+    from synapseml_tpu.core import Table
+    from synapseml_tpu.gbdt import LightGBMClassifier
+
+    x, y = CLF_DATASETS[dataset]()
+    tuner = TuneHyperparameters(
+        models=LightGBMClassifier(),
+        hyperparams={"num_leaves": [7, 15], "num_iterations": [20, 40]},
+        search_mode="grid", evaluation_metric="auc", seed=0, parallelism=1)
+    fitted = tuner.fit(Table({"features": x, "label": y}))
+    return float(fitted.best_metric)
+
+
+def read_benchmarks(name: str):
+    path = os.path.join(BENCH_DIR, name)
+    with open(path) as f:
+        return list(csv.DictReader(f))
+
+
+def write_benchmarks(name: str, rows, fields):
+    os.makedirs(BENCH_DIR, exist_ok=True)
+    path = os.path.join(BENCH_DIR, name)
+    with open(path, "w", newline="") as f:
+        w = csv.DictWriter(f, fieldnames=fields)
+        w.writeheader()
+        w.writerows(rows)

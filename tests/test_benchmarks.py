@@ -1,0 +1,45 @@
+"""Accuracy-ratchet regression tests.
+
+Reference: ``Benchmarks.compareBenchmark`` asserting each committed metric
+within its precision (``core/src/test/.../benchmarks/Benchmarks.scala:70-80``;
+CSVs like ``benchmarks_VerifyLightGBMClassifier.csv`` — 33 AUC entries).
+A silent quality regression in the GBDT engine, TrainClassifier path, or the
+tuner fails one of these rows.
+"""
+
+import pytest
+
+import benchmark_utils as bu
+
+
+def _rows(name):
+    return [pytest.param(r, id=f"{r['dataset']}-{r['variant']}")
+            for r in bu.read_benchmarks(name)]
+
+
+def _compare(measured: float, row: dict):
+    expected = float(row["value"])
+    precision = float(row["precision"])
+    assert abs(measured - expected) <= precision, (
+        f"{row['dataset']}/{row['variant']} {row['metric']}: measured "
+        f"{measured:.4f}, expected {expected:.4f} ± {precision}")
+
+
+@pytest.mark.parametrize("row", _rows("benchmarks_gbdt_classifier.csv"))
+def test_classifier_benchmark(row):
+    _compare(bu.measure_classifier(row["dataset"], row["variant"]), row)
+
+
+@pytest.mark.parametrize("row", _rows("benchmarks_gbdt_regressor.csv"))
+def test_regressor_benchmark(row):
+    _compare(bu.measure_regressor(row["dataset"], row["variant"]), row)
+
+
+@pytest.mark.parametrize("row", _rows("benchmarks_train_classifier.csv"))
+def test_train_classifier_benchmark(row):
+    _compare(bu.measure_train_classifier(row["dataset"]), row)
+
+
+@pytest.mark.parametrize("row", _rows("benchmarks_tune_hyperparameters.csv"))
+def test_tune_hyperparameters_benchmark(row):
+    _compare(bu.measure_tune(row["dataset"]), row)

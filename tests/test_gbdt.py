@@ -541,3 +541,18 @@ def test_voting_parallel_single_replica_matches_data_parallel():
     b_d = train({**params, "parallelism": "data_parallel"}, x, y)
     b_v = train({**params, "parallelism": "voting_parallel"}, x, y)
     np.testing.assert_allclose(b_d.predict(x), b_v.predict(x), rtol=1e-6)
+
+
+def test_device_pipeline_predict_matches_host():
+    """device_bin + on-device tree scan == host transform + predict."""
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(70)
+    x = rng.normal(size=(300, 8))
+    y = (x[:, 0] - x[:, 4] > 0).astype(np.float64)
+    b = train({"objective": "binary", "num_iterations": 8, "num_leaves": 7}, x, y)
+    host = b.predict(x)
+    dev = np.asarray(b.predict_device(jnp.asarray(x, jnp.float32)))
+    # f32 device binning can flip rows that sit exactly on a bin edge; with
+    # random data none do, so predictions agree to f32 precision
+    np.testing.assert_allclose(host, dev, rtol=1e-5, atol=1e-5)
