@@ -38,12 +38,13 @@ _logger = get_logger("io.serving")
 
 
 class _Pending:
-    __slots__ = ("request", "response", "event")
+    __slots__ = ("request", "response", "event", "t_enqueue")
 
     def __init__(self, request: HTTPRequestData):
         self.request = request
         self.response: Optional[HTTPResponseData] = None
         self.event = threading.Event()
+        self.t_enqueue = time.perf_counter()
 
 
 class ServingServer:
@@ -59,6 +60,8 @@ class ServingServer:
         self._pending: Dict[str, _Pending] = {}
         self._queue: List[str] = []
         self._lock = threading.Lock()
+        from collections import deque
+        self._latencies = deque(maxlen=4096)
         self.requests_received = 0  # JVMSharedServer request counters (:96-105)
         self.responses_sent = 0
         outer = self
@@ -76,26 +79,38 @@ class ServingServer:
                     outer._pending[rid] = slot
                     outer._queue.append(rid)
                     outer.requests_received += 1
+                outer._on_enqueue()
                 if not slot.event.wait(outer.reply_timeout):
-                    with outer._lock:
-                        outer._pending.pop(rid, None)
-                    self.send_error(504, "serving engine timed out")
-                    return
+                    # raced reply? the engine may have set the response between
+                    # the timeout firing and this line — prefer the real reply
+                    if slot.response is None:
+                        with outer._lock:
+                            outer._pending.pop(rid, None)
+                        try:
+                            self.send_error(504, "serving engine timed out")
+                        except OSError:
+                            pass  # client already gone
+                        return
                 resp = slot.response
-                self.send_response(resp.status_code or 200)
-                # Content-Length is computed below; hop-by-hop headers are the
-                # server's to manage (RFC 7230 §6.1) — forwarding either from a
-                # pipeline-supplied response would emit duplicates/mis-framing.
-                skip = {"content-length", "transfer-encoding", "connection",
-                        "keep-alive", "upgrade", "proxy-authenticate",
-                        "proxy-authorization", "te", "trailer"}
-                for k, v in resp.headers.items():
-                    if k.lower() not in skip:
-                        self.send_header(k, v)
-                ent = resp.entity or b""
-                self.send_header("Content-Length", str(len(ent)))
-                self.end_headers()
-                self.wfile.write(ent)
+                try:
+                    self.send_response(resp.status_code or 200)
+                    # Content-Length is computed below; hop-by-hop headers are
+                    # the server's to manage (RFC 7230 §6.1) — forwarding either
+                    # from a pipeline-supplied response would emit
+                    # duplicates/mis-framing.
+                    skip = {"content-length", "transfer-encoding", "connection",
+                            "keep-alive", "upgrade", "proxy-authenticate",
+                            "proxy-authorization", "te", "trailer"}
+                    for k, v in resp.headers.items():
+                        if k.lower() not in skip:
+                            self.send_header(k, v)
+                    ent = resp.entity or b""
+                    self.send_header("Content-Length", str(len(ent)))
+                    self.end_headers()
+                    self.wfile.write(ent)
+                except (BrokenPipeError, ConnectionResetError, OSError):
+                    _logger.debug("serving: client disconnected before reply")
+                    return
                 with outer._lock:
                     outer.responses_sent += 1
 
@@ -108,11 +123,20 @@ class ServingServer:
             def log_message(self, fmt, *args):  # route into framework logging
                 _logger.debug("serving: " + fmt, *args)
 
-        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        class Server(ThreadingHTTPServer):
+            # handler threads must not block interpreter shutdown (they park on
+            # reply events for up to reply_timeout) — source of the fatal-exit
+            # flake when a test tears down mid-request
+            daemon_threads = True
+
+        self._httpd = Server((host, port), Handler)
         self.host, self.port = self._httpd.server_address[:2]
         self._thread = threading.Thread(target=self._httpd.serve_forever,
                                         name=f"serving-{self.port}", daemon=True)
         self._thread.start()
+
+    def _on_enqueue(self) -> None:
+        """Hook for push-mode engines (continuous serving overrides)."""
 
     @property
     def address(self) -> str:
@@ -136,8 +160,23 @@ class ServingServer:
             return
         slot.response = response
         slot.event.set()
+        self._latencies.append(time.perf_counter() - slot.t_enqueue)
+
+    def latency_quantile(self, q: float = 0.5) -> Optional[float]:
+        """Enqueue->reply latency quantile in seconds over recent requests."""
+        lat = list(self._latencies)
+        return float(np.quantile(lat, q)) if lat else None
 
     def close(self) -> None:
+        # release every held-open exchange with 503 so handler threads finish
+        # promptly instead of parking out their reply timeout
+        with self._lock:
+            pending = list(self._pending.items())
+            self._pending.clear()
+            self._queue.clear()
+        for _rid, slot in pending:
+            slot.response = HTTPResponseData(503, "server shutting down")
+            slot.event.set()
         self._httpd.shutdown()
         self._httpd.server_close()
 
