@@ -81,35 +81,31 @@ def kernel_shap_coalitions(rng: np.random.Generator, feature_size: int,
             sizes.append(m - k)
 
     budget = n - 2
-    enumerated_all = True
-    for k in sizes:
+    remaining_sizes: list = []
+    for i, k in enumerate(sizes):
         if budget <= 0:
             break
         c = comb(m, k)
-        if enumerated_all and c <= budget:
-            w = kernel_w(k)
-            for sub in combinations(range(m), k):
-                v = np.zeros(m)
-                v[list(sub)] = 1.0
-                rows.append(v)
-                weights.append(w)
-            budget -= c
-        else:
-            # budget no longer covers a full level: sample the rest uniformly
-            # over this and remaining sizes, weight 1 (reference
-            # allocateRemainingSamples assigns weight 1.0 to the overflow)
-            enumerated_all = False
-            take = min(budget, max(1, int(np.ceil(budget / max(1, len(sizes))))))
-            for _ in range(take):
-                sub = rng.choice(m, size=k, replace=False)
-                v = np.zeros(m)
-                v[sub] = 1.0
-                rows.append(v)
-                weights.append(1.0)
-            budget -= take
-    # spend any remainder on random sizes (deep levels of large m)
+        if c > budget:
+            # budget no longer covers a full level: everything from here on
+            # (this size AND all later ones) goes to the sampled fallback
+            remaining_sizes = sizes[i:]
+            break
+        w = kernel_w(k)
+        for sub in combinations(range(m), k):
+            v = np.zeros(m)
+            v[list(sub)] = 1.0
+            rows.append(v)
+            weights.append(w)
+        budget -= c
+    # Sampled fallback: draw each subset's SIZE uniformly from the
+    # not-yet-enumerated sizes so leftover budget spreads across all of them
+    # (matching the reference's allocateRemainingSamples allocation), with
+    # weight 1 (the reference assigns 1.0 to the overflow samples).
+    if not remaining_sizes:
+        remaining_sizes = list(range(1, m))  # deep levels of large m
     while budget > 0:
-        k = int(rng.integers(1, m))
+        k = int(remaining_sizes[int(rng.integers(len(remaining_sizes)))])
         sub = rng.choice(m, size=k, replace=False)
         v = np.zeros(m)
         v[sub] = 1.0

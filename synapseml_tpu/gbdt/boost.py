@@ -779,12 +779,17 @@ def train(params: Dict[str, Any], x: np.ndarray, y: np.ndarray,
             sigma=float(p["sigmoid"]))
     else:
         init_fn, grad_fn = _resolve_objective(p)
-    cat_features = sorted(set(p["categorical_feature"] or []))
-    if any(not isinstance(c, (int, np.integer)) for c in cat_features):
+    # Resolve names -> indices BEFORE sorting: the list may mix indices and
+    # names (estimators concatenate categorical_slot_indexes +
+    # categorical_slot_names, both settable simultaneously as in the
+    # reference API), and sorted() over mixed str/int raises TypeError.
+    cat_raw = list(p["categorical_feature"] or [])
+    if any(not isinstance(c, (int, np.integer)) for c in cat_raw):
         if not feature_names:
             raise ValueError("categorical_feature names require feature_names")
-        cat_features = sorted(feature_names.index(c) if isinstance(c, str) else int(c)
-                              for c in cat_features)
+        cat_raw = [feature_names.index(c) if isinstance(c, str) else int(c)
+                   for c in cat_raw]
+    cat_features = sorted({int(c) for c in cat_raw})
     if mapper is None:
         if init_booster is not None:
             mapper = init_booster.mapper

@@ -152,13 +152,26 @@ def pack_edges(mapper) -> Tuple[np.ndarray, np.ndarray]:
 
     Padding is +inf, which never compares below a finite value, so the device
     bin computation needs no per-feature masking.
+
+    BinMapper's edges are float64; the device path compares in float32, so
+    each edge is rounded DOWN to the nearest f32 (never up). The host bin is
+    the count of f64 edges strictly below ``v``; for f32-representable ``v``,
+    ``floor_f32(e64) < v  ⟺  e64 < v``: if ``e64 < v`` then
+    ``floor_f32(e64) ≤ e64 < v``; if ``v ≤ e64`` then ``v``, being an f32
+    no greater than ``e64``, satisfies ``v ≤ floor_f32(e64)``. Rounding up
+    would break the second case when the rounded edge lands exactly on a
+    data value (e.g. midpoint edges between adjacent f32 values).
     """
     edges = mapper.upper_edges
     emax = max(len(e) for e in edges)
     out = np.full((len(edges), emax), np.inf, dtype=np.float32)
     lens = np.empty(len(edges), dtype=np.int32)
     for j, e in enumerate(edges):
-        out[j, : len(e)] = e
+        e64 = np.asarray(e, dtype=np.float64)
+        e32 = e64.astype(np.float32)
+        floored = np.where(e32.astype(np.float64) > e64,
+                           np.nextafter(e32, np.float32(-np.inf)), e32)
+        out[j, : len(e)] = floored
         lens[j] = len(e)
     return out, lens
 
@@ -166,11 +179,13 @@ def pack_edges(mapper) -> Tuple[np.ndarray, np.ndarray]:
 def device_bin(x, edges, lens, missing_bin: int):
     """(n, d) float features -> (n, d) int32 bins, entirely on device.
 
-    Matches ``BinMapper.transform`` bit-for-bit for numeric features:
-    ``searchsorted(edges, v, 'left')`` == count of edges strictly below ``v``,
-    clamped to the feature's last bin; non-finite values land in the missing
-    bin. (Categorical features need the host value->code map — callers fall
-    back to the host path when the mapper has any.)
+    Matches ``BinMapper.transform`` for numeric features whose raw values are
+    f32-representable (the device case — see the rounding note on
+    ``pack_edges``): ``searchsorted(edges, v, 'left')`` == count of edges
+    strictly below ``v``, clamped to the feature's last bin; non-finite
+    values land in the missing bin. (Categorical features need the host
+    value->code map — callers fall back to the host path when the mapper has
+    any.)
     """
     import jax.numpy as jnp
 

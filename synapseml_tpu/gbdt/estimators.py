@@ -183,6 +183,17 @@ class _LightGBMBase(Estimator):
             kw["group"] = group(tr) if callable(group) else group
         if eval_groups is not None:
             kw["eval_group"] = eval_groups
+        # Resolve categorical_slot_names against the features column's
+        # slot-name metadata (the reference reads SparkML vector attribute
+        # metadata for the same purpose).
+        slot_names = table.meta.get(self.features_col, {}).get("slot_names")
+        if slot_names is not None:
+            kw["feature_names"] = list(slot_names)
+        elif self.categorical_slot_names:
+            raise ValueError(
+                "categorical_slot_names requires slot-name metadata on the "
+                f"features column: Table(meta={{{self.features_col!r}: "
+                "{'slot_names': [...]}})")
 
         n_batches = int(self.num_batches)
         if n_batches > 1 and group is not None:

@@ -227,8 +227,7 @@ class MicroBatchServingEngine:
                         500, "pipeline error", entity=str(e).encode()))
                 self._error = e
                 continue
-            for rid, rep in zip(out_ids, replies):
-                self.server.respond(rid, _coerce_response(rep))
+            respond_batch(self.server, ids, out_ids, replies)
             self.batches_processed += 1
 
     def stop(self) -> None:
@@ -237,6 +236,19 @@ class MicroBatchServingEngine:
         self.server.close()
         if self._error is not None:
             _logger.warning("serving engine saw pipeline errors; last: %s", self._error)
+
+
+def respond_batch(server, batch_ids, out_ids, replies) -> None:
+    """Reply to every request in the batch: pipeline outputs get their reply;
+    rows the pipeline dropped/filtered get 204 immediately instead of leaving
+    the client blocked until reply_timeout -> 504."""
+    answered = set()
+    for rid, rep in zip(out_ids, replies):
+        server.respond(rid, _coerce_response(rep))
+        answered.add(rid)
+    for rid in batch_ids:
+        if rid not in answered:
+            server.respond(rid, HTTPResponseData(204, "row dropped by pipeline"))
 
 
 def _coerce_response(rep) -> HTTPResponseData:

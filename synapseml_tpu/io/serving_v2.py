@@ -36,7 +36,7 @@ import numpy as np
 from ..core import Table, Transformer
 from ..core.telemetry import get_logger
 from .http_schema import HTTPResponseData
-from .serving import MicroBatchServingEngine, ServingServer, _coerce_response
+from .serving import MicroBatchServingEngine, ServingServer, respond_batch
 
 __all__ = ["ContinuousServingEngine", "DistributedServingEngine",
            "ServiceRegistry", "RoutingServer", "serve_continuous",
@@ -95,8 +95,7 @@ class ContinuousServingEngine:
                     500, "pipeline error", entity=str(e).encode()))
             self._error = e
             return
-        for rid, rep in zip(out_ids, replies):
-            self.server.respond(rid, _coerce_response(rep))
+        respond_batch(self.server, ids, out_ids, replies)
         self.batches_processed += 1
         self.requests_processed += len(batch)
 

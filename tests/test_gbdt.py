@@ -478,6 +478,24 @@ def test_categorical_split_beats_numeric_encoding():
     assert acc_cat >= acc_num
 
 
+def test_categorical_feature_mixed_names_and_indexes():
+    """Indices and names may be mixed (estimators concatenate
+    categorical_slot_indexes + categorical_slot_names); advisor round-2
+    medium: sorted() over the mixed list used to raise TypeError."""
+    rng = np.random.default_rng(61)
+    n = 500
+    cats0 = rng.integers(0, 8, size=n).astype(np.float64)
+    cats1 = rng.integers(0, 8, size=n).astype(np.float64)
+    y = (np.isin(cats0, [1, 3]) | np.isin(cats1, [2, 6])).astype(np.float64)
+    x = np.stack([cats0, cats1, rng.normal(size=n)], axis=1)
+    b = train({"objective": "binary", "num_iterations": 3, "num_leaves": 4,
+               "min_data_in_leaf": 5, "categorical_feature": [0, "c1"]},
+              x, y, feature_names=["c0", "c1", "num"])
+    assert sorted(b.mapper.categorical_features) == [0, 1]
+    acc = ((b.predict(x) > 0.5) == (y > 0.5)).mean()
+    assert acc > 0.9
+
+
 def test_categorical_roundtrip_and_device_predict():
     rng = np.random.default_rng(61)
     n = 800

@@ -188,6 +188,53 @@ def test_serving_end_to_end():
         engine.stop()
 
 
+class _DropOddReply(Transformer):
+    """Replies only to even-suffixed bodies; drops the rest (filter pipeline)."""
+
+    def _transform(self, table):
+        reqs, ids = table["request"], table["id"]
+        keep = [i for i, r in enumerate(reqs)
+                if int((r.entity or b"0").decode()[-1]) % 2 == 0]
+        out = np.empty(len(keep), dtype=object)
+        for j, i in enumerate(keep):
+            out[j] = string_to_response((reqs[i].entity or b"").decode().upper())
+        return Table({"id": np.asarray(ids, dtype=object)[keep], "reply": out})
+
+
+@pytest.mark.parametrize("mode", ["micro-batch", "continuous"])
+def test_serving_dropped_rows_get_204(mode):
+    """Rows a pipeline filters out must be answered (204) immediately, not
+    left to hit reply_timeout -> 504 (advisor round-2 finding)."""
+    from synapseml_tpu.io.serving import MicroBatchServingEngine, ServingServer
+    from synapseml_tpu.io.serving_v2 import ContinuousServingEngine
+
+    srv = ServingServer(port=0)
+    eng = (MicroBatchServingEngine(srv, _DropOddReply(), interval=0.01)
+           if mode == "micro-batch"
+           else ContinuousServingEngine(srv, _DropOddReply())).start()
+    codes = {}
+
+    def hit(i):
+        req = urllib.request.Request(srv.address, data=f"msg{i}".encode(),
+                                     method="POST")
+        try:
+            with urllib.request.urlopen(req, timeout=20) as r:
+                codes[i] = r.status
+        except urllib.error.HTTPError as e:
+            codes[i] = e.code
+
+    try:
+        threads = [threading.Thread(target=hit, args=(i,)) for i in range(6)]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+    finally:
+        eng.stop()
+    assert all(codes[i] == 200 for i in (0, 2, 4)), codes
+    assert all(codes[i] == 204 for i in (1, 3, 5)), codes
+
+
 class _BoomReply(Transformer):
     def _transform(self, table):
         raise RuntimeError("boom")
