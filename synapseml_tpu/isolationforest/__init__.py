@@ -1,0 +1,12 @@
+"""Isolation Forest anomaly detection.
+
+Reference: ``core/src/main/scala/.../isolationforest/IsolationForest.scala:18-65``
+(a wrapper over ``com.linkedin.relevance.isolationforest``). Here the
+algorithm itself is implemented: random-split isolation trees built on host
+(cheap, tiny subsamples) and scored on device as a fixed-depth vectorized
+heap-array traversal (same design as the GBDT device predictor).
+"""
+
+from .forest import IsolationForest, IsolationForestModel
+
+__all__ = ["IsolationForest", "IsolationForestModel"]

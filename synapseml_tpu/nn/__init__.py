@@ -1,0 +1,10 @@
+"""Nearest neighbors: exact KNN + conditional (label-filtered) KNN.
+
+Reference package: ``core/src/main/scala/.../nn/`` (616 LoC —
+``BallTree.scala``, ``ConditionalKNN.scala``, ``KNN.scala``,
+``BoundedPriorityQueue.scala``).
+"""
+
+from .knn import KNN, KNNModel, ConditionalKNN, ConditionalKNNModel
+
+__all__ = ["KNN", "KNNModel", "ConditionalKNN", "ConditionalKNNModel"]

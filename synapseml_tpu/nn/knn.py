@@ -1,0 +1,186 @@
+"""Exact (conditional) KNN by maximum inner product, TPU-first.
+
+Reference: ``core/src/main/scala/.../nn/KNN.scala:48``,
+``ConditionalKNN.scala:31``, backed by a serialized ball tree
+(``BallTree.scala:109``, ``ConditionalBallTree`` at ``:202``) whose
+``findMaximumInnerProducts`` walks tree nodes with a bounded priority queue
+per query.
+
+TPU-first redesign: a pointer ball tree is the wrong shape for the MXU — the
+index here is the raw (N, d) key matrix, a query batch scores ALL keys with
+ONE matmul ``Q @ K.T`` (bf16/f32 on the systolic array), conditional search
+masks disallowed labels with ``-inf``, and ``jax.lax.top_k`` returns the
+result. Exact (no approximation), like the reference; brute force on the MXU
+beats tree pointer-chasing for any N that fits in HBM.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+from typing import Optional
+
+import numpy as np
+
+from ..core import ComplexParam, Estimator, Model, Param, Table
+from ..core.params import ParamValidators
+
+__all__ = ["KNN", "KNNModel", "ConditionalKNN", "ConditionalKNNModel"]
+
+
+def _matrix(col: np.ndarray) -> np.ndarray:
+    if col.dtype == object:
+        return np.stack([np.asarray(v, dtype=np.float64) for v in col])
+    return np.asarray(col, dtype=np.float64)
+
+
+@lru_cache(maxsize=64)
+def _topk_kernel(k: int, has_mask: bool):
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def run(q, kk, m):
+        scores = q @ kk.T  # (nq, N) on the MXU
+        if has_mask:
+            scores = jnp.where(m, scores, -jnp.inf)
+        return jax.lax.top_k(scores, k)
+
+    return run
+
+
+def _topk_inner_products(keys: np.ndarray, queries: np.ndarray, k: int,
+                         mask: Optional[np.ndarray] = None):
+    """(scores, indices) of the k largest inner products per query row.
+
+    One jitted matmul over the whole batch (replaces the reference's per-row
+    ``findMaximumInnerProducts`` tree walk)."""
+    import jax.numpy as jnp
+
+    k = min(k, keys.shape[0])
+    run = _topk_kernel(k, mask is not None)
+    vals, idx = run(jnp.asarray(queries, jnp.float32),
+                    jnp.asarray(keys, jnp.float32),
+                    jnp.zeros((), jnp.bool_) if mask is None
+                    else jnp.asarray(mask))
+    return np.asarray(vals), np.asarray(idx)
+
+
+class KNN(Estimator):
+    """Reference ``KNN.scala:48``: indexes (features, values); queries return
+    the k best matches as ``[{value, distance}]`` where distance is the inner
+    product (the reference's ``BestMatch``)."""
+
+    features_col = Param("key vector column", str, default="features")
+    values_col = Param("payload column returned for matches", str,
+                       default="values")
+    output_col = Param("output column of match lists", str, default="output")
+    k = Param("number of matches", int, default=5,
+              validator=ParamValidators.gt(0))
+    leaf_size = Param("accepted for reference API parity (the MXU index has "
+                      "no tree leaves)", int, default=50)
+
+    def _fit(self, table: Table) -> "KNNModel":
+        self._validate_input(table, self.features_col, self.values_col)
+        return KNNModel(
+            features_col=self.features_col, values_col=self.values_col,
+            output_col=self.output_col, k=self.k,
+            keys=_matrix(table[self.features_col]).astype(np.float32),
+            values=np.asarray(table[self.values_col], dtype=object))
+
+
+class KNNModel(Model):
+    features_col = Param("query vector column", str, default="features")
+    values_col = Param("payload column", str, default="values")
+    output_col = Param("output column", str, default="output")
+    k = Param("number of matches", int, default=5)
+    keys = ComplexParam("(N, d) indexed key matrix", object, default=None)
+    values = ComplexParam("(N,) payload array", object, default=None)
+
+    def _transform(self, table: Table) -> Table:
+        self._validate_input(table, self.features_col)
+        queries = _matrix(table[self.features_col])
+        vals, idx = _topk_inner_products(np.asarray(self.keys), queries, self.k)
+        values = np.asarray(self.values, dtype=object)
+        out = np.empty(len(queries), dtype=object)
+        for r in range(len(queries)):
+            out[r] = [{"value": values[idx[r, j]],
+                       "distance": float(vals[r, j])}
+                      for j in range(idx.shape[1])]
+        return table.with_column(self.output_col, out)
+
+
+class ConditionalKNN(Estimator):
+    """Reference ``ConditionalKNN.scala:31``: like KNN but each query carries
+    a conditioner set; only keys whose label is in the set may match."""
+
+    features_col = Param("key vector column", str, default="features")
+    values_col = Param("payload column returned for matches", str,
+                       default="values")
+    label_col = Param("per-key label used for conditioning", str,
+                      default="labels")
+    conditioner_col = Param("per-query collection of admissible labels", str,
+                            default="conditioner")
+    output_col = Param("output column of match lists", str, default="output")
+    k = Param("number of matches", int, default=5,
+              validator=ParamValidators.gt(0))
+    leaf_size = Param("accepted for reference API parity", int, default=50)
+
+    def _fit(self, table: Table) -> "ConditionalKNNModel":
+        self._validate_input(table, self.features_col, self.values_col,
+                             self.label_col)
+        labels = np.asarray(table[self.label_col], dtype=object)
+        levels = sorted({l for l in labels.tolist()}, key=repr)
+        lut = {l: i for i, l in enumerate(levels)}
+        codes = np.array([lut[l] for l in labels.tolist()], dtype=np.int32)
+        return ConditionalKNNModel(
+            features_col=self.features_col, values_col=self.values_col,
+            label_col=self.label_col, conditioner_col=self.conditioner_col,
+            output_col=self.output_col, k=self.k,
+            keys=_matrix(table[self.features_col]).astype(np.float32),
+            values=np.asarray(table[self.values_col], dtype=object),
+            labels=labels, label_codes=codes,
+            label_levels=np.array(levels, dtype=object))
+
+
+class ConditionalKNNModel(Model):
+    features_col = Param("query vector column", str, default="features")
+    values_col = Param("payload column", str, default="values")
+    label_col = Param("per-key label column", str, default="labels")
+    conditioner_col = Param("per-query admissible-label collection", str,
+                            default="conditioner")
+    output_col = Param("output column", str, default="output")
+    k = Param("number of matches", int, default=5)
+    keys = ComplexParam("(N, d) indexed key matrix", object, default=None)
+    values = ComplexParam("(N,) payload array", object, default=None)
+    labels = ComplexParam("(N,) label array", object, default=None)
+    label_codes = ComplexParam("(N,) int codes of labels", object, default=None)
+    label_levels = ComplexParam("code -> label", object, default=None)
+
+    def _transform(self, table: Table) -> Table:
+        self._validate_input(table, self.features_col, self.conditioner_col)
+        queries = _matrix(table[self.features_col])
+        levels = list(self.label_levels)
+        lut = {l: i for i, l in enumerate(levels)}
+        codes = np.asarray(self.label_codes)
+        # (nq, L) admissible matrix -> (nq, N) mask by code gather; labels
+        # unseen at fit time simply admit nothing.
+        allowed = np.zeros((len(queries), len(levels)), dtype=bool)
+        for r, cond in enumerate(table[self.conditioner_col]):
+            for l in (cond if isinstance(cond, (list, tuple, set, np.ndarray))
+                      else [cond]):
+                i = lut.get(l)
+                if i is not None:
+                    allowed[r, i] = True
+        mask = allowed[:, codes]
+        vals, idx = _topk_inner_products(np.asarray(self.keys), queries,
+                                         self.k, mask=mask)
+        values = np.asarray(self.values, dtype=object)
+        labels = np.asarray(self.labels, dtype=object)
+        out = np.empty(len(queries), dtype=object)
+        for r in range(len(queries)):
+            # drop -inf entries (fewer than k admissible keys)
+            out[r] = [{"value": values[idx[r, j]],
+                       "distance": float(vals[r, j]),
+                       "label": labels[idx[r, j]]}
+                      for j in range(idx.shape[1]) if np.isfinite(vals[r, j])]
+        return table.with_column(self.output_col, out)

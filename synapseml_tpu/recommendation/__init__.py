@@ -1,0 +1,28 @@
+"""Recommendation: SAR + ranking adapters/evaluation/tuning.
+
+Reference package: ``core/src/main/scala/.../recommendation/`` (1,283 LoC —
+``SAR.scala``, ``SARModel.scala``, ``RankingAdapter.scala``,
+``RankingEvaluator.scala``, ``RankingTrainValidationSplit.scala``,
+``RecommendationIndexer.scala``).
+"""
+
+from .sar import SAR, SARModel
+from .ranking import (
+    AdvancedRankingMetrics,
+    RankingAdapter,
+    RankingAdapterModel,
+    RankingEvaluator,
+    RankingTrainValidationSplit,
+    RankingTrainValidationSplitModel,
+    RecommendationIndexer,
+    RecommendationIndexerModel,
+)
+
+__all__ = [
+    "SAR", "SARModel",
+    "AdvancedRankingMetrics",
+    "RankingAdapter", "RankingAdapterModel",
+    "RankingEvaluator",
+    "RankingTrainValidationSplit", "RankingTrainValidationSplitModel",
+    "RecommendationIndexer", "RecommendationIndexerModel",
+]

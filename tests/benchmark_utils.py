@@ -162,6 +162,32 @@ def measure_tune(dataset: str) -> float:
     return float(fitted.best_metric)
 
 
+def measure_sar_ranking(metric: str, variant: str) -> float:
+    """SAR ranking metric on the deterministic two-group dataset (the ratchet
+    analogue of the reference's SARSpec ranking expectations)."""
+    from synapseml_tpu.core import Table
+    from synapseml_tpu.recommendation import (RankingAdapter, RankingEvaluator,
+                                              SAR)
+
+    rng = np.random.default_rng(7)
+    n_users, n_items, per_user = 40, 30, 8
+    users, items, ratings = [], [], []
+    for u in range(n_users):
+        pool = (np.arange(0, n_items // 2) if u % 2 == 0
+                else np.arange(n_items // 2, n_items))
+        for it in rng.choice(pool, size=per_user, replace=False):
+            users.append(u)
+            items.append(int(it))
+            ratings.append(float(rng.integers(3, 6)))
+    t = Table({"user": np.array(users, np.int64),
+               "item": np.array(items, np.int64),
+               "rating": np.array(ratings)})
+    adapter = RankingAdapter(k=5, recommender=SAR(support_threshold=1,
+                                                  similarity_function=variant))
+    ranked = adapter.fit(t).transform(t)
+    return RankingEvaluator(k=5, n_items=n_items).get_metrics_map(ranked)[metric]
+
+
 def read_benchmarks(name: str):
     path = os.path.join(BENCH_DIR, name)
     with open(path) as f:

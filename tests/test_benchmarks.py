@@ -43,3 +43,8 @@ def test_train_classifier_benchmark(row):
 @pytest.mark.parametrize("row", _rows("benchmarks_tune_hyperparameters.csv"))
 def test_tune_hyperparameters_benchmark(row):
     _compare(bu.measure_tune(row["dataset"]), row)
+
+
+@pytest.mark.parametrize("row", _rows("benchmarks_sar_ranking.csv"))
+def test_sar_ranking_benchmark(row):
+    _compare(bu.measure_sar_ranking(row["metric"], row["variant"]), row)

@@ -1,0 +1,117 @@
+"""Data balance analysis tests.
+
+Reference suite: ``core/src/test/scala/.../exploratory/DataBalanceSuite``
+(hand-computed measure expectations on small synthetic frames).
+"""
+
+import math
+
+import numpy as np
+import pytest
+
+from synapseml_tpu import Table
+from synapseml_tpu.exploratory import (
+    AggregateBalanceMeasure,
+    DistributionBalanceMeasure,
+    FeatureBalanceMeasure,
+)
+
+
+def _df():
+    # gender: 4 M (3 positive), 4 F (1 positive)
+    return Table({
+        "gender": np.array(["M", "M", "M", "M", "F", "F", "F", "F"],
+                           dtype=object),
+        "label": np.array([1, 1, 1, 0, 1, 0, 0, 0], dtype=np.float64),
+    })
+
+
+def test_feature_balance_demographic_parity_gap():
+    out = FeatureBalanceMeasure(sensitive_cols=["gender"],
+                                label_col="label").transform(_df())
+    assert out.num_rows == 1  # one (M, F) pair
+    assert out["ClassA"][0] == "M" and out["ClassB"][0] == "F"
+    m = out["measures" if "measures" in out else "FeatureBalanceMeasure"][0]
+    # dp(M) = P(pos & M)/P(M) = (3/8)/(4/8); dp(F) = (1/8)/(4/8)
+    np.testing.assert_allclose(m["dp"], 3 / 4 - 1 / 4)
+    # pmi gap = ln(dpM) - ln(dpF)
+    np.testing.assert_allclose(m["pmi"], math.log(0.75) - math.log(0.25))
+    assert set(m) >= {"dp", "sdc", "ji", "llr", "pmi", "n_pmi_y", "n_pmi_xy",
+                      "s_pmi", "krc", "t_test"}
+
+
+def test_feature_balance_equal_values_gap_zero():
+    t = Table({"g": np.array(["A", "A", "B", "B"], dtype=object),
+               "label": np.array([1, 0, 1, 0], dtype=np.float64)})
+    out = FeatureBalanceMeasure(sensitive_cols=["g"]).transform(t)
+    m = out["FeatureBalanceMeasure"][0]
+    for metric in ("dp", "pmi", "ji"):
+        assert m[metric] == 0.0  # symmetric classes -> exact zero, no NaN
+
+
+def test_feature_balance_verbose_adds_probabilities():
+    out = FeatureBalanceMeasure(sensitive_cols=["gender"], verbose=True
+                                ).transform(_df())
+    m = out["FeatureBalanceMeasure"][0]
+    np.testing.assert_allclose(m["prA"], 0.75)
+    np.testing.assert_allclose(m["prB"], 0.25)
+
+
+def test_distribution_balance_uniform_is_zero():
+    t = Table({"g": np.array(["A", "B", "C", "A", "B", "C"], dtype=object)})
+    out = DistributionBalanceMeasure(sensitive_cols=["g"]).transform(t)
+    m = out["DistributionBalanceMeasure"][0]
+    np.testing.assert_allclose(m["kl_divergence"], 0.0, atol=1e-12)
+    np.testing.assert_allclose(m["js_dist"], 0.0, atol=1e-7)
+    np.testing.assert_allclose(m["total_variation_dist"], 0.0, atol=1e-12)
+    np.testing.assert_allclose(m["chi_sq_stat"], 0.0, atol=1e-12)
+    np.testing.assert_allclose(m["chi_sq_p_value"], 1.0, atol=1e-9)
+
+
+def test_distribution_balance_skew_measures():
+    # 6 A, 2 B: obs = [.25, .75] sorted ascending; ref = [.5, .5]
+    t = Table({"g": np.array(["A"] * 6 + ["B"] * 2, dtype=object)})
+    out = DistributionBalanceMeasure(sensitive_cols=["g"]).transform(t)
+    m = out["DistributionBalanceMeasure"][0]
+    np.testing.assert_allclose(m["inf_norm_dist"], 0.25)
+    np.testing.assert_allclose(m["total_variation_dist"], 0.25)
+    np.testing.assert_allclose(m["wasserstein_dist"], 0.25)
+    kl = 0.25 * math.log(0.5) + 0.75 * math.log(1.5)
+    np.testing.assert_allclose(m["kl_divergence"], kl, rtol=1e-9)
+    np.testing.assert_allclose(m["chi_sq_stat"], (6 - 4) ** 2 / 4 * 2)
+    assert 0 < m["chi_sq_p_value"] < 1
+
+
+def test_chi_sq_p_value_matches_known_table():
+    # chi2 sf(3.841, df=1) ~= 0.05 ; sf(5.991, df=2) ~= 0.05
+    from synapseml_tpu.exploratory.balance import _chi2_sf
+    np.testing.assert_allclose(_chi2_sf(3.841459, 1), 0.05, atol=1e-4)
+    np.testing.assert_allclose(_chi2_sf(5.991465, 2), 0.05, atol=1e-4)
+    np.testing.assert_allclose(_chi2_sf(0.0, 3), 1.0)
+
+
+def test_aggregate_balance_perfectly_balanced():
+    t = Table({"g": np.array(["A", "B"] * 5, dtype=object)})
+    out = AggregateBalanceMeasure(sensitive_cols=["g"]).transform(t)
+    m = out["AggregateBalanceMeasure"][0]
+    np.testing.assert_allclose(m["atkinson_index"], 0.0, atol=1e-9)
+    np.testing.assert_allclose(m["theil_l_index"], 0.0, atol=1e-12)
+    np.testing.assert_allclose(m["theil_t_index"], 0.0, atol=1e-12)
+
+
+def test_aggregate_balance_joint_distribution():
+    t = Table({
+        "g": np.array(["A", "A", "A", "B"], dtype=object),
+        "r": np.array(["x", "x", "y", "y"], dtype=object),
+    })
+    out = AggregateBalanceMeasure(sensitive_cols=["g", "r"]).transform(t)
+    m = out["AggregateBalanceMeasure"][0]
+    # joint classes: (A,x)=2, (A,y)=1, (B,y)=1 -> unbalanced
+    assert m["theil_l_index"] > 0
+    assert m["theil_t_index"] > 0
+    assert 0 < m["atkinson_index"] < 1
+
+
+def test_missing_sensitive_cols_raises():
+    with pytest.raises(ValueError, match="sensitive_cols"):
+        FeatureBalanceMeasure().transform(_df())

@@ -1,0 +1,80 @@
+"""Isolation forest tests (reference: LinkedIn lib behavior via
+``isolationforest/IsolationForest.scala``; VerifyIsolationForest suite)."""
+
+import numpy as np
+
+from synapseml_tpu import Table, load_stage
+from synapseml_tpu.isolationforest import IsolationForest, IsolationForestModel
+
+
+def _data(n=500, n_out=20, seed=0):
+    rng = np.random.default_rng(seed)
+    inliers = rng.normal(size=(n, 4))
+    outliers = rng.normal(size=(n_out, 4)) * 0.5 + 8.0
+    x = np.vstack([inliers, outliers])
+    is_outlier = np.r_[np.zeros(n), np.ones(n_out)]
+    return Table({"features": x}), is_outlier
+
+
+def test_outlier_scores_separate_clusters():
+    t, truth = _data()
+    model = IsolationForest(num_estimators=50, max_samples=128,
+                            random_seed=3).fit(t)
+    out = model.transform(t)
+    scores = np.asarray(out["outlierScore"])
+    assert scores.min() >= 0 and scores.max() <= 1
+    # every true outlier scores above the median inlier
+    assert scores[truth == 1].min() > np.median(scores[truth == 0])
+    # AUC of score vs truth should be ~1 on this easy split
+    order = np.argsort(scores)
+    ranks = np.empty(len(scores))
+    ranks[order] = np.arange(len(scores))
+    pos, neg = ranks[truth == 1], ranks[truth == 0]
+    auc = (pos.mean() - (len(pos) - 1) / 2 - len(neg) / 2) / len(neg) + 0.5
+    assert auc > 0.95
+
+
+def test_contamination_thresholds_predictions():
+    t, truth = _data(n=500, n_out=25)
+    frac = 25 / 525
+    model = IsolationForest(num_estimators=50, max_samples=128,
+                            contamination=frac, random_seed=3).fit(t)
+    out = model.transform(t)
+    pred = np.asarray(out["predictedLabel"])
+    # roughly the contamination fraction flagged, mostly the true outliers
+    assert 0.5 * frac <= pred.mean() <= 2 * frac
+    assert pred[truth == 1].mean() > 0.9
+
+
+def test_zero_contamination_predicts_no_outliers():
+    t, _ = _data()
+    out = IsolationForest(num_estimators=20, random_seed=1).fit(t).transform(t)
+    assert np.asarray(out["predictedLabel"]).sum() == 0
+
+
+def test_save_load_same_scores(tmp_path):
+    t, _ = _data(n=200, n_out=10)
+    model = IsolationForest(num_estimators=25, random_seed=5).fit(t)
+    p = str(tmp_path / "iso")
+    model.save(p)
+    loaded = load_stage(p)
+    assert isinstance(loaded, IsolationForestModel)
+    np.testing.assert_allclose(np.asarray(model.transform(t)["outlierScore"]),
+                               np.asarray(loaded.transform(t)["outlierScore"]),
+                               rtol=1e-6)
+
+
+def test_max_features_subsets_columns():
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(300, 10))
+    t = Table({"features": x})
+    model = IsolationForest(num_estimators=10, max_features=0.3,
+                            random_seed=2).fit(t)
+    used = {int(f) for f in np.asarray(model.tree_features).ravel() if f >= 0}
+    # each tree saw 3 of 10 features; across 10 trees not all columns all trees
+    per_tree = [
+        {int(f) for f in row if f >= 0}
+        for row in np.asarray(model.tree_features)
+    ]
+    assert all(len(s) <= 3 for s in per_tree)
+    assert used  # something was split

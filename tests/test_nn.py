@@ -1,0 +1,88 @@
+"""KNN / ConditionalKNN tests.
+
+Reference suites: ``core/src/test/scala/.../nn/`` (``KNNTest``,
+``ConditionalKNNTest`` — exact matches vs brute-force inner products).
+"""
+
+import numpy as np
+
+from synapseml_tpu import Table, load_stage
+from synapseml_tpu.nn import KNN, ConditionalKNN, ConditionalKNNModel
+
+
+def _index_table(n=200, d=8, seed=0):
+    rng = np.random.default_rng(seed)
+    feats = rng.normal(size=(n, d))
+    values = np.array([f"v{i}" for i in range(n)], dtype=object)
+    labels = np.array([i % 3 for i in range(n)], dtype=object)
+    return Table({"features": feats, "values": values, "labels": labels}), feats
+
+
+def test_knn_matches_bruteforce():
+    t, feats = _index_table()
+    model = KNN(k=4).fit(t)
+    rng = np.random.default_rng(1)
+    q = rng.normal(size=(17, feats.shape[1]))
+    out = model.transform(Table({"features": q}))
+    for r in range(len(q)):
+        scores = feats @ q[r]
+        expected = np.argsort(-scores)[:4]
+        got = [m["value"] for m in out["output"][r]]
+        assert got == [f"v{i}" for i in expected]
+        np.testing.assert_allclose(
+            [m["distance"] for m in out["output"][r]],
+            scores[expected], rtol=1e-5)
+
+
+def test_knn_k_larger_than_index():
+    t, _ = _index_table(n=3)
+    out = KNN(k=10).fit(t).transform(
+        Table({"features": np.zeros((2, 8))}))
+    assert len(out["output"][0]) == 3
+
+
+def test_conditional_knn_respects_conditioner():
+    t, feats = _index_table()
+    model = ConditionalKNN(k=5).fit(t)
+    rng = np.random.default_rng(2)
+    q = rng.normal(size=(9, feats.shape[1]))
+    conds = np.empty(9, dtype=object)
+    for r in range(9):
+        conds[r] = [r % 3]  # admit a single label class
+    out = model.transform(Table({"features": q, "conditioner": conds}))
+    labels = np.array([i % 3 for i in range(len(feats))])
+    for r in range(9):
+        matches = out["output"][r]
+        assert len(matches) == 5
+        assert all(m["label"] == r % 3 for m in matches)
+        # exact vs brute force restricted to the admitted class
+        scores = feats @ q[r]
+        admitted = np.nonzero(labels == r % 3)[0]
+        expected = admitted[np.argsort(-scores[admitted])[:5]]
+        assert [m["value"] for m in matches] == [f"v{i}" for i in expected]
+
+
+def test_conditional_knn_multi_label_and_unseen():
+    t, feats = _index_table(n=30)
+    model = ConditionalKNN(k=30).fit(t)
+    q = np.zeros((2, feats.shape[1]))
+    conds = np.empty(2, dtype=object)
+    conds[0] = [0, 2]
+    conds[1] = ["not-a-label"]
+    out = model.transform(Table({"features": q, "conditioner": conds}))
+    assert {m["label"] for m in out["output"][0]} == {0, 2}
+    assert out["output"][1] == []  # unseen label admits nothing
+
+
+def test_conditional_knn_save_load(tmp_path):
+    t, feats = _index_table(n=40)
+    model = ConditionalKNN(k=3).fit(t)
+    p = str(tmp_path / "cknn")
+    model.save(p)
+    loaded = load_stage(p)
+    assert isinstance(loaded, ConditionalKNNModel)
+    q = Table({"features": feats[:5],
+               "conditioner": np.array([[0, 1, 2]] * 5, dtype=object)})
+    out1, out2 = model.transform(q), loaded.transform(q)
+    for a, b in zip(out1["output"], out2["output"]):
+        assert [m["value"] for m in a] == [m["value"] for m in b]

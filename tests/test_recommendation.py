@@ -1,0 +1,260 @@
+"""SAR recommender + ranking stack tests.
+
+Reference suites: ``core/src/test/scala/.../recommendation/``
+(``SARSpec.scala``, ``RankingAdapterSpec``, ``RankingTrainValidationSplitSpec``).
+"""
+
+import numpy as np
+import pytest
+
+from synapseml_tpu import Table, load_stage
+from synapseml_tpu.recommendation import (
+    SAR,
+    SARModel,
+    AdvancedRankingMetrics,
+    RankingAdapter,
+    RankingEvaluator,
+    RankingTrainValidationSplit,
+    RecommendationIndexer,
+)
+
+
+def _tiny_events():
+    """3 users x 4 items with known co-occurrence counts."""
+    # user 0: items 0,1 ; user 1: items 0,1,2 ; user 2: items 1,2,3
+    users = [0, 0, 1, 1, 1, 2, 2, 2]
+    items = [0, 1, 0, 1, 2, 1, 2, 3]
+    return Table({"user": np.array(users, np.int64),
+                  "item": np.array(items, np.int64)})
+
+
+def test_sar_cooccurrence_and_jaccard():
+    t = _tiny_events()
+    m = SAR(support_threshold=1, similarity_function="cooccurrence").fit(t)
+    sim = np.asarray(m.item_similarity)
+    # occ: item0=2 users, item1=3, item2=2, item3=1
+    assert sim[0, 0] == 2 and sim[1, 1] == 3 and sim[2, 2] == 2 and sim[3, 3] == 1
+    assert sim[0, 1] == 2          # users 0,1 have both
+    assert sim[0, 2] == 1          # user 1
+    assert sim[0, 3] == 0
+    assert sim[2, 3] == 1          # user 2
+
+    mj = SAR(support_threshold=1, similarity_function="jaccard").fit(t)
+    sj = np.asarray(mj.item_similarity)
+    np.testing.assert_allclose(sj[0, 1], 2 / (2 + 3 - 2))
+    np.testing.assert_allclose(sj[2, 3], 1 / (2 + 1 - 1))
+
+    ml = SAR(support_threshold=1, similarity_function="lift").fit(t)
+    sl = np.asarray(ml.item_similarity)
+    np.testing.assert_allclose(sl[0, 1], 2 / (2 * 3))
+
+
+def test_sar_support_threshold_zeroes_rare_pairs():
+    t = _tiny_events()
+    m = SAR(support_threshold=2, similarity_function="cooccurrence").fit(t)
+    sim = np.asarray(m.item_similarity)
+    assert sim[0, 2] == 0 and sim[2, 3] == 0  # co-occurrence 1 < threshold 2
+    assert sim[0, 1] == 2                      # >= threshold survives
+
+
+def test_sar_time_decay_affinity():
+    # two events on the same (user, item): one now, one a half-life (30d) ago
+    day_s = 24 * 3600.0
+    t = Table({
+        "user": np.array([0, 0], np.int64),
+        "item": np.array([0, 0], np.int64),
+        "time": np.array([30 * day_s, 0.0]),  # numeric epoch seconds
+    })
+    m = SAR(support_threshold=1, time_decay_coeff=30).fit(t)
+    aff = np.asarray(m.user_affinity)
+    # newest event decays 2^0=1, the 30-day-old one 2^-1=0.5
+    np.testing.assert_allclose(aff[0, 0], 1.5, rtol=1e-5)
+
+
+def test_sar_rating_blend_and_string_times():
+    t = Table({
+        "user": np.array([0], np.int64),
+        "item": np.array([0], np.int64),
+        "rating": np.array([4.0]),
+        "time": np.array(["2024/01/02T00:00:00"], dtype=object),
+    })
+    m = SAR(support_threshold=1).fit(t)
+    aff = np.asarray(m.user_affinity)
+    np.testing.assert_allclose(aff[0, 0], 4.0, rtol=1e-5)  # decay 1 at t_ref
+
+
+def test_sar_transform_scores_and_cold_start_drop():
+    t = _tiny_events()
+    m = SAR(support_threshold=1).fit(t)
+    score_t = m.transform(Table({"user": np.array([0, 0, 99], np.int64),
+                                 "item": np.array([2, 3, 0], np.int64)}))
+    assert score_t.num_rows == 2  # user 99 dropped (cold start)
+    aff, sim = np.asarray(m.user_affinity), np.asarray(m.item_similarity)
+    np.testing.assert_allclose(score_t["prediction"][0],
+                               float(aff[0] @ sim[:, 2]), rtol=1e-5)
+
+
+def test_sar_recommend_top_k_and_remove_seen():
+    t = _tiny_events()
+    m = SAR(support_threshold=1).fit(t)
+    recs = m.recommend_for_all_users(2)
+    assert recs.num_rows == 3
+    r0 = recs["recommendations"][0]
+    assert len(r0) == 2
+    assert r0[0][1] >= r0[1][1]  # sorted by score desc
+
+    filtered = m.recommend_for_all_users(4, remove_seen=True)
+    for u in range(3):
+        seen = {int(i) for i in
+                np.nonzero(np.asarray(m.user_affinity)[u] > 0)[0]}
+        top = [item for item, score in filtered["recommendations"][u]
+               if np.isfinite(score)]
+        assert not (set(top) & seen)
+
+
+def test_sar_model_save_load(tmp_path):
+    m = SAR(support_threshold=1).fit(_tiny_events())
+    p = str(tmp_path / "sar")
+    m.save(p)
+    loaded = load_stage(p)
+    assert isinstance(loaded, SARModel)
+    np.testing.assert_allclose(np.asarray(loaded.item_similarity),
+                               np.asarray(m.item_similarity))
+    out1 = m.recommend_for_all_users(2)
+    out2 = loaded.recommend_for_all_users(2)
+    assert out1["recommendations"][1] == out2["recommendations"][1]
+
+
+def test_recommendation_indexer_roundtrip():
+    t = Table({"user": np.array(["alice", "bob", "alice"], dtype=object),
+               "item": np.array(["x", "y", "y"], dtype=object),
+               "rating": np.array([1.0, 2.0, 3.0])})
+    model = RecommendationIndexer(user_input_col="user", item_input_col="item").fit(t)
+    out = model.transform(t)
+    u = np.asarray(out["user_idx"])
+    assert u[0] == u[2] and u[0] != u[1]
+    assert model.recover_user(int(u[0])) == "alice"
+    assert model.recover_item(999) == "-1"
+
+
+def _synthetic_ranking_data(seed=7, n_users=40, n_items=30, per_user=8):
+    """Two user groups with disjoint preferred item halves — SAR should rank
+    in-group items above out-group ones."""
+    rng = np.random.default_rng(seed)
+    users, items, ratings = [], [], []
+    for u in range(n_users):
+        group = u % 2
+        pool = (np.arange(0, n_items // 2) if group == 0
+                else np.arange(n_items // 2, n_items))
+        chosen = rng.choice(pool, size=per_user, replace=False)
+        for it in chosen:
+            users.append(u)
+            items.append(int(it))
+            ratings.append(float(rng.integers(3, 6)))
+    return Table({"user": np.array(users, np.int64),
+                  "item": np.array(items, np.int64),
+                  "rating": np.array(ratings)})
+
+
+def test_ranking_adapter_and_evaluator_end_to_end():
+    t = _synthetic_ranking_data()
+    adapter = RankingAdapter(k=5, recommender=SAR(support_threshold=1))
+    model = adapter.fit(t)
+    ranked = model.transform(t)
+    assert "prediction" in ranked and "label" in ranked
+    ev = RankingEvaluator(k=5, n_items=30)
+    metrics = ev.get_metrics_map(ranked)
+    assert set(metrics) == {"map", "ndcgAt", "precisionAtk", "recallAtK",
+                            "diversityAtK", "maxDiversity", "mrr", "fcp"}
+    # group structure is strong: recommendations should be dominated by
+    # in-group items the user actually rated
+    assert metrics["ndcgAt"] > 0.5
+    assert metrics["map"] > 0.3
+    assert 0 < metrics["diversityAtK"] <= 1.0
+
+
+def test_ranking_adapter_normal_mode_ranks_observed_pairs_only():
+    t = _synthetic_ranking_data()
+    model = RankingAdapter(k=5, mode="normal",
+                           recommender=SAR(support_threshold=1)).fit(t)
+    ranked = model.transform(t)
+    users = np.asarray(t["user"], np.int64)
+    items = np.asarray(t["item"], np.int64)
+    observed = {(int(u), int(i)) for u, i in zip(users, items)}
+    by_user = {}
+    for u, i in observed:
+        by_user.setdefault(u, set()).add(i)
+    # every prediction must be an item the user actually has in the input
+    all_user_items = set()
+    for s in by_user.values():
+        all_user_items |= s
+    for pred in ranked["prediction"]:
+        assert set(pred) <= all_user_items
+        assert len(pred) <= 5
+
+
+def test_ranking_adapter_min_ratings_filters_before_fit():
+    t = Table({"user": np.array([0, 0, 0, 1], np.int64),
+               "item": np.array([0, 1, 2, 3], np.int64),
+               "rating": np.ones(4)})
+    model = RankingAdapter(k=2, min_ratings_per_user=2,
+                           recommender=SAR(support_threshold=1)).fit(t)
+    aff = np.asarray(model.recommender_model.user_affinity)
+    assert aff.shape[0] == 1  # user 1 (single rating) excluded from fit
+
+
+def test_ranking_tvs_picks_better_param_map():
+    t = _synthetic_ranking_data()
+    tvs = RankingTrainValidationSplit(
+        estimator=SAR(support_threshold=1),
+        estimator_param_maps=[{"similarity_function": "jaccard"},
+                              {"similarity_function": "cooccurrence"}],
+        evaluator=RankingEvaluator(k=5, metric_name="ndcgAt"),
+        train_ratio=0.75, seed=3)
+    model = tvs.fit(t)
+    assert len(model.validation_metrics) == 2
+    recs = model.recommend_for_all_users(3)
+    assert recs.num_rows == 40
+
+
+def test_ranking_tvs_filters_min_ratings():
+    t = Table({"user": np.array([0, 0, 0, 1], np.int64),
+               "item": np.array([0, 1, 2, 0], np.int64),
+               "rating": np.ones(4)})
+    tvs = RankingTrainValidationSplit(min_ratings_u=2, min_ratings_i=1,
+                                      estimator=SAR(), evaluator=RankingEvaluator())
+    filtered = tvs._filter_ratings(t)
+    assert filtered.num_rows == 3  # user 1 has a single rating -> dropped
+
+
+# -- metric unit checks (reference AdvancedRankingMetrics semantics) -----------------
+
+def test_advanced_ranking_metrics_hand_checked():
+    preds = [[1, 2, 3], [4, 5, 6]]
+    labels = [[1, 3], [7]]
+    m = AdvancedRankingMetrics(preds, labels, k=3, n_items=10)
+    # user A: hits at ranks 1,3 -> AP = (1/1 + 2/3)/2 ; user B: 0
+    np.testing.assert_allclose(m.map(), ((1 + 2 / 3) / 2) / 2)
+    # mrr: 1/1 for A, 0 for B
+    np.testing.assert_allclose(m.mrr(), 0.5)
+    # precision@3: A = 2/3, B = 0
+    np.testing.assert_allclose(m.precision_at_k(), (2 / 3) / 2)
+    # recall: A = 2/3, B = 0
+    np.testing.assert_allclose(m.recall_at_k(), (2 / 3) / 2)
+    # diversity: 6 unique recommended / 10
+    np.testing.assert_allclose(m.diversity_at_k(), 0.6)
+    # maxDiversity: union {1..7} / 10
+    np.testing.assert_allclose(m.max_diversity(), 0.7)
+    # fcp: A positions -> pred[0]==lab[0] (1==1 c), pred[1]!=lab[1] (2!=3 d) -> 1/2
+    #      B -> pred[0]!=7 -> 0/1
+    np.testing.assert_allclose(m.fcp(), (0.5 + 0.0) / 2)
+
+
+def test_ndcg_perfect_ranking_is_one():
+    m = AdvancedRankingMetrics([[1, 2, 3]], [[1, 2, 3]], k=3, n_items=5)
+    np.testing.assert_allclose(m.ndcg_at(), 1.0)
+
+
+def test_evaluator_rejects_unknown_metric():
+    with pytest.raises(ValueError):
+        RankingEvaluator(metric_name="nope")
