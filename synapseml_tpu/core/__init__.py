@@ -12,7 +12,7 @@ from .stage import (
     UnaryTransformer,
     stage_class,
 )
-from .table import Table, concat_tables
+from .table import Table, concat_tables, features_matrix
 from .serialization import load_stage, register_state_class, save_stage
 from .clock import StopWatch, buffered_map
 from .fault import retry_with_backoff, retry_with_timeout, using, using_many
@@ -33,6 +33,7 @@ __all__ = [
     "stage_class",
     "Table",
     "concat_tables",
+    "features_matrix",
     "save_stage",
     "load_stage",
     "register_state_class",

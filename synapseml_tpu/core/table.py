@@ -292,6 +292,15 @@ class Table:
         return f"Table[{self._num_rows} rows x {len(self._columns)} cols, {self.npartitions} parts]({schema})"
 
 
+def features_matrix(col: np.ndarray, dtype=np.float64) -> np.ndarray:
+    """Coerce a features column (dense 2-D or object array of vectors) to an
+    (n, d) float matrix — the one shared conversion every vector-consuming
+    stage uses (GBDT/KNN/isolation forest/...)."""
+    if col.dtype == object:
+        return np.stack([np.asarray(v, dtype=dtype) for v in col])
+    return np.asarray(col, dtype=dtype)
+
+
 def concat_tables(tables: Sequence[Table]) -> Table:
     tables = [t for t in tables if t.num_rows > 0 or t.column_names]
     if not tables:

@@ -1,0 +1,26 @@
+"""CyberML: access-anomaly detection via collaborative filtering.
+
+Reference package: ``core/src/main/python/synapse/ml/cyber/`` (1,787 LoC) —
+``anomaly/collaborative_filtering.py`` (``AccessAnomaly:472``,
+``AccessAnomalyModel:161``, ``ConnectedComponents:415``,
+``ModelNormalizeTransformer:886``), ``anomaly/complement_access.py``,
+``feature/indexers.py``, ``feature/scalers.py``.
+"""
+
+from .anomaly import AccessAnomaly, AccessAnomalyModel, ConnectedComponents
+from .complement import ComplementAccessTransformer
+from .indexers import IdIndexer, IdIndexerModel, MultiIndexer, MultiIndexerModel
+from .scalers import (
+    LinearScalarScaler,
+    LinearScalarScalerModel,
+    StandardScalarScaler,
+    StandardScalarScalerModel,
+)
+
+__all__ = [
+    "AccessAnomaly", "AccessAnomalyModel", "ConnectedComponents",
+    "ComplementAccessTransformer",
+    "IdIndexer", "IdIndexerModel", "MultiIndexer", "MultiIndexerModel",
+    "LinearScalarScaler", "LinearScalarScalerModel",
+    "StandardScalarScaler", "StandardScalarScalerModel",
+]

@@ -70,14 +70,22 @@ def _association_metrics(n_pos_feature: float, n_feature: float,
     with np.errstate(divide="ignore"):
         pmi = -math.inf if dp == 0.0 else math.log(dp)
         llr = math.log(p_pos_feat / p_pos) if p_pos > 0 else math.nan
+    def _div(a: float, b: float) -> float:
+        """IEEE division like the Scala reference: x/0 = ±inf, 0/0 = NaN
+        (Python raises ZeroDivisionError; e.g. b = log(p_pos) is 0 when the
+        label column is all-positive)."""
+        with np.errstate(divide="ignore", invalid="ignore"):
+            return float(np.float64(a) / np.float64(b))
+
     out = {
         "dp": dp,
         "sdc": p_pos_feat / (p_feat + p_pos),
         "ji": p_pos_feat / (p_feat + p_pos - p_pos_feat),
         "llr": llr,
         "pmi": pmi,
-        "n_pmi_y": 0.0 if p_pos == 0 else pmi / math.log(p_pos),
-        "n_pmi_xy": 0.0 if p_pos_feat == 0 else pmi / math.log(p_pos_feat),
+        "n_pmi_y": 0.0 if p_pos == 0 else _div(pmi, math.log(p_pos)),
+        "n_pmi_xy": 0.0 if p_pos_feat == 0 else _div(pmi,
+                                                     math.log(p_pos_feat)),
         "s_pmi": (0.0 if p_feat * p_pos == 0
                   else math.log(p_pos_feat ** 2 / (p_feat * p_pos))
                   if p_pos_feat > 0 else -math.inf),

@@ -36,10 +36,9 @@ __all__ = [
 
 
 def _features_matrix(table: Table, col: str) -> np.ndarray:
-    arr = table.column(col)
-    if arr.dtype == object:
-        arr = np.stack([np.asarray(v, dtype=np.float64) for v in arr])
-    return np.asarray(arr, dtype=np.float64)
+    from ..core.table import features_matrix
+
+    return features_matrix(table.column(col))
 
 
 class _LightGBMBase(Estimator):

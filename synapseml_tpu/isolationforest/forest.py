@@ -22,6 +22,7 @@ import numpy as np
 
 from ..core import ComplexParam, Estimator, Model, Param, Table
 from ..core.params import ParamValidators
+from ..core.table import features_matrix
 
 __all__ = ["IsolationForest", "IsolationForestModel"]
 
@@ -125,9 +126,7 @@ class IsolationForest(Estimator):
 
     def _fit(self, table: Table) -> "IsolationForestModel":
         self._validate_input(table, self.features_col)
-        col = table[self.features_col]
-        x = (np.stack([np.asarray(v, np.float64) for v in col])
-             if col.dtype == object else np.asarray(col, np.float64))
+        x = features_matrix(table[self.features_col])
         n, d = x.shape
         m = min(self.max_samples, n)
         depth_limit = max(1, int(math.ceil(math.log2(max(m, 2)))))
@@ -187,9 +186,7 @@ class IsolationForestModel(Model):
 
     def _transform(self, table: Table) -> Table:
         self._validate_input(table, self.features_col)
-        col = table[self.features_col]
-        x = (np.stack([np.asarray(v, np.float64) for v in col])
-             if col.dtype == object else np.asarray(col, np.float64))
+        x = features_matrix(table[self.features_col])
         scores = self._scores(x)
         pred = (scores >= self.score_threshold).astype(np.float64)
         return (table.with_column(self.score_col, scores.astype(np.float64))

@@ -23,14 +23,9 @@ import numpy as np
 
 from ..core import ComplexParam, Estimator, Model, Param, Table
 from ..core.params import ParamValidators
+from ..core.table import features_matrix as _matrix
 
 __all__ = ["KNN", "KNNModel", "ConditionalKNN", "ConditionalKNNModel"]
-
-
-def _matrix(col: np.ndarray) -> np.ndarray:
-    if col.dtype == object:
-        return np.stack([np.asarray(v, dtype=np.float64) for v in col])
-    return np.asarray(col, dtype=np.float64)
 
 
 @lru_cache(maxsize=64)

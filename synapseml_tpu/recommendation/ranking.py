@@ -48,17 +48,17 @@ def _per_user_top_items(table: Table, user_col: str, item_col: str,
 
 def _filter_min_ratings(table: Table, user_col: str, item_col: str,
                         min_u: int, min_i: int) -> Table:
-    """Drop items then users with too few ratings (reference
-    ``filterRatings``, ``RankingTrainValidationSplit.scala:150-169``)."""
-    users = np.asarray(table[user_col], dtype=np.int64)
+    """Drop items THEN users with too few ratings — sequentially, so user
+    counts are taken after the item filter (reference ``filterRatings``,
+    ``RankingTrainValidationSplit.scala:150-169``)."""
     items = np.asarray(table[item_col], dtype=np.int64)
     _, item_inv, item_counts = np.unique(items, return_inverse=True,
                                          return_counts=True)
-    keep = item_counts[item_inv] >= min_i
+    table = table.filter(item_counts[item_inv] >= min_i)
+    users = np.asarray(table[user_col], dtype=np.int64)
     _, user_inv, user_counts = np.unique(users, return_inverse=True,
                                          return_counts=True)
-    keep &= user_counts[user_inv] >= min_u
-    return table.filter(keep)
+    return table.filter(user_counts[user_inv] >= min_u)
 
 
 def _join_recs_with_actual(recs: Table, rec_user_col: str,
@@ -255,7 +255,13 @@ class AdvancedRankingMetrics:
         return self._mean(f)
 
     def match_metric(self, name: str) -> float:
-        return self.all_metrics()[name]
+        fns = {"map": self.map, "ndcgAt": self.ndcg_at,
+               "precisionAtk": self.precision_at_k,
+               "recallAtK": self.recall_at_k,
+               "diversityAtK": self.diversity_at_k,
+               "maxDiversity": self.max_diversity,
+               "mrr": self.mrr, "fcp": self.fcp}
+        return fns[name]()
 
     def all_metrics(self) -> Dict[str, float]:
         return {"map": self.map(), "ndcgAt": self.ndcg_at(),

@@ -34,7 +34,8 @@ __all__ = ["SAR", "SARModel"]
 # Java SimpleDateFormat defaults from the reference (SAR.scala:257-259),
 # expressed as strptime patterns.
 _ACTIVITY_FMT = "%Y/%m/%dT%H:%M:%S"        # "yyyy/MM/dd'T'h:mm:ss"
-_START_FMT = "%a %b %d %H:%M:%S %Z %Y"     # "EEE MMM dd HH:mm:ss Z yyyy"
+# "EEE MMM dd HH:mm:ss Z yyyy" — Java's Z is a numeric offset (+0000): %z
+_START_FMT = "%a %b %d %H:%M:%S %z %Y"
 
 
 def _parse_times(col: np.ndarray, fmt: str) -> np.ndarray:

@@ -1,0 +1,252 @@
+"""CyberML tests (reference: ``core/src/test/python/synapsemltest/cyber/``
+— anomaly/test_collaborative_filtering.py semantics: cross-group access
+scores high, in-group low)."""
+
+import numpy as np
+import pytest
+
+from synapseml_tpu import Table, load_stage
+from synapseml_tpu.cyber import (
+    AccessAnomaly,
+    AccessAnomalyModel,
+    ComplementAccessTransformer,
+    ConnectedComponents,
+    IdIndexer,
+    LinearScalarScaler,
+    MultiIndexer,
+    StandardScalarScaler,
+)
+
+
+# -- scalers -------------------------------------------------------------------------
+
+def test_standard_scaler_per_partition():
+    t = Table({"tenant": np.array(["a"] * 4 + ["b"] * 4, dtype=object),
+               "x": np.array([1.0, 2, 3, 4, 10, 20, 30, 40])})
+    model = StandardScalarScaler(input_col="x", output_col="z",
+                                 partition_key="tenant").fit(t)
+    out = model.transform(t)
+    z = np.asarray(out["z"])
+    for m in (slice(0, 4), slice(4, 8)):
+        np.testing.assert_allclose(z[m].mean(), 0.0, atol=1e-12)
+        np.testing.assert_allclose(z[m].std(), 1.0, atol=1e-12)
+
+
+def test_standard_scaler_zero_std_falls_back_to_centering():
+    t = Table({"x": np.array([3.0, 3.0, 3.0])})
+    out = StandardScalarScaler(input_col="x", output_col="z").fit(t).transform(t)
+    np.testing.assert_allclose(np.asarray(out["z"]), 0.0)
+
+
+def test_linear_scaler_maps_to_range():
+    t = Table({"x": np.array([0.0, 5.0, 10.0])})
+    out = LinearScalarScaler(input_col="x", output_col="z",
+                             min_required_value=5.0,
+                             max_required_value=10.0).fit(t).transform(t)
+    np.testing.assert_allclose(np.asarray(out["z"]), [5.0, 7.5, 10.0])
+
+
+def test_linear_scaler_degenerate_maps_to_midpoint():
+    t = Table({"x": np.array([7.0, 7.0])})
+    out = LinearScalarScaler(input_col="x", output_col="z",
+                             min_required_value=5.0,
+                             max_required_value=10.0).fit(t).transform(t)
+    np.testing.assert_allclose(np.asarray(out["z"]), 7.5)
+
+
+# -- indexers ------------------------------------------------------------------------
+
+def test_id_indexer_from_one_and_unseen_zero():
+    t = Table({"tenant": np.array(["a", "a", "b"], dtype=object),
+               "u": np.array(["x", "y", "x"], dtype=object)})
+    model = IdIndexer(input_col="u", partition_key="tenant",
+                      output_col="idx", reset_per_partition=True).fit(t)
+    out = model.transform(t)
+    idx = np.asarray(out["idx"])
+    assert idx[0] == 1 and idx[1] == 2 and idx[2] == 1  # reset per tenant
+    unseen = model.transform(Table({"tenant": np.array(["a"], dtype=object),
+                                    "u": np.array(["zzz"], dtype=object)}))
+    assert np.asarray(unseen["idx"])[0] == 0
+
+
+def test_id_indexer_global_numbering():
+    t = Table({"tenant": np.array(["a", "a", "b"], dtype=object),
+               "u": np.array(["x", "y", "x"], dtype=object)})
+    model = IdIndexer(input_col="u", partition_key="tenant",
+                      output_col="idx", reset_per_partition=False).fit(t)
+    idx = np.asarray(model.transform(t)["idx"])
+    assert sorted(idx.tolist()) == [1, 2, 3]  # consecutive across partitions
+
+
+def test_multi_indexer():
+    t = Table({"tenant": np.array(["a", "a"], dtype=object),
+               "u": np.array(["x", "y"], dtype=object),
+               "r": np.array(["p", "q"], dtype=object)})
+    mi = MultiIndexer(indexers=[
+        IdIndexer(input_col="u", partition_key="tenant", output_col="ui"),
+        IdIndexer(input_col="r", partition_key="tenant", output_col="ri"),
+    ]).fit(t)
+    out = mi.transform(t)
+    assert "ui" in out and "ri" in out
+    assert mi.get_model_by_input_col("u").output_col == "ui"
+    assert mi.get_model_by_output_col("ri").input_col == "r"
+
+
+# -- complement sampling -------------------------------------------------------------
+
+def test_complement_access_excludes_observed():
+    rng = np.random.default_rng(0)
+    u = rng.integers(0, 10, 60)
+    r = rng.integers(0, 10, 60)
+    t = Table({"u": u, "r": r})
+    comp = ComplementAccessTransformer(
+        indexed_col_names=["u", "r"], complementset_factor=3).transform(t)
+    seen = set(zip(u.tolist(), r.tolist()))
+    assert comp.num_rows > 0
+    for i in range(comp.num_rows):
+        assert (int(comp["u"][i]), int(comp["r"][i])) not in seen
+
+
+def test_complement_factor_zero_empty():
+    t = Table({"u": np.arange(5), "r": np.arange(5)})
+    comp = ComplementAccessTransformer(
+        indexed_col_names=["u", "r"], complementset_factor=0).transform(t)
+    assert comp.num_rows == 0
+
+
+# -- connected components ------------------------------------------------------------
+
+def test_connected_components_bipartite():
+    t = Table({
+        "tenant": np.array(["t"] * 5, dtype=object),
+        "user": np.array(["u1", "u2", "u2", "u3", "u4"], dtype=object),
+        "res": np.array(["r1", "r1", "r2", "r3", "r3"], dtype=object),
+    })
+    users, res = ConnectedComponents("tenant", "user", "res").compute(t)
+    # u1-r1-u2-r2 one component; u3-r3-u4 another
+    assert users[("t", "u1")] == users[("t", "u2")] == res[("t", "r1")]
+    assert users[("t", "u3")] == users[("t", "u4")] == res[("t", "r3")]
+    assert users[("t", "u1")] != users[("t", "u3")]
+
+
+# -- access anomaly end-to-end -------------------------------------------------------
+
+def _two_group_access(seed=0, n_users=12, n_res=10, events_per_user=18):
+    """Users 0..5 access resources 0..4; users 6..11 access 5..9; one bridge
+    user touches both halves so the graph stays a single connected component
+    (otherwise cross-group scores are +inf by the components rule)."""
+    rng = np.random.default_rng(seed)
+    tenants, users, resources = [], [], []
+    for u in range(n_users):
+        pool = (np.arange(0, n_res // 2) if u < n_users // 2
+                else np.arange(n_res // 2, n_res))
+        for _ in range(events_per_user):
+            tenants.append("t0")
+            users.append(f"user{u}")
+            resources.append(f"res{rng.choice(pool)}")
+    for r in (0, n_res - 1):
+        tenants.append("t0")
+        users.append("bridge")
+        resources.append(f"res{r}")
+    return Table({"tenant": np.array(tenants, dtype=object),
+                  "user": np.array(users, dtype=object),
+                  "res": np.array(resources, dtype=object)})
+
+
+def test_access_anomaly_cross_group_scores_high():
+    t = _two_group_access()
+    model = AccessAnomaly(max_iter=10, rank_param=8).fit(t)
+    in_group = model.transform(Table({
+        "tenant": np.array(["t0"], dtype=object),
+        "user": np.array(["user0"], dtype=object),
+        "res": np.array(["res1"], dtype=object)}))
+    cross_group = model.transform(Table({
+        "tenant": np.array(["t0"], dtype=object),
+        "user": np.array(["user0"], dtype=object),
+        "res": np.array(["res8"], dtype=object)}))
+    s_in = float(np.asarray(in_group["anomaly_score"])[0])
+    s_cross = float(np.asarray(cross_group["anomaly_score"])[0])
+    assert np.isfinite(s_in) and np.isfinite(s_cross)
+    assert s_cross > s_in
+
+
+def test_access_anomaly_scores_standardized():
+    t = _two_group_access()
+    model = AccessAnomaly(max_iter=10, rank_param=8).fit(t)
+    scores = np.asarray(model.transform(t)["anomaly_score"])
+    assert np.isfinite(scores).all()
+    assert abs(scores.mean()) < 0.35
+    assert 0.5 < scores.std() < 2.0
+
+
+def test_access_anomaly_unknown_user_nan_and_disconnected_inf():
+    # two disconnected tenant sub-graphs: users A* on resources RA*,
+    # users B* on RB* — cross-component access must be +inf
+    t = Table({
+        "tenant": np.array(["t"] * 8, dtype=object),
+        "user": np.array(["A1", "A2"] * 2 + ["B1", "B2"] * 2, dtype=object),
+        "res": np.array(["RA1", "RA2", "RA2", "RA1",
+                         "RB1", "RB2", "RB2", "RB1"], dtype=object),
+    })
+    model = AccessAnomaly(max_iter=5, rank_param=4).fit(t)
+    q = Table({"tenant": np.array(["t", "t"], dtype=object),
+               "user": np.array(["A1", "nobody"], dtype=object),
+               "res": np.array(["RB1", "RA1"], dtype=object)})
+    s = np.asarray(model.transform(q)["anomaly_score"])
+    assert np.isinf(s[0])      # disconnected component
+    assert np.isnan(s[1])      # unknown user
+
+
+def test_access_anomaly_history_scores_zero():
+    t = _two_group_access()
+    hist = Table({"tenant": np.array(["t0"], dtype=object),
+                  "user": np.array(["user0"], dtype=object),
+                  "res": np.array(["res0"], dtype=object)})
+    model = AccessAnomaly(max_iter=5, rank_param=4,
+                          history_access_df=hist).fit(t)
+    q = model.transform(hist)
+    assert float(np.asarray(q["anomaly_score"])[0]) == 0.0
+
+
+def test_access_anomaly_explicit_cf_variant():
+    t = _two_group_access(seed=3)
+    model = AccessAnomaly(max_iter=8, rank_param=6, apply_implicit_cf=False,
+                          complementset_factor=2, neg_score=1.0).fit(t)
+    in_g = model.transform(Table({
+        "tenant": np.array(["t0"], dtype=object),
+        "user": np.array(["user1"], dtype=object),
+        "res": np.array(["res2"], dtype=object)}))
+    cross = model.transform(Table({
+        "tenant": np.array(["t0"], dtype=object),
+        "user": np.array(["user1"], dtype=object),
+        "res": np.array(["res9"], dtype=object)}))
+    assert float(np.asarray(cross["anomaly_score"])[0]) > \
+        float(np.asarray(in_g["anomaly_score"])[0])
+
+
+def test_access_anomaly_save_load(tmp_path):
+    t = _two_group_access()
+    model = AccessAnomaly(max_iter=5, rank_param=4).fit(t)
+    p = str(tmp_path / "aa")
+    model.save(p)
+    loaded = load_stage(p)
+    assert isinstance(loaded, AccessAnomalyModel)
+    s1 = np.asarray(model.transform(t)["anomaly_score"])
+    s2 = np.asarray(loaded.transform(t)["anomaly_score"])
+    np.testing.assert_allclose(s1, s2, rtol=1e-6)
+
+
+def test_access_anomaly_multi_tenant_isolation():
+    ta = _two_group_access(seed=1)
+    # second tenant with identical structure
+    tb_cols = {k: ta[k].copy() for k in ("tenant", "user", "res")}
+    tb_cols["tenant"] = np.array(["t1"] * ta.num_rows, dtype=object)
+    both = Table({k: np.concatenate([ta[k], tb_cols[k]])
+                  for k in ("tenant", "user", "res")})
+    model = AccessAnomaly(max_iter=5, rank_param=4).fit(both)
+    # same user/res names exist in both tenants but are scored independently
+    q = Table({"tenant": np.array(["t0", "t1"], dtype=object),
+               "user": np.array(["user0", "user0"], dtype=object),
+               "res": np.array(["res0", "res0"], dtype=object)})
+    s = np.asarray(model.transform(q)["anomaly_score"])
+    assert np.isfinite(s).all()

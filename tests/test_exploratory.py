@@ -49,6 +49,16 @@ def test_feature_balance_equal_values_gap_zero():
         assert m[metric] == 0.0  # symmetric classes -> exact zero, no NaN
 
 
+def test_feature_balance_all_positive_labels_no_crash():
+    """All-positive label: log(p_pos)=0 — IEEE division (inf/NaN), not a
+    ZeroDivisionError (reference Scala semantics)."""
+    t = Table({"g": np.array(["A", "A", "B", "B"], dtype=object),
+               "label": np.ones(4)})
+    out = FeatureBalanceMeasure(sensitive_cols=["g"]).transform(t)
+    m = out["FeatureBalanceMeasure"][0]
+    assert m["dp"] == 0.0  # both classes fully positive -> equal, gap 0
+
+
 def test_feature_balance_verbose_adds_probabilities():
     out = FeatureBalanceMeasure(sensitive_cols=["gender"], verbose=True
                                 ).transform(_df())

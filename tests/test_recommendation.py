@@ -83,6 +83,20 @@ def test_sar_rating_blend_and_string_times():
     np.testing.assert_allclose(aff[0, 0], 4.0, rtol=1e-5)  # decay 1 at t_ref
 
 
+def test_sar_start_time_java_default_format():
+    """The documented Java default emits numeric offsets ('+0000'); %z must
+    parse them (advisor-confirmed crash with %Z)."""
+    t = Table({
+        "user": np.array([0], np.int64),
+        "item": np.array([0], np.int64),
+        "time": np.array(["2024/01/01T00:00:00"], dtype=object),
+    })
+    m = SAR(support_threshold=1,
+            start_time="Mon Jan 01 00:00:00 +0000 2024").fit(t)
+    np.testing.assert_allclose(np.asarray(m.user_affinity)[0, 0], 1.0,
+                               rtol=1e-6)
+
+
 def test_sar_transform_scores_and_cold_start_drop():
     t = _tiny_events()
     m = SAR(support_threshold=1).fit(t)
