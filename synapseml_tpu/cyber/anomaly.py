@@ -26,7 +26,7 @@ from ..core import ComplexParam, Estimator, Model, Param, Table
 from ..core.params import ParamValidators
 from .complement import ComplementAccessTransformer
 from .indexers import IdIndexer
-from .scalers import LinearScalarScaler
+from .scalers import LinearScalarScaler, _partition_values
 
 __all__ = ["AccessAnomaly", "AccessAnomalyModel", "ConnectedComponents"]
 
@@ -195,15 +195,15 @@ class AccessAnomaly(Estimator):
                     "__lik__", np.asarray(indexed[self.likelihood_col],
                                           np.float64))
         else:
+            default_lik = 1.0 if self.high_value is None else self.high_value
             indexed = indexed.with_column("__lik__",
                                           np.full(indexed.num_rows,
-                                                  self.high_value or 1.0))
+                                                  default_lik))
 
         tenants = sorted({str(v) for v in table[tenant_col].tolist()})
         user_vecs: Dict[str, Dict[str, list]] = {}
         res_vecs: Dict[str, Dict[str, list]] = {}
-        parts = np.array([str(v) for v in indexed[tenant_col].tolist()],
-                         dtype=object)
+        parts = _partition_values(indexed, tenant_col, indexed.num_rows)
         k = self.rank_param
         for tenant in tenants:
             m = parts == tenant

@@ -12,6 +12,7 @@ from typing import Optional
 import numpy as np
 
 from ..core import Param, Table, Transformer
+from .scalers import _partition_values
 
 __all__ = ["ComplementAccessTransformer"]
 
@@ -39,10 +40,7 @@ class ComplementAccessTransformer(Transformer):
             return Table(empty)
         if pk is not None:
             self._validate_input(table, pk)
-            parts = np.array([str(v) for v in table[pk].tolist()],
-                             dtype=object)
-        else:
-            parts = np.array(["__all__"] * table.num_rows, dtype=object)
+        parts = _partition_values(table, pk, table.num_rows)
         rng = np.random.default_rng(self.seed)
         vals = {c: np.asarray(table[c], dtype=np.int64) for c in cols}
 

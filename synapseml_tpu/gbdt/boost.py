@@ -17,6 +17,7 @@ Boosting modes (reference param ``boostingType`` gbdt|rf|dart|goss,
 from __future__ import annotations
 
 import json
+from functools import lru_cache
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -714,7 +715,11 @@ _DEFAULTS = dict(
     categorical_feature=None, cat_smooth=10.0, max_cat_threshold=32,
     parallelism="data_parallel", top_k=20,
     num_class=1, seed=0, bagging_seed=3, metric=None, early_stopping_round=0,
-    early_stopping_min_delta=0.0, hist_method="auto", hist_chunk=2048,
+    early_stopping_min_delta=0.0, hist_method="auto", hist_chunk=1 << 20,
+    # leaf-local gather histograms measured SLOWER than the masked full pass
+    # on both v5e (cumsum/scatter compaction costs more than the fused
+    # one-hot contraction) and CPU — kept as an opt-in experiment
+    leaf_local=False,
     alpha=0.9, tweedie_variance_power=1.5, verbose=0,
     lambdarank_truncation_level=30, sigmoid=1.0, ndcg_at=10,
 )
@@ -733,6 +738,151 @@ def _resolve_objective(params):
     if name not in OBJECTIVES:
         raise ValueError(f"unknown objective {name!r}; available: {sorted(OBJECTIVES)}")
     return OBJECTIVES[name]()
+
+
+def _build_step(grad_fn=None, fobj=None, *, cfg, C, lr, boosting, d, cat_idx,
+                ff, bf, bfreq, use_goss, top_rate, other_rate, mesh, axis,
+                scan_iters=None):
+    """Build the jitted per-iteration training step.
+
+    Module-level so :func:`_cached_step` can reuse compiled programs across
+    ``train()`` calls — a per-call closure would make every fit re-trace and
+    re-compile the full ``num_leaves``-step XLA program (tens of seconds),
+    which dominated short runs and hyperparameter sweeps.
+
+    ``scan_iters=k``: instead of a single step, return the WHOLE k-iteration
+    training loop as one ``lax.scan`` program — one dispatch per fit instead
+    of one per iteration (host dispatch latency dominates on tunneled/remote
+    backends; per-iteration host work only exists for dart/eval/callbacks,
+    which use the per-step form). RNG streams match the host loop exactly:
+    carry key splits per iteration, bagging key folds by period."""
+    import jax
+    import jax.numpy as jnp
+
+    axis_name = axis if mesh is not None else None
+    cat_mask_np = None
+    if cat_idx:
+        cat_mask_np = np.zeros(d, np.float32)
+        cat_mask_np[list(cat_idx)] = 1.0
+
+    def make_weights(key, grad_abs, n_rows):
+        """Bagging/GOSS row mask. Starts from ones: sample weights already live in
+        the objective's grad/hess (multiplying again would square them)."""
+        ones = jnp.ones(n_rows, jnp.float32)
+        if use_goss:
+            cut = jnp.quantile(grad_abs, 1.0 - top_rate)
+            is_top = grad_abs >= cut
+            keep_small = jax.random.uniform(key, grad_abs.shape) < (
+                other_rate / max(1e-12, 1.0 - top_rate))
+            amp = (1.0 - top_rate) / max(other_rate, 1e-12)
+            return jnp.where(is_top, 1.0, jnp.where(keep_small, amp, 0.0))
+        if bf < 1.0 and bfreq > 0:
+            keep = jax.random.uniform(key, grad_abs.shape) < bf
+            return keep.astype(jnp.float32)
+        return ones
+
+    def one_iter(binned, yv, wv, raw, key, fkey):
+        """raw (n, C) -> per-class trees + new raw; runs fully on device."""
+        if fobj is not None:
+            g, h = fobj(raw[:, 0] if C == 1 else raw, yv, wv)
+            g = jnp.reshape(jnp.asarray(g, jnp.float32), (-1, C) if C > 1 else (-1, 1))
+            h = jnp.reshape(jnp.asarray(h, jnp.float32), (-1, C) if C > 1 else (-1, 1))
+        elif C == 1:
+            g, h = grad_fn(raw[:, 0], yv, wv)
+            g, h = g[:, None], h[:, None]
+        else:
+            g, h = grad_fn(raw, yv, wv)
+        g = g.astype(jnp.float32)
+        h = h.astype(jnp.float32)
+
+        fmask = (jax.random.uniform(fkey, (d,)) < ff).astype(jnp.float32) if ff < 1.0 \
+            else jnp.ones((d,), jnp.float32)
+        # never mask every feature
+        fmask = jnp.where(fmask.sum() == 0, jnp.ones((d,), jnp.float32), fmask)
+
+        bw = make_weights(key, jnp.abs(g).sum(axis=1), g.shape[0])
+
+        cmask = (jnp.asarray(cat_mask_np) if cat_mask_np is not None else None)
+
+        def grow_c(gc, hc):
+            return grow_tree(binned, gc, hc, bw, fmask, cfg,
+                             axis_name=axis_name, cat_mask=cmask)
+
+        if C == 1:
+            tree, node = grow_c(g[:, 0], h[:, 0])
+            trees = jax.tree.map(lambda a: a[None], tree)  # add class dim
+            delta = tree.leaf_value[node][:, None]
+        else:
+            trees, nodes = jax.vmap(grow_c, in_axes=(1, 1), out_axes=0)(g, h)
+            delta = jnp.stack(
+                [trees.leaf_value[c][nodes[c]] for c in range(C)], axis=1
+            )
+        if boosting == "rf":
+            new_raw = raw  # rf: every tree fits the base-score residual; avg at predict
+        else:
+            new_raw = raw + lr * delta
+        return trees, new_raw
+
+    def scan_loop(binned, yv, wv, raw, key0, bkey):
+        from jax import lax
+
+        def body(carry, i):
+            key, raw = carry
+            key, k2 = jax.random.split(key)
+            period = i if use_goss else i // max(bfreq, 1)
+            k1 = jax.random.fold_in(bkey, period)
+            if mesh is not None:
+                k1 = jax.random.fold_in(k1, jax.lax.axis_index(axis))
+            trees, raw = one_iter(binned, yv, wv, raw, k1, k2)
+            return (key, raw), trees
+
+        (_, raw), trees = lax.scan(body, (key0, raw),
+                                   jnp.arange(scan_iters))
+        return trees, raw
+
+    if mesh is not None:
+        from jax import shard_map
+        from jax.sharding import PartitionSpec as Pspec
+
+        data_spec = Pspec(axis)
+        rep = Pspec()
+        in_specs = (data_spec, data_spec, data_spec, data_spec, rep, rep)
+        out_specs = (rep, data_spec)
+        if scan_iters is not None:
+            return jax.jit(shard_map(scan_loop, mesh=mesh, in_specs=in_specs,
+                                     out_specs=out_specs, check_vma=False))
+
+        def sharded_iter(binned, yv, wv, raw, key, fkey):
+            key = jax.random.fold_in(key, jax.lax.axis_index(axis))
+            trees, new_raw = one_iter(binned, yv, wv, raw, key, fkey)
+            return trees, new_raw
+
+        return jax.jit(shard_map(
+            sharded_iter, mesh=mesh,
+            in_specs=in_specs,
+            out_specs=out_specs,
+            check_vma=False,
+        ))
+    if scan_iters is not None:
+        return jax.jit(scan_loop)
+    return jax.jit(one_iter)
+
+
+@lru_cache(maxsize=64)
+def _cached_step(obj_key, *, cfg, C, lr, boosting, d, cat_idx, ff, bf, bfreq,
+                 use_goss, top_rate, other_rate, mesh, axis, scan_iters=None):
+    """Compiled-step cache for built-in objectives (custom fobj / lambdarank
+    close over data and stay uncached). Keyed on every static that shapes the
+    traced program; jax's own jit cache then dedupes by input shape/dtype."""
+    obj_name, num_class, alpha, tweedie, sigmoid = obj_key
+    pp = dict(_DEFAULTS, objective=obj_name, num_class=num_class, alpha=alpha,
+              tweedie_variance_power=tweedie, sigmoid=sigmoid)
+    _, grad_fn = _resolve_objective(pp)
+    return _build_step(grad_fn=grad_fn, cfg=cfg, C=C, lr=lr, boosting=boosting,
+                       d=d, cat_idx=cat_idx, ff=ff, bf=bf, bfreq=bfreq,
+                       use_goss=use_goss, top_rate=top_rate,
+                       other_rate=other_rate, mesh=mesh, axis=axis,
+                       scan_iters=scan_iters)
 
 
 def train(params: Dict[str, Any], x: np.ndarray, y: np.ndarray,
@@ -759,6 +909,8 @@ def train(params: Dict[str, Any], x: np.ndarray, y: np.ndarray,
     p.update(params or {})
     obj_name = p["objective"]
     C = int(p["num_class"]) if obj_name in ("multiclass", "softmax") else 1
+    x_f32_in = np.asarray(x).dtype == np.float32
+    x32 = np.asarray(x) if x_f32_in else None  # keep: skips a f64->f32 roundtrip
     x = np.asarray(x, dtype=np.float64)
     y = np.asarray(y, dtype=np.float64)
     n, d = x.shape
@@ -797,7 +949,15 @@ def train(params: Dict[str, Any], x: np.ndarray, y: np.ndarray,
             mapper = BinMapper(max_bin=int(p["max_bin"]), seed=int(p["seed"]),
                                categorical_features=cat_features).fit(x)
     has_cat = bool(mapper.categorical_features)
-    binned_np = mapper.transform(x)
+    # Bin on DEVICE when exact: numeric features whose raw values are all
+    # f32-representable bin identically via device_bin's floored-f32 edges
+    # (see pack_edges), and the vectorized XLA binning replaces the host
+    # searchsorted pass — the single largest fixed cost at multi-million-row
+    # scale. f64-only values or categorical features keep the host path.
+    use_device_bin = (mesh is None and not mapper.cat_values
+                      and (x_f32_in
+                           or bool(np.all(x == x.astype(np.float32)))))
+    binned_np = None if use_device_bin else mapper.transform(x)
 
     if init_booster is not None:
         base = init_booster.base_score.copy()
@@ -843,6 +1003,9 @@ def train(params: Dict[str, Any], x: np.ndarray, y: np.ndarray,
         max_cat_threshold=int(p["max_cat_threshold"]),
         parallelism="voting" if parallelism.startswith("voting") else "data",
         top_k=int(p["top_k"]),
+        # multiclass vmaps grow_tree: a vmapped lax.switch runs every buffer
+        # branch (~2n/step), so leaf-local only pays off single-class
+        leaf_local=bool(p["leaf_local"]) and C == 1,
     )
     cat_mask_np = None
     if has_cat:
@@ -856,68 +1019,37 @@ def train(params: Dict[str, Any], x: np.ndarray, y: np.ndarray,
     top_rate, other_rate = float(p["top_rate"]), float(p["other_rate"])
 
     # -- the jitted per-iteration step --------------------------------------------
-    def make_weights(key, grad_abs, n_rows):
-        """Bagging/GOSS row mask. Starts from ones: sample weights already live in
-        the objective's grad/hess (multiplying again would square them)."""
-        ones = jnp.ones(n_rows, jnp.float32)
-        if use_goss:
-            cut = jnp.quantile(grad_abs, 1.0 - top_rate)
-            is_top = grad_abs >= cut
-            keep_small = jax.random.uniform(key, grad_abs.shape) < (other_rate / max(1e-12, 1.0 - top_rate))
-            amp = (1.0 - top_rate) / max(other_rate, 1e-12)
-            return jnp.where(is_top, 1.0, jnp.where(keep_small, amp, 0.0))
-        if bf < 1.0 and bfreq > 0:
-            keep = jax.random.uniform(key, grad_abs.shape) < bf
-            return keep.astype(jnp.float32)
-        return ones
+    cat_idx = (tuple(sorted(mapper.categorical_features))
+               if has_cat else None)
+    step_args = dict(cfg=cfg, C=C, lr=lr, boosting=boosting, d=d,
+                     cat_idx=cat_idx, ff=ff, bf=bf, bfreq=bfreq,
+                     use_goss=use_goss, top_rate=top_rate,
+                     other_rate=other_rate, mesh=mesh, axis=axis)
+    obj_key = (obj_name, C, float(p["alpha"]),
+               float(p["tweedie_variance_power"]), float(p["sigmoid"]))
+    step_cacheable = fobj is None and obj_name != "lambdarank"
 
-    axis_name = axis if mesh is not None else None
+    def make_step(scan_iters=None):
+        # Cacheable: the step is a pure function of these hashables, so a
+        # second train() with the same config reuses the compiled XLA program
+        # instead of re-tracing a fresh closure (compile dominates wall time
+        # for short benchmark-style runs).
+        if step_cacheable:
+            return _cached_step(obj_key, scan_iters=scan_iters, **step_args)
+        return _build_step(grad_fn=grad_fn, fobj=fobj, scan_iters=scan_iters,
+                           **step_args)
 
-    def one_iter(binned, yv, wv, raw, key, fkey):
-        """raw (n, C) -> per-class trees + new raw; runs fully on device."""
-        if fobj is not None:
-            g, h = fobj(raw[:, 0] if C == 1 else raw, yv, wv)
-            g = jnp.reshape(jnp.asarray(g, jnp.float32), (-1, C) if C > 1 else (-1, 1))
-            h = jnp.reshape(jnp.asarray(h, jnp.float32), (-1, C) if C > 1 else (-1, 1))
-        elif C == 1:
-            g, h = grad_fn(raw[:, 0], yv, wv)
-            g, h = g[:, None], h[:, None]
-        else:
-            g, h = grad_fn(raw, yv, wv)
-        g = g.astype(jnp.float32)
-        h = h.astype(jnp.float32)
-
-        fmask = (jax.random.uniform(fkey, (d,)) < ff).astype(jnp.float32) if ff < 1.0 \
-            else jnp.ones((d,), jnp.float32)
-        # never mask every feature
-        fmask = jnp.where(fmask.sum() == 0, jnp.ones((d,), jnp.float32), fmask)
-
-        bw = make_weights(key, jnp.abs(g).sum(axis=1), g.shape[0])
-
-        cmask = (jnp.asarray(cat_mask_np) if cat_mask_np is not None else None)
-
-        def grow_c(gc, hc):
-            return grow_tree(binned, gc, hc, bw, fmask, cfg,
-                             axis_name=axis_name, cat_mask=cmask)
-
-        if C == 1:
-            tree, node = grow_c(g[:, 0], h[:, 0])
-            trees = jax.tree.map(lambda a: a[None], tree)  # add class dim
-            delta = tree.leaf_value[node][:, None]
-        else:
-            trees, nodes = jax.vmap(grow_c, in_axes=(1, 1), out_axes=0)(g, h)
-            delta = jnp.stack(
-                [trees.leaf_value[c][nodes[c]] for c in range(C)], axis=1
-            )
-        if boosting == "rf":
-            new_raw = raw  # rf: every tree fits the base-score residual; avg at predict
-        else:
-            new_raw = raw + lr * delta
-        return trees, new_raw
+    # narrow binned storage: int8/int16 when bins fit — 4x/2x less transfer
+    # and HBM traffic for the histogram reads (the engine's bandwidth bound)
+    if mapper.n_bins <= 127:
+        bin_dtype = np.int8
+    elif mapper.n_bins <= 32767:
+        bin_dtype = np.int16
+    else:
+        bin_dtype = np.int32
 
     if mesh is not None:
         from jax.sharding import NamedSharding, PartitionSpec as Pspec
-        from jax import shard_map
 
         n_shards = mesh.shape[axis]
         pad = (-n) % n_shards
@@ -928,27 +1060,24 @@ def train(params: Dict[str, Any], x: np.ndarray, y: np.ndarray,
             raw0 = np.concatenate([raw0, raw0[:pad]], axis=0)
 
         data_spec = Pspec(axis)
-        rep = Pspec()
-
-        def sharded_iter(binned, yv, wv, raw, key, fkey):
-            key = jax.random.fold_in(key, jax.lax.axis_index(axis))
-            trees, new_raw = one_iter(binned, yv, wv, raw, key, fkey)
-            return trees, new_raw
-
-        step = jax.jit(shard_map(
-            sharded_iter, mesh=mesh,
-            in_specs=(data_spec, data_spec, data_spec, data_spec, rep, rep),
-            out_specs=(rep, data_spec),
-            check_vma=False,
-        ))
         dev_put = lambda a, spec: jax.device_put(a, NamedSharding(mesh, spec))
-        binned_d = dev_put(binned_np.astype(np.int32), data_spec)
+        binned_d = dev_put(binned_np.astype(bin_dtype), data_spec)
         y_d = dev_put(y.astype(np.float32), data_spec)
         w_d = dev_put(w_np.astype(np.float32), data_spec)
         raw_d = dev_put(raw0.astype(np.float32), data_spec)
+    elif use_device_bin:
+        from .device_predict import device_bin, pack_edges
+
+        edges, lens = pack_edges(mapper)
+        xb = jnp.asarray(np.ascontiguousarray(
+            x32 if x32 is not None else x.astype(np.float32)))
+        binned_d = device_bin(xb, jnp.asarray(edges), jnp.asarray(lens),
+                              mapper.missing_bin).astype(bin_dtype)
+        y_d = jnp.asarray(y, dtype=jnp.float32)
+        w_d = jnp.asarray(w_np, dtype=jnp.float32)
+        raw_d = jnp.asarray(raw0, dtype=jnp.float32)
     else:
-        step = jax.jit(one_iter)
-        binned_d = jnp.asarray(binned_np, dtype=jnp.int32)
+        binned_d = jnp.asarray(binned_np.astype(bin_dtype))
         y_d = jnp.asarray(y, dtype=jnp.float32)
         w_d = jnp.asarray(w_np, dtype=jnp.float32)
         raw_d = jnp.asarray(raw0, dtype=jnp.float32)
@@ -992,6 +1121,14 @@ def train(params: Dict[str, Any], x: np.ndarray, y: np.ndarray,
     trees_host: List[Any] = []
     tree_scales: List[float] = []
 
+    def host_binned():
+        """Host copy of the binned matrix, pulled lazily — only dart's
+        drop/re-add bookkeeping replays trees host-side."""
+        nonlocal binned_np
+        if binned_np is None:
+            binned_np = np.asarray(binned_d, dtype=np.int32)
+        return binned_np
+
     def predict_tree_binned(tr, binned_mat, c):
         node = np.zeros(binned_mat.shape[0], dtype=np.int32)
         par, feat, bins = tr.parent[c], tr.feature[c], tr.bin[c]
@@ -1011,12 +1148,21 @@ def train(params: Dict[str, Any], x: np.ndarray, y: np.ndarray,
     stopped_early = False
 
     # Only dart bookkeeping, per-iteration eval, and user callbacks need the
-    # tree on the HOST mid-loop. Without them, keep trees as device buffers and
-    # pull everything once after the loop — iterations then pipeline
-    # back-to-back on the device with no per-iteration host round-trip (the
-    # round-trip dominates wall time on tunneled/remote backends).
+    # tree on the HOST mid-loop. Without them the ENTIRE loop runs as one
+    # lax.scan program — a single dispatch instead of one per iteration (the
+    # host round-trip dominates wall time on tunneled/remote backends).
     sync_each_iter = bool(eval_binned) or boosting == "dart" or bool(callbacks)
 
+    if not sync_each_iter and num_iter > 0:
+        loop_fn = make_step(scan_iters=num_iter)
+        trees_stacked, raw_d = loop_fn(binned_d, y_d, w_d, raw_d, key, bkey)
+        stacked_np = jax.device_get(trees_stacked)  # each field (T, C, ...)
+        trees_host = [jax.tree.map(lambda a, i=i: a[i], stacked_np)
+                      for i in range(num_iter)]
+        tree_scales = [1.0] * num_iter
+        num_iter = 0  # host loop below is skipped
+
+    step = make_step() if num_iter > 0 else None
     for it in range(num_iter):
         key, k2 = jax.random.split(key)
         # LightGBM re-bags every bagging_freq iterations and reuses the bag
@@ -1034,15 +1180,14 @@ def train(params: Dict[str, Any], x: np.ndarray, y: np.ndarray,
                 for t in dart_dropped:
                     for c in range(C):
                         raw_np[:, c] -= lr * tree_scales[t] * predict_tree_binned(
-                            trees_host[t], binned_np, c)
+                            trees_host[t], host_binned(), c)
                 raw_d = _reput(raw_np, raw_d)
 
         trees, raw_d = step(binned_d, y_d, w_d, raw_d, k1, k2)
-        if sync_each_iter:
-            tree_np = jax.tree.map(np.asarray, trees)
-            trees_host.append(tree_np)
-        else:
-            trees_host.append(trees)  # device buffers; converted after the loop
+        # the no-sync case runs the scan fast-path above; this loop only
+        # exists for dart/eval/callbacks, which all need host trees
+        tree_np = jax.tree.map(np.asarray, trees)
+        trees_host.append(tree_np)
 
         scale = 1.0
         if boosting == "dart" and dart_dropped:
@@ -1051,7 +1196,7 @@ def train(params: Dict[str, Any], x: np.ndarray, y: np.ndarray,
             # normalize: dropped trees keep k/(k+1) of their weight; re-add them
             raw_np = np.array(raw_d)
             for c in range(C):
-                raw_np[:, c] -= (1.0 - scale) * lr * predict_tree_binned(tree_np, binned_np, c)
+                raw_np[:, c] -= (1.0 - scale) * lr * predict_tree_binned(tree_np, host_binned(), c)
             factor = k_d / (k_d + 1.0)
             for t in dart_dropped:
                 old = tree_scales[t]
@@ -1097,20 +1242,8 @@ def train(params: Dict[str, Any], x: np.ndarray, y: np.ndarray,
             break
 
     # -- assemble host model --------------------------------------------------------
-    if not sync_each_iter and trees_host:
-        if mesh is None:
-            # stack per-field ON DEVICE first: one transfer per field instead
-            # of fields*T tiny transfers (each costs a full RPC round-trip on
-            # tunneled backends — this is the difference between ~1s and ~80s
-            # for a 100-iteration model)
-            stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *trees_host)
-            stacked_np = jax.device_get(stacked)
-            trees_host = [jax.tree.map(lambda a, i=i: a[i], stacked_np)
-                          for i in range(len(trees_host))]
-        else:
-            # mesh outputs carry shard_map shardings; stacking mixed-sharded
-            # arrays is not supported — pull per tree (replicated, local)
-            trees_host = [jax.tree.map(np.asarray, t) for t in trees_host]
+    # (the scan fast-path already pulled trees to host in one batched
+    # device_get; the host loop pulls per iteration for dart/eval/callbacks)
     T = len(trees_host)
     parent = np.stack([t.parent for t in trees_host]) if T else np.zeros((0, C, L - 1), np.int32)
     feature = np.stack([t.feature for t in trees_host]) if T else np.zeros((0, C, L - 1), np.int32)

@@ -189,7 +189,22 @@ def device_bin(x, edges, lens, missing_bin: int):
     """
     import jax.numpy as jnp
 
-    x = jnp.asarray(x)
-    below = (edges[None, :, :] < x[:, :, None]).sum(-1).astype(jnp.int32)
-    bins = jnp.minimum(below, lens[None, :] - 1)
-    return jnp.where(jnp.isfinite(x), bins, missing_bin).astype(jnp.int32)
+    return _device_bin_kernel(int(missing_bin))(
+        jnp.asarray(x), jnp.asarray(edges), jnp.asarray(lens))
+
+
+@lru_cache(maxsize=16)
+def _device_bin_kernel(missing_bin: int):
+    # jitted: run eagerly, the (n, d, E) broadcast compare materializes in
+    # HBM op-by-op (tens of GB and tens of seconds at multi-million rows);
+    # under jit XLA fuses it into the reduction
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def run(x, edges, lens):
+        below = (edges[None, :, :] < x[:, :, None]).sum(-1).astype(jnp.int32)
+        bins = jnp.minimum(below, lens[None, :] - 1)
+        return jnp.where(jnp.isfinite(x), bins, missing_bin).astype(jnp.int32)
+
+    return run

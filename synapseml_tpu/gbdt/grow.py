@@ -48,11 +48,19 @@ class TreeConfig(NamedTuple):
     min_sum_hessian: float = 1e-3
     min_gain_to_split: float = 0.0
     hist_method: str = "auto"
-    hist_chunk: int = 2048
+    hist_chunk: int = 1 << 20
     cat_smooth: float = 10.0
     max_cat_threshold: int = 32
     parallelism: str = "data"   # 'data' | 'voting'
     top_k: int = 20             # voting: local vote size (global select = 2k)
+    # Leaf-local histograms (LightGBM's ConstructHistograms scans only the
+    # split leaf): gather the SMALLER child's rows into a static power-of-2
+    # buffer picked by lax.switch, histogram the buffer, and derive the other
+    # side by parent subtraction — work per split is proportional to the
+    # split leaf, not to n. Keep False under vmap (multiclass): a vmapped
+    # switch executes every branch, costing ~2n per step.
+    leaf_local: bool = False
+    leaf_buf_min: int = 1024    # smallest gather buffer (rows)
 
 
 class GrownTree(NamedTuple):
@@ -104,6 +112,57 @@ def grow_tree(binned, grad, hess, row_weight, feature_mask, cfg: TreeConfig,
         if axis_name is not None and not voting:
             h = lax.psum(h, axis_name)
         return h
+
+    # -- leaf-local gather histograms (LightGBM ConstructHistograms analogue) --
+    use_leaf_local = cfg.leaf_local and n > 2 * cfg.leaf_buf_min
+    if use_leaf_local:
+        from .histogram import histogram_panel
+
+        ghc_full = jnp.stack(
+            [grad * row_weight, hess * row_weight, row_weight], axis=-1)
+        # pad row n: zero weight, bin 0 — gathered padding contributes nothing
+        binned_pad = jnp.concatenate(
+            [binned, jnp.zeros((1, d), binned.dtype)], axis=0)
+        ghc_pad = jnp.concatenate(
+            [ghc_full, jnp.zeros((1, 3), ghc_full.dtype)], axis=0)
+        # Single-host the smaller child is <= ceil(n/2); under data-parallel
+        # shard_map the side is chosen by GLOBAL counts, so one shard's local
+        # membership can be up to n — the ladder must cover it or the compact
+        # scatter silently drops rows.
+        buf_max = (n if (axis_name is not None and not voting)
+                   else (n + 1) // 2)
+        sizes = []
+        sz = cfg.leaf_buf_min
+        while sz < buf_max:
+            sizes.append(sz)
+            sz *= 2
+        sizes.append(sz)
+        sizes_arr = jnp.asarray(sizes, jnp.int32)
+        row_ids = jnp.arange(n, dtype=jnp.int32)
+
+        def leaf_hist_local(mask, cnt):
+            """Histogram of the masked rows via a static-size gather buffer.
+
+            ``lax.switch`` picks the smallest power-of-2 buffer >= cnt; rows
+            compact into it with a cumsum scatter (out-of-buffer writes drop).
+            No collectives inside the branches, so shards may take different
+            branches under shard_map."""
+            pos = jnp.cumsum(mask) - 1  # compacted position per member row
+
+            def make_branch(size):
+                def br(_):
+                    tgt = jnp.where(mask, pos, size).astype(jnp.int32)
+                    idx = jnp.full((size,), n, jnp.int32).at[tgt].set(
+                        row_ids, mode="drop")
+                    rows = jnp.take(binned_pad, idx, axis=0)
+                    panel = jnp.take(ghc_pad, idx, axis=0)
+                    return histogram_panel(rows, panel, B,
+                                           method=cfg.hist_method,
+                                           chunk=cfg.hist_chunk)
+                return br
+
+            branch = jnp.minimum((cnt > sizes_arr).sum(), len(sizes) - 1)
+            return lax.switch(branch, [make_branch(s) for s in sizes], None)
 
     def gain_term(G, H):
         return _thresh_l1(G, l1) ** 2 / (H + l2)
@@ -218,11 +277,39 @@ def grow_tree(binned, grad, hess, row_weight, feature_mask, cfg: TreeConfig,
         f_sel = leaf_f[l]
         b_sel = leaf_b[l]
         in_set, is_cat = split_detail(hists, l, f_sel, b_sel)
-        col = jnp.take(binned, f_sel, axis=1)
+        # binned may be stored int8/int16 (HBM + transfer savings); gather
+        # indices must widen
+        col = jnp.take(binned, f_sel, axis=1).astype(jnp.int32)
         go_left = jnp.take(in_set, col)
         went_right = (node == l) & ~go_left & ok
         node = jnp.where(went_right, s + 1, node)
-        child = hist_of(row_weight * went_right.astype(jnp.float32))
+        if use_leaf_local:
+            # histogram only the SMALLER child's rows; derive the other side
+            # by parent subtraction (LightGBM's sibling subtract, but with the
+            # scan itself leaf-local instead of full-data)
+            # node is already updated: rows still in l are exactly the
+            # original members that went left
+            went_left = (node == l) & ok
+            cnt_r = went_right.sum().astype(jnp.int32)
+            cnt_l = went_left.sum().astype(jnp.int32)
+            if axis_name is not None and not voting:
+                # data mode psums h_small: every shard must pick the same side
+                cnt_r = lax.psum(cnt_r, axis_name)
+                cnt_l = lax.psum(cnt_l, axis_name)
+            smaller_right = cnt_r <= cnt_l
+            mask_small = jnp.where(smaller_right, went_right, went_left)
+            cnt_small = jnp.minimum(cnt_r, cnt_l)
+            if axis_name is not None and not voting:
+                # local buffer sizing: the local member count is what must fit
+                local_cnt = mask_small.sum().astype(jnp.int32)
+            else:
+                local_cnt = cnt_small
+            h_small = leaf_hist_local(mask_small, local_cnt)
+            if axis_name is not None and not voting:
+                h_small = lax.psum(h_small, axis_name)
+            child = jnp.where(smaller_right, h_small, hists[l] - h_small)
+        else:
+            child = hist_of(row_weight * went_right.astype(jnp.float32))
         hists = jnp.where(
             ok,
             hists.at[s + 1].set(child).at[l].add(-child),
@@ -271,7 +358,7 @@ def predict_binned(tree: GrownTree, binned):
     L1 = tree.parent.shape[0]
     for s in range(L1):
         p = tree.parent[s]
-        col = jnp.take(binned, tree.feature[s], axis=1)
+        col = jnp.take(binned, tree.feature[s], axis=1).astype(jnp.int32)
         is_cat = tree.bin[s] < 0
         go_left_cat = jnp.take(tree.cat_set[s], col) > 0
         go_left = jnp.where(is_cat, go_left_cat, col <= tree.bin[s])

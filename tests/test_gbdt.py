@@ -478,6 +478,22 @@ def test_categorical_split_beats_numeric_encoding():
     assert acc_cat >= acc_num
 
 
+def test_leaf_local_histograms_match_full_pass():
+    """Opt-in leaf-local gather histograms (lax.switch buffers) must grow the
+    same model as the default masked full pass (measured slower on TPU, kept
+    as an experiment — see TreeConfig.leaf_local)."""
+    rng = np.random.default_rng(33)
+    n = 6000  # > 2 * leaf_buf_min so the gather path actually engages
+    x = rng.normal(size=(n, 6))
+    y = (x[:, 0] + 0.5 * x[:, 1] > 0).astype(np.float64)
+    params = {"objective": "binary", "num_iterations": 5, "num_leaves": 15}
+    b_full = train({**params, "leaf_local": False}, x, y)
+    b_leaf = train({**params, "leaf_local": True}, x, y)
+    np.testing.assert_allclose(b_leaf.leaf_value, b_full.leaf_value,
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_array_equal(b_leaf.feature, b_full.feature)
+
+
 def test_categorical_feature_mixed_names_and_indexes():
     """Indices and names may be mixed (estimators concatenate
     categorical_slot_indexes + categorical_slot_names); advisor round-2
