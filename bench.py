@@ -39,19 +39,26 @@ def _peak_flops(dev) -> float | None:
     return None
 
 
-def _timed(fn, sync, warmup: int = 2, iters: int = 10):
-    """Chain iterations through a device-side accumulator and sync ONCE — the
-    dependency chain keeps the device busy back-to-back and is immune to
-    async-dispatch quirks on tunneled backends."""
-    for _ in range(warmup):
-        sync(fn())
+def _timed_device_loop(step, iters: int):
+    """Time ``iters`` executions of ``step(x) -> scalar`` as ONE on-device
+    fori_loop — a single dispatch, so per-call RPC latency on tunneled
+    backends can't contaminate the measurement (r02's ResNet 'regression'
+    was exactly that: per-iteration enqueue latency billed as device time).
+    The loop carries the accumulated scalar into each step's input at 1e-30
+    scale so XLA cannot hoist the body (numerically a no-op in bf16/f32)."""
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def loop():
+        def body(i, acc):
+            return acc + step(acc * jnp.float32(1e-30))
+        return jax.lax.fori_loop(0, iters, body, jnp.float32(0.0))
+
+    float(loop())  # compile + warm
     t0 = time.perf_counter()
-    acc = None
-    for _ in range(iters):
-        out = fn()
-        acc = out if acc is None else acc + out
-    sync(acc)
-    return (time.perf_counter() - t0) / iters
+    out = float(loop())  # scalar pull: real completion barrier
+    return (time.perf_counter() - t0) / iters, out
 
 
 def bench_resnet50(platform, peak):
@@ -66,11 +73,12 @@ def bench_resnet50(platform, peak):
     rng = np.random.default_rng(0)
     data = jax.device_put(rng.normal(size=(batch, 3, 224, 224)).astype(np.float32))
 
-    def run():
-        return fn({"data": data})["logits"].sum()
+    def step(eps):
+        return fn._run_positional(data + eps)[
+            fn.output_names.index("logits")].astype("float32").sum()
 
     iters = 30 if platform != "cpu" else 2
-    dt = _timed(run, lambda o: float(o), warmup=3, iters=iters)
+    dt, _ = _timed_device_loop(step, iters)
     ips = batch / dt
     flops_per_img = 4.09e9 * 2  # ~4.09 GMACs fwd (He et al. / v1.5)
     mfu = ips * flops_per_img / peak if peak else None
@@ -91,12 +99,16 @@ def bench_bert(platform, peak):
     ids = jax.device_put(rng.integers(0, 30000, size=(batch, S)).astype(np.int64))
     mask = jax.device_put(np.ones((batch, S), dtype=np.int64))
 
-    def run():
-        out = fn({"input_ids": ids, "attention_mask": mask})
-        return next(iter(out.values())).sum()
+    def step(eps):
+        import jax.numpy as jnp
+
+        ids_i = jnp.where(eps < 1e30, ids, 0)  # eps-dependent, value-stable
+        out = fn._run_positional(
+            *[ids_i if n == "input_ids" else mask for n in fn.input_names])
+        return out[0].astype("float32").sum()
 
     iters = 20 if platform != "cpu" else 2
-    dt = _timed(run, lambda o: float(o), warmup=3, iters=iters)
+    dt, _ = _timed_device_loop(step, iters)
     sps = batch / dt
     # matmul MACs per layer: qkv+out 4H^2 per token + ffn 2*H*FFN per token
     # + attention scores/values 2*S*H per token
@@ -168,17 +180,75 @@ def bench_vit_gbdt(platform, peak):
     booster = train({"objective": "binary", "num_iterations": 10,
                      "num_leaves": 15, "min_data_in_leaf": 2}, feats, yb)
 
-    def run():
+    def step(eps):
         # featurize -> device binning -> device tree scan: zero host transfers
-        f = fn({"data": data})["features"]
-        return booster.predict_device(f).sum()
+        f = fn._run_positional(data + eps)[fn.output_names.index("features")]
+        return booster.predict_device(f).sum().astype("float32")
 
     iters = 10 if platform != "cpu" else 2
-    dt = _timed(run, lambda o: float(o), warmup=2, iters=iters)
+    dt, _ = _timed_device_loop(step, iters)
     ips = batch / dt
     mfu = ips * 17.6e9 * 2 / peak if peak else None  # ViT-B/16 ~17.6 GMACs/img
     return {"images_per_sec_end_to_end": round(ips, 2),
             "mfu_vit_only": round(mfu, 4) if mfu else None}
+
+
+def bench_serving(platform):
+    """Serving latency p50/p99: continuous (push) vs micro-batch engines over
+    a trivial pipeline. Reference north-star: sub-millisecond continuous p50
+    (``website/docs/features/spark_serving/about.md:18,101``)."""
+    import threading
+    import urllib.request
+
+    from synapseml_tpu.core.stage import Transformer
+    from synapseml_tpu.io.serving import (MicroBatchServingEngine,
+                                          ServingServer, string_to_response)
+    from synapseml_tpu.io.serving_v2 import ContinuousServingEngine
+
+    class Echo(Transformer):
+        def _transform(self, table):
+            reqs = table["request"]
+            out = np.empty(len(reqs), dtype=object)
+            for i, r in enumerate(reqs):
+                out[i] = string_to_response((r.entity or b"").decode())
+            return table.with_column("reply", out)
+
+    def drive(make_engine, n_requests=200, n_threads=4):
+        srv = ServingServer(port=0)
+        eng = make_engine(srv).start()
+
+        def hit():
+            for _ in range(n_requests // n_threads):
+                req = urllib.request.Request(srv.address, data=b"x",
+                                             method="POST")
+                with urllib.request.urlopen(req, timeout=10) as r:
+                    r.read()
+
+        try:
+            # warm, then drop the warm-up sample so it can't show up as tail
+            req = urllib.request.Request(srv.address, data=b"w", method="POST")
+            with urllib.request.urlopen(req, timeout=10) as r:
+                r.read()
+            srv._latencies.clear()
+            threads = [threading.Thread(target=hit)
+                       for _ in range(n_threads)]
+            for th in threads:
+                th.start()
+            for th in threads:
+                th.join()
+            return (srv.latency_quantile(0.5), srv.latency_quantile(0.99))
+        finally:
+            eng.stop()
+
+    cont_p50, cont_p99 = drive(lambda s: ContinuousServingEngine(s, Echo()))
+    mb_p50, mb_p99 = drive(
+        lambda s: MicroBatchServingEngine(s, Echo(), interval=0.01))
+    return {
+        "continuous_p50_ms": round(cont_p50 * 1000, 3),
+        "continuous_p99_ms": round(cont_p99 * 1000, 3),
+        "microbatch_p50_ms": round(mb_p50 * 1000, 3),
+        "microbatch_p99_ms": round(mb_p99 * 1000, 3),
+    }
 
 
 def main() -> None:
@@ -197,6 +267,7 @@ def main() -> None:
         ("bert_base_onnx", lambda: bench_bert(platform, peak)),
         ("gbdt_higgs_scale", lambda: bench_gbdt_higgs(platform)),
         ("vit_to_gbdt_pipeline", lambda: bench_vit_gbdt(platform, peak)),
+        ("serving_latency", lambda: bench_serving(platform)),
     ]:
         try:
             extra[key] = fn()

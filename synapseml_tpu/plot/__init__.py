@@ -1,0 +1,6 @@
+"""Plot helpers (reference ``core/src/main/python/synapse/ml/plot/plot.py``)."""
+
+from .plot import confusion_matrix, plot_confusion_matrix, plot_roc, roc_curve
+
+__all__ = ["confusion_matrix", "roc_curve",
+           "plot_confusion_matrix", "plot_roc"]

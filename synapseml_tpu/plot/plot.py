@@ -1,0 +1,103 @@
+"""Confusion-matrix / ROC helpers.
+
+Reference: ``core/src/main/python/synapse/ml/plot/plot.py`` —
+``confusionMatrix(df, y_col, y_hat_col, labels)`` and
+``roc(df, y_col, y_hat_col, thresh)``, which delegate the math to sklearn
+and render with matplotlib. Here the math is plain numpy (no sklearn
+dependency) and rendering is split out so the computations are testable
+headless; the ``plot_*`` functions lazily import matplotlib like the
+reference.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..core import Table
+
+__all__ = ["confusion_matrix", "roc_curve",
+           "plot_confusion_matrix", "plot_roc"]
+
+
+def _columns(df, y_col: str, y_hat_col: str):
+    if isinstance(df, Table):
+        return np.asarray(df[y_col]), np.asarray(df[y_hat_col])
+    return np.asarray(df[y_col]), np.asarray(df[y_hat_col])  # pandas-like
+
+
+def confusion_matrix(df, y_col: str, y_hat_col: str,
+                     labels: Optional[Sequence] = None) -> np.ndarray:
+    """(L, L) count matrix, rows = true label, cols = predicted."""
+    y, y_hat = _columns(df, y_col, y_hat_col)
+    if labels is None:
+        labels = sorted({*np.asarray(y).tolist(), *np.asarray(y_hat).tolist()})
+    lut = {l: i for i, l in enumerate(labels)}
+    cm = np.zeros((len(labels), len(labels)), dtype=np.int64)
+    for t, p in zip(y.tolist(), y_hat.tolist()):
+        if t in lut and p in lut:
+            cm[lut[t], lut[p]] += 1
+    return cm
+
+
+def roc_curve(df, y_col: str, y_hat_col: str,
+              thresh: float = 0.5) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """(fpr, tpr, thresholds). ``y`` is binarized at ``thresh`` like the
+    reference's ``f2i``; ``y_hat`` is the score."""
+    y, score = _columns(df, y_col, y_hat_col)
+    y = (np.asarray(y, dtype=np.float64) > thresh).astype(np.int64)
+    score = np.asarray(score, dtype=np.float64)
+    order = np.argsort(-score, kind="stable")
+    y_s, s_s = y[order], score[order]
+    # thresholds at distinct scores: take the LAST index of each tie group
+    # so tied scores move together (sklearn semantics)
+    distinct = np.r_[np.diff(s_s) != 0, True]
+    tps = np.cumsum(y_s)[distinct]
+    fps = np.cumsum(1 - y_s)[distinct]
+    thresholds = s_s[distinct]
+    p = max(int(y.sum()), 1)
+    n = max(int((1 - y).sum()), 1)
+    tpr = np.r_[0.0, tps / p]
+    fpr = np.r_[0.0, fps / n]
+    thresholds = np.r_[np.inf, thresholds]
+    return fpr, tpr, thresholds
+
+
+def plot_confusion_matrix(df, y_col: str, y_hat_col: str,
+                          labels: Optional[Sequence] = None, ax=None):
+    """Render the confusion matrix (reference ``confusionMatrix``)."""
+    import matplotlib.pyplot as plt
+
+    y, y_hat = _columns(df, y_col, y_hat_col)
+    if labels is None:
+        labels = sorted({*np.asarray(y).tolist(), *np.asarray(y_hat).tolist()})
+    cm = confusion_matrix(df, y_col, y_hat_col, labels)
+    with np.errstate(invalid="ignore"):
+        cmn = cm.astype(float) / np.maximum(cm.sum(axis=1, keepdims=True), 1)
+    accuracy = float(np.mean(np.asarray(y) == np.asarray(y_hat)))
+    ax = ax or plt.gca()
+    ax.imshow(cmn, interpolation="nearest", cmap=plt.cm.Blues, vmin=0, vmax=1)
+    ticks = np.arange(len(labels))
+    ax.set_xticks(ticks, labels)
+    ax.set_yticks(ticks, labels)
+    for i in range(cm.shape[0]):
+        for j in range(cm.shape[1]):
+            ax.text(j, i, str(cm[i, j]), ha="center",
+                    color="white" if cmn[i, j] > 0.1 else "black")
+    ax.set_xlabel("Predicted Label")
+    ax.set_ylabel("True Label")
+    ax.set_title(f"Accuracy = {accuracy * 100:.1f}%")
+    return ax
+
+
+def plot_roc(df, y_col: str, y_hat_col: str, thresh: float = 0.5, ax=None):
+    """Render the ROC curve (reference ``roc``)."""
+    import matplotlib.pyplot as plt
+
+    fpr, tpr, _ = roc_curve(df, y_col, y_hat_col, thresh)
+    ax = ax or plt.gca()
+    ax.plot(fpr, tpr)
+    ax.set_xlabel("False Positive Rate")
+    ax.set_ylabel("True Positive Rate")
+    return ax

@@ -1,0 +1,140 @@
+"""Binary/image file IO + plot helper tests.
+
+Reference: ``BinaryFileFormat.scala:113`` / ``BinaryFileReader.scala`` suites
+and the image datasource; ``plot/plot.py``.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from synapseml_tpu import Table
+from synapseml_tpu.io.binary import (
+    read_binary_files,
+    read_images,
+    write_binary_files,
+)
+from synapseml_tpu.plot import confusion_matrix, roc_curve
+
+
+@pytest.fixture()
+def tree(tmp_path):
+    (tmp_path / "sub").mkdir()
+    (tmp_path / "a.bin").write_bytes(b"alpha")
+    (tmp_path / "b.txt").write_bytes(b"beta")
+    (tmp_path / "sub" / "c.bin").write_bytes(b"gamma")
+    return str(tmp_path)
+
+
+def test_read_binary_files_flat(tree):
+    t = read_binary_files(tree)
+    assert t.num_rows == 2  # sub/ not included
+    names = [os.path.basename(p) for p in t["path"]]
+    assert names == ["a.bin", "b.txt"]
+    assert t["bytes"][0] == b"alpha"
+    assert t.meta["bytes"]["type"] == "binary"
+
+
+def test_read_binary_files_recursive_and_pattern(tree):
+    t = read_binary_files(tree, recursive=True)
+    assert t.num_rows == 3
+    t2 = read_binary_files(tree, recursive=True, pattern="*.bin")
+    assert {os.path.basename(p) for p in t2["path"]} == {"a.bin", "c.bin"}
+
+
+def test_read_binary_files_missing_path():
+    with pytest.raises(FileNotFoundError):
+        read_binary_files("/nonexistent/dir")
+
+
+def test_write_binary_files_roundtrip(tree, tmp_path):
+    t = read_binary_files(tree, recursive=True)
+    out = str(tmp_path / "out")
+    write_binary_files(t, out)
+    t2 = read_binary_files(out)
+    assert t2.num_rows == 3
+    assert set(b for b in t2["bytes"]) == {b"alpha", b"beta", b"gamma"}
+
+
+@pytest.fixture()
+def image_dir(tmp_path):
+    from PIL import Image
+
+    rng = np.random.default_rng(0)
+    for i, size in enumerate([(16, 12), (8, 8)]):
+        arr = rng.integers(0, 255, size=(size[1], size[0], 3), dtype=np.uint8)
+        Image.fromarray(arr).save(tmp_path / f"img{i}.png")
+    (tmp_path / "notes.txt").write_bytes(b"not an image")
+    (tmp_path / "broken.png").write_bytes(b"truncated garbage")
+    return str(tmp_path)
+
+
+def test_read_images_decodes_and_drops_invalid(image_dir):
+    t = read_images(image_dir)
+    assert t.num_rows == 2  # txt + broken dropped
+    assert t["image"][0].shape == (12, 16, 3)
+    assert t["image"][1].shape == (8, 8, 3)
+    assert t["height"][0] == 12 and t["width"][0] == 16
+    assert t.meta["image"]["type"] == "image"
+
+
+def test_read_images_strict_raises(image_dir):
+    with pytest.raises(Exception):
+        read_images(image_dir, drop_invalid=False)
+
+
+def test_images_to_featurizer_to_classifier(image_dir):
+    """E2E: directory of images -> ImageFeaturizer -> LightGBMClassifier
+    (VERDICT item 9's done-criterion)."""
+    from synapseml_tpu.dl import ImageFeaturizer
+    from synapseml_tpu.gbdt import LightGBMClassifier
+    from synapseml_tpu.models import build_model_bytes
+
+    t = read_images(image_dir)
+    feat = ImageFeaturizer(
+        model_bytes=build_model_bytes("ResNet18", num_classes=4),
+        input_col="image", output_col="features")
+    ft = feat.transform(t)
+    ft = ft.with_column("label", np.array([0.0, 1.0]))
+    model = LightGBMClassifier(num_iterations=2, num_leaves=3,
+                               min_data_in_leaf=1).fit(ft)
+    out = model.transform(ft)
+    assert "prediction" in out
+
+
+# -- plot helpers --------------------------------------------------------------------
+
+def test_confusion_matrix_counts():
+    t = Table({"y": np.array([0, 0, 1, 1, 2], dtype=np.int64),
+               "yh": np.array([0, 1, 1, 1, 0], dtype=np.int64)})
+    cm = confusion_matrix(t, "y", "yh", labels=[0, 1, 2])
+    np.testing.assert_array_equal(cm, [[1, 1, 0], [0, 2, 0], [1, 0, 0]])
+
+
+def test_roc_curve_perfect_separation():
+    t = Table({"y": np.array([0, 0, 1, 1], dtype=np.float64),
+               "score": np.array([0.1, 0.2, 0.8, 0.9])})
+    fpr, tpr, th = roc_curve(t, "y", "score")
+    # ROC must reach (0, 1) before any false positive
+    assert 1.0 in tpr[fpr == 0]
+    assert fpr[-1] == 1.0 and tpr[-1] == 1.0
+
+
+def test_plot_functions_render(tmp_path):
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    from synapseml_tpu.plot import plot_confusion_matrix, plot_roc
+
+    t = Table({"y": np.array([0, 1, 1, 0], dtype=np.int64),
+               "yh": np.array([0, 1, 0, 0], dtype=np.int64),
+               "score": np.array([0.2, 0.9, 0.4, 0.1])})
+    ax = plot_confusion_matrix(t, "y", "yh")
+    assert "Accuracy" in ax.get_title()
+    plt.figure()
+    ax2 = plot_roc(t, "y", "score")
+    assert ax2.get_xlabel() == "False Positive Rate"
+    plt.close("all")
