@@ -1,0 +1,16 @@
+"""Codegen: reflect stage Params into generated API surfaces.
+
+Reference layer: ``core/.../codegen/`` — ``Wrappable.scala:68`` /
+``CodeGen.scala:23-199`` walk every stage in the jar via
+``JarLoadingUtils.instantiateServices`` and emit Python/R wrappers, setup
+files, and docs from ``Params`` reflection. This framework is Python-native
+(no wrapper language gap), so the same reflection emits what still has
+value: typed ``.pyi`` stubs for IDEs/type-checkers and a markdown API
+reference — from the live :data:`STAGE_REGISTRY`, so new stages are covered
+the moment they register (same enforcement surface as the fuzzing
+meta-test).
+"""
+
+from .generate import generate_api_docs, generate_stubs, registry_inventory
+
+__all__ = ["generate_api_docs", "generate_stubs", "registry_inventory"]

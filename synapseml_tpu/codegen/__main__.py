@@ -1,0 +1,18 @@
+"""CLI: ``python -m synapseml_tpu.codegen <out_dir>`` writes stubs + docs
+(reference: the sbt ``codegen`` task driving ``CodeGen.scala``)."""
+
+import sys
+
+from .generate import generate_api_docs, generate_stubs
+
+
+def main(argv) -> int:
+    out = argv[1] if len(argv) > 1 else "generated"
+    stubs = generate_stubs(f"{out}/stubs")  # stubs/<full module path>.pyi
+    docs = generate_api_docs(f"{out}/docs")
+    print(f"wrote {len(stubs)} stub files and {len(docs)} doc files to {out}/")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

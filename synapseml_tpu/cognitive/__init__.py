@@ -1,7 +1,9 @@
 """Cognitive-service transformers (reference ``cognitive/`` module, SURVEY.md §2.4)."""
 
 from .base import CognitiveServiceBase
+from .extended import *  # noqa: F401,F403
+from .extended import __all__ as _extended_all
 from .services import *  # noqa: F401,F403
 from .services import __all__ as _service_all
 
-__all__ = ["CognitiveServiceBase", *_service_all]
+__all__ = ["CognitiveServiceBase", *_service_all, *_extended_all]

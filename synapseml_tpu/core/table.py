@@ -216,6 +216,20 @@ class Table:
         cols = {k: v[idx] for k, v in self._columns.items()}
         return Table(cols, npartitions=self.npartitions, meta=self.meta)
 
+    # -- fluent API (reference ``core/.../core/spark/FluentAPI.scala:14-20``:
+    # ``df.mlTransform(stage, ...)`` / ``df.mlFit(estimator)``) ------------------
+
+    def ml_transform(self, *stages) -> "Table":
+        """Apply one or more transformers in sequence."""
+        out = self
+        for st in stages:
+            out = st.transform(out)
+        return out
+
+    def ml_fit(self, estimator):
+        """Fit an estimator on this table, returning its model."""
+        return estimator.fit(self)
+
     def filter(self, mask) -> "Table":
         mask = np.asarray(mask, dtype=bool)
         return self.take(np.nonzero(mask)[0])

@@ -1,0 +1,67 @@
+"""Codegen + fluent API tests.
+
+Reference: ``CodeGen.scala:23-199`` (wrapper/doc generation from Params
+reflection), ``FluentAPI.scala:14-20`` (df.mlTransform / df.mlFit).
+"""
+
+import os
+
+import numpy as np
+
+from synapseml_tpu import Table
+from synapseml_tpu.codegen import (
+    generate_api_docs,
+    generate_stubs,
+    registry_inventory,
+)
+from synapseml_tpu.core.stage import STAGE_REGISTRY
+
+
+def test_inventory_covers_registry():
+    inv = registry_inventory()
+    total = sum(len(v) for v in inv.values())
+    assert total == len(STAGE_REGISTRY)
+    assert any("gbdt" in m for m in inv)
+    assert any("recommendation" in m for m in inv)
+
+
+def test_generate_stubs(tmp_path):
+    written = generate_stubs(str(tmp_path))
+    assert written
+    gbdt_stub = [p for p in written if p.endswith(
+        os.path.join("synapseml_tpu", "gbdt", "estimators.pyi"))]
+    assert gbdt_stub
+    assert any(p.endswith(os.path.join("synapseml_tpu", "__init__.pyi"))
+               for p in written)
+    text = open(gbdt_stub[0]).read()
+    assert "class LightGBMClassifier:" in text
+    assert "num_iterations: int = 100" in text
+    assert "def __init__(self, uid: Optional[str] = None" in text
+
+
+def test_generate_api_docs(tmp_path):
+    written = generate_api_docs(str(tmp_path))
+    index = open(os.path.join(str(tmp_path), "index.md")).read()
+    assert f"{len(STAGE_REGISTRY)} registered stages" in index
+    sar_doc = [p for p in written if "recommendation_sar" in p]
+    assert sar_doc
+    text = open(sar_doc[0]).read()
+    assert "## SAR" in text
+    assert "| similarity_function |" in text
+    assert "jaccard" in text
+
+
+def test_fluent_api():
+    from synapseml_tpu.featurize import CleanMissingData
+    from synapseml_tpu.gbdt import LightGBMClassifier
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(300, 4))
+    y = (x[:, 0] > 0).astype(float)
+    t = Table({"features": x, "label": y})
+    model = t.ml_fit(LightGBMClassifier(num_iterations=3, num_leaves=4))
+    out = t.ml_transform(model)
+    assert "prediction" in out
+    # chaining multiple transformers
+    out2 = t.ml_transform(model, model)  # idempotent stage twice
+    assert "prediction" in out2

@@ -1,0 +1,234 @@
+"""Cognitive-tail tests against local stub services (async-reply polling,
+search writer batching, MAD train/poll, document translation, form ontology,
+streaming speech).
+
+Reference suites call live Azure endpoints; the stubs here verify protocol
+shape: 202+Location polling, batch payloads, key headers, chunked streams.
+"""
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+import pytest
+
+from synapseml_tpu.core import Table
+from synapseml_tpu.cognitive import (
+    AddDocuments,
+    AddressGeocoder,
+    AzureSearchWriter,
+    DetectMultivariateAnomaly,
+    DocumentTranslator,
+    FitMultivariateAnomaly,
+    FormOntologyLearner,
+    SpeechToTextSDK,
+)
+
+RECORDED = []
+
+
+@pytest.fixture()
+def stub():
+    """Async-reply-capable stub: first POST to /async* answers 202 with a
+    Location; the second GET poll answers 202 once then 200."""
+    polls = {"n": 0}
+
+    class H(BaseHTTPRequestHandler):
+        def _go(self, method):
+            n = int(self.headers.get("Content-Length") or 0)
+            body = self.rfile.read(n) if n else b""
+            RECORDED.append({"method": method, "path": self.path,
+                             "headers": dict(self.headers.items()),
+                             "body": body})
+            host = f"http://127.0.0.1:{self.server.server_address[1]}"
+            if self.path.startswith("/asyncsubmit"):
+                self.send_response(202)
+                self.send_header("Location", host + "/asyncresult")
+                self.send_header("Content-Length", "0")
+                self.end_headers()
+                return
+            if self.path.startswith("/asyncresult"):
+                polls["n"] += 1
+                if polls["n"] < 2:
+                    self.send_response(202)
+                    self.send_header("Content-Length", "0")
+                    self.end_headers()
+                    return
+                out = {"batchItems": [{"results": [{"address": "1 Way St"}]}],
+                       "status": "Succeeded"}
+            elif self.path.startswith("/models") and method == "POST" \
+                    and "detect" not in self.path:
+                self.send_response(201)
+                self.send_header("Location", host + "/models/model-123")
+                self.send_header("Content-Length", "0")
+                self.end_headers()
+                return
+            elif self.path.startswith("/models/model-123/detect"):
+                self.send_response(202)
+                self.send_header("Location", host + "/asyncdetect")
+                self.send_header("Content-Length", "0")
+                self.end_headers()
+                return
+            elif self.path.startswith("/asyncdetect"):
+                out = {"results": [
+                    {"timestamp": "t0", "value": {"isAnomaly": False}},
+                    {"timestamp": "t1", "value": {"isAnomaly": True}}]}
+            elif self.path.startswith("/models/model-123"):
+                polls["n"] += 1
+                status = "CREATED" if polls["n"] < 2 else "READY"
+                out = {"modelInfo": {"status": status}}
+            elif "docs/index" in self.path:
+                out = {"value": [{"status": True}]}
+            elif "speech" in self.path:
+                idx = self.headers.get("X-Chunk-Index", "0")
+                out = {"RecognitionStatus": "Success",
+                       "DisplayText": f"part{idx}"}
+            else:
+                out = {"ok": True}
+            data = json.dumps(out).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+        def do_POST(self):
+            self._go("POST")
+
+        def do_GET(self):
+            self._go("GET")
+
+        def log_message(self, *a):
+            pass
+
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), H)
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    RECORDED.clear()
+    yield f"http://127.0.0.1:{httpd.server_address[1]}"
+    httpd.shutdown()
+
+
+def test_address_geocoder_batch_and_async_poll(stub):
+    t = Table({"addr": np.array([["1 Main St", "2 Side Ave"]], dtype=object)})
+    geo = AddressGeocoder(url=stub + "/asyncsubmit", subscription_key="K",
+                          address_col="addr", polling_delay=0.01)
+    out = geo.transform(t)
+    assert out["errors"][0] is None
+    assert out["output"][0][0]["results"][0]["address"] == "1 Way St"
+    submit = RECORDED[0]
+    assert "subscription-key=K" in submit["path"]
+    assert "api-version=1.0" in submit["path"]
+    body = json.loads(submit["body"])
+    assert len(body["batchItems"]) == 2
+    # polled at least twice (one 202, then 200)
+    assert sum(1 for r in RECORDED if r["path"].startswith("/asyncresult")) >= 2
+
+
+def test_azure_search_writer_batches(stub):
+    t = Table({"id": np.array(["a", "b", "c"], dtype=object),
+               "score": np.array([1.0, 2.0, 3.0])})
+    out = AzureSearchWriter.write(
+        t, subscription_key="SK", url=stub + "/indexes/idx/docs/index",
+        batch_size=2)
+    assert out.num_rows == 2  # ceil(3/2) batches
+    bodies = [json.loads(r["body"]) for r in RECORDED]  # concurrent: any order
+    assert sorted(len(b["value"]) for b in bodies) == [1, 2]
+    assert all(d["@search.action"] == "upload"
+               for b in bodies for d in b["value"])
+    headers = {k.lower(): v for k, v in RECORDED[0]["headers"].items()}
+    assert headers.get("api-key") == "SK"
+
+
+def test_add_documents_merge_action(stub):
+    docs = np.empty(1, dtype=object)
+    docs[0] = [{"id": "1", "@search.action": "merge"}]
+    out = AddDocuments(subscription_key="SK",
+                       url=stub + "/indexes/i/docs/index").transform(
+        Table({"documents": docs}))
+    body = json.loads(RECORDED[0]["body"])
+    assert body["value"][0]["@search.action"] == "merge"
+    assert out["errors"][0] is None
+
+
+def test_fit_multivariate_anomaly_trains_and_detects(stub):
+    est = FitMultivariateAnomaly(
+        url=stub, subscription_key="K", source="blob://data",
+        start_time="2021-01-01T00:00:00Z", end_time="2021-01-02T00:00:00Z",
+        sliding_window=200, polling_delay=0.01)
+    model = est.fit(Table({}))
+    assert isinstance(model, DetectMultivariateAnomaly)
+    assert model.model_id == "model-123"
+    submit = json.loads(RECORDED[0]["body"])
+    assert submit["slidingWindow"] == 200
+    assert submit["alignPolicy"]["fillNAMethod"] == "Linear"
+
+    t = Table({"timestamp": np.array(["t0", "t1"], dtype=object)})
+    scored = model.transform(t)
+    assert scored["output"][0]["value"]["isAnomaly"] is False
+    assert scored["output"][1]["value"]["isAnomaly"] is True
+
+
+def test_document_translator_payload_and_poll(stub):
+    t = Table({"src": np.array(["https://src/container"], dtype=object)})
+    dt = DocumentTranslator(
+        url=stub + "/asyncsubmit", subscription_key="K",
+        source_url_col="src", filter_prefix="docs/",
+        targets=[{"targetUrl": "https://dst", "language": "fr"}],
+        polling_delay=0.01)
+    out = dt.transform(t)
+    assert out["errors"][0] is None
+    body = json.loads(RECORDED[0]["body"])
+    assert body["inputs"][0]["source"]["filter"]["prefix"] == "docs/"
+    assert body["inputs"][0]["targets"][0]["language"] == "fr"
+
+
+def test_form_ontology_learner_merges_and_projects():
+    forms = np.empty(2, dtype=object)
+    forms[0] = {"analyzeResult": {"documentResults": [{"fields": {
+        "Total": {"valueNumber": 12.5},
+        "Vendor": {"valueString": "acme"},
+    }}]}}
+    forms[1] = {"analyzeResult": {"documentResults": [{"fields": {
+        "Total": {"valueInteger": 3},
+        "Items": {"valueArray": [{"valueObject": {
+            "Name": {"valueString": "x"}}}]},
+    }}]}}
+    t = Table({"form": forms})
+    model = FormOntologyLearner(input_col="form", output_col="o").fit(t)
+    # integer + number widen to number; all field names unioned
+    assert model.ontology["Total"] == "number"
+    assert set(model.ontology) == {"Total", "Vendor", "Items"}
+    out = model.transform(t)
+    assert out["o"][0] == {"Total": 12.5, "Vendor": "acme", "Items": None}
+    assert out["o"][1]["Items"] == [{"Name": "x"}]
+
+
+def test_speech_to_text_sdk_streams_chunks(stub):
+    audio = np.empty(1, dtype=object)
+    audio[0] = b"x" * 2500  # 3 chunks of 1000
+    t = Table({"audio": audio})
+    stt = SpeechToTextSDK(url=stub + "/speech", subscription_key="K",
+                          chunk_size=1000)
+    out = stt.transform(t)
+    assert out["errors"][0] is None
+    assert out["output"][0]["DisplayText"] == "part0 part1 part2"
+    sends = [r for r in RECORDED if "speech" in r["path"]]
+    assert len(sends) == 3
+
+    def h(rec, name):  # urllib title-cases header names
+        return {k.lower(): v for k, v in rec["headers"].items()}[name]
+
+    assert h(sends[0], "x-chunk-count") == "3"
+    assert h(sends[0], "content-type") == "audio/wav"
+    assert len({h(s, "x-connectionid") for s in sends}) == 1
+
+
+def test_async_poll_timeout_reports_error(stub):
+    # a submit URL that never completes: point Location at /asyncsubmit again
+    t = Table({"addr": np.array([["a"]], dtype=object)})
+    geo = AddressGeocoder(url=stub + "/neverdone", subscription_key="K",
+                          address_col="addr")
+    out = geo.transform(t)  # /neverdone answers 200 {'ok': True} directly
+    assert out["output"][0] == {"ok": True}
