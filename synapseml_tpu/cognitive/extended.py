@@ -29,7 +29,7 @@ from ..core import ComplexParam, Estimator, Model, Param, Table, Transformer
 from ..core.params import ParamValidators
 from ..io.clients import send_with_retries
 from ..io.http_schema import HTTPRequestData, HTTPResponseData
-from .base import CognitiveServiceBase, _np_jsonable
+from .base import CognitiveServiceBase, jsonable_value
 
 __all__ = [
     "AddressGeocoder", "ReverseAddressGeocoder",
@@ -39,6 +39,14 @@ __all__ = [
     "FormOntologyLearner", "FormOntologyTransformer",
     "SpeechToTextSDK",
 ]
+
+
+class AsyncPollError(RuntimeError):
+    """A 202 poll failed; ``status`` is the failing poll's status code."""
+
+    def __init__(self, message: str, status: Optional[int] = None):
+        super().__init__(message)
+        self.status = status
 
 
 class _AsyncReplyMixin:
@@ -59,7 +67,8 @@ class _AsyncReplyMixin:
             if k.lower() in ("location", "operation-location"):
                 location = v
         if not location:
-            raise RuntimeError("202 reply without a Location header")
+            raise AsyncPollError("202 reply without a Location header",
+                                 status=resp.status_code)
         if location_suffix:
             location += ("&" if "?" in location else "?") + location_suffix
         for _ in range(self.max_polling_retries):
@@ -69,8 +78,9 @@ class _AsyncReplyMixin:
             if poll.status_code == 200:
                 return poll
             if poll.status_code != 202:
-                raise RuntimeError(
-                    f"async poll got status {poll.status_code}: {poll.text!r}")
+                raise AsyncPollError(
+                    f"async poll got status {poll.status_code}: {poll.text!r}",
+                    status=poll.status_code)
             time.sleep(self.polling_delay)
         raise TimeoutError(f"async result not ready after "
                            f"{self.max_polling_retries} polls")
@@ -129,7 +139,8 @@ class _AzureMapsBase(_AsyncReplyMixin, CognitiveServiceBase):
                 resp = self.await_result(resp, location_suffix=suffix)
             except (RuntimeError, TimeoutError) as e:
                 out[i] = None
-                errors[i] = {"statusCode": resp.status_code, "reason": str(e)}
+                errors[i] = {"statusCode": getattr(e, "status", None),
+                             "reason": str(e)}
                 continue
             if 200 <= resp.status_code < 300:
                 parsed = self.parse_response(resp)
@@ -244,11 +255,7 @@ class AzureSearchWriter:
         for i in range(table.num_rows):
             doc = {}
             for c in cols:
-                v = table[c][i]
-                if isinstance(v, np.generic):
-                    v = v.item()
-                elif isinstance(v, np.ndarray):
-                    v = v.tolist()
+                v = jsonable_value(table[c][i])
                 if filter_nulls and v is None:
                     continue
                 doc[c] = v
@@ -448,7 +455,7 @@ class DocumentTranslator(_AsyncReplyMixin, CognitiveServiceBase):
                 errors[i] = None
             except (RuntimeError, TimeoutError) as e:
                 out[i] = None
-                errors[i] = {"statusCode": resp.status_code,
+                errors[i] = {"statusCode": getattr(e, "status", None),
                              "reason": str(e)}
         return (table.with_column(self.output_col, out)
                 .with_column(self.error_col, errors))

@@ -1,0 +1,142 @@
+"""Port forwarding for serving behind load balancers.
+
+Reference: ``core/.../io/http/PortForwarding.scala`` — jsch ``ssh -R``
+reverse tunnels with port-scan retry so per-worker serving endpoints become
+reachable through a frontend host. Two layers here:
+
+- :class:`TcpForwarder` — a pure-Python TCP relay (listen locally, pipe to a
+  target host:port). This is the in-process building block and is fully
+  testable; it also gives the DistributedServingEngine a frontend that
+  round-robins like the reference's load-balancer path.
+- :func:`forward_port_to_remote` — the ssh -R analogue via the system ssh
+  client, with the reference's port-scan-on-bind-conflict retry loop.
+"""
+
+from __future__ import annotations
+
+import socket
+import subprocess
+import threading
+from typing import List, Optional, Tuple
+
+__all__ = ["TcpForwarder", "forward_port_to_remote"]
+
+
+class TcpForwarder:
+    """Relay connections from a local listen port to target (host, port)s,
+    round-robin when several targets are given."""
+
+    def __init__(self, targets: List[Tuple[str, int]], listen_port: int = 0,
+                 host: str = "127.0.0.1"):
+        if not targets:
+            raise ValueError("need at least one target")
+        self.targets = list(targets)
+        self._next = 0
+        self._lock = threading.Lock()
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind((host, listen_port))
+        self._listener.listen(64)
+        self.port = self._listener.getsockname()[1]
+        self.address = f"http://{host}:{self.port}"
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._accept_loop,
+                                        name="tcp-forwarder", daemon=True)
+        self.connections_forwarded = 0
+
+    def start(self) -> "TcpForwarder":
+        self._thread.start()
+        return self
+
+    def _pick(self) -> Tuple[str, int]:
+        with self._lock:
+            t = self.targets[self._next % len(self.targets)]
+            self._next += 1
+            return t
+
+    def _accept_loop(self):
+        self._listener.settimeout(0.2)
+        while not self._stop.is_set():
+            try:
+                client, _ = self._listener.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            host, port = self._pick()
+            try:
+                upstream = socket.create_connection((host, port), timeout=10)
+            except OSError:
+                client.close()
+                continue
+            self.connections_forwarded += 1
+            for a, b in ((client, upstream), (upstream, client)):
+                threading.Thread(target=self._pipe, args=(a, b),
+                                 daemon=True).start()
+
+    @staticmethod
+    def _pipe(src: socket.socket, dst: socket.socket):
+        try:
+            while True:
+                data = src.recv(65536)
+                if not data:
+                    break
+                dst.sendall(data)
+        except OSError:
+            pass
+        finally:
+            try:
+                dst.shutdown(socket.SHUT_WR)
+            except OSError:
+                pass
+
+    def stop(self) -> None:
+        self._stop.set()
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        self._thread.join(timeout=2)
+
+
+def forward_port_to_remote(username: str, ssh_host: str, ssh_port: int,
+                           local_port: int, remote_port_start: int,
+                           bind_address: str = "*",
+                           local_host: str = "127.0.0.1",
+                           max_attempts: int = 10,
+                           establish_timeout: float = 5.0,
+                           ssh_binary: str = "ssh") -> Tuple[subprocess.Popen,
+                                                             int]:
+    """``ssh -R`` reverse tunnel with bind-conflict port scan (reference
+    ``forwardPortToRemote``, ``PortForwarding.scala:16-67``). Returns the
+    live ssh process and the remote port that bound.
+
+    ``establish_timeout`` is how long a surviving ssh process counts as an
+    established forward (``ExitOnForwardFailure`` makes ssh exit on a remote
+    bind conflict; size this above your handshake+auth latency — a slow WAN
+    link with the default too low would report success before ssh finished
+    connecting). Output streams go to DEVNULL: a long-lived ``ssh -N``
+    writing banners into an unread pipe would fill the buffer and hang the
+    tunnel."""
+    last_err: Optional[Exception] = None
+    for attempt in range(max_attempts):
+        remote_port = remote_port_start + attempt
+        cmd = [ssh_binary, "-N", "-p", str(ssh_port),
+               "-o", "ExitOnForwardFailure=yes",
+               "-o", "BatchMode=yes",
+               "-R", f"{bind_address}:{remote_port}:{local_host}:{local_port}",
+               f"{username}@{ssh_host}"]
+        try:
+            proc = subprocess.Popen(cmd, stdout=subprocess.DEVNULL,
+                                    stderr=subprocess.DEVNULL,
+                                    stdin=subprocess.DEVNULL)
+        except OSError as e:
+            raise RuntimeError(f"cannot launch {ssh_binary!r}: {e}") from e
+        try:
+            rc = proc.wait(timeout=establish_timeout)
+        except subprocess.TimeoutExpired:
+            return proc, remote_port  # still running: forward established
+        last_err = RuntimeError(
+            f"ssh exited rc={rc} binding remote port {remote_port}")
+    raise RuntimeError(f"no remote port bound after {max_attempts} attempts: "
+                       f"{last_err}")

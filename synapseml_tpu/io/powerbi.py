@@ -1,0 +1,54 @@
+"""PowerBI streaming-dataset writer.
+
+Reference: ``core/.../io/powerbi/PowerBIWriter.scala`` — rows batch into
+JSON arrays POSTed to a PowerBI push URL, with ``batchSize``, bounded
+``concurrency``, and retry/backoff handling via the HTTP client stack.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List
+
+import numpy as np
+
+from ..core import Table
+from .clients import AsyncHTTPClient
+from .http_schema import HTTPRequestData
+
+__all__ = ["PowerBIWriter"]
+
+
+class PowerBIWriter:
+    """Batched push of table rows to a PowerBI streaming dataset URL."""
+
+    @staticmethod
+    def write(table: Table, url: str, *, batch_size: int = 10,
+              concurrency: int = 1, timeout: float = 30.0,
+              backoffs=(100, 500, 1000)) -> Table:
+        """POST rows as JSON arrays in ``batch_size`` chunks. Returns a Table
+        of per-batch (status, error) rows; raises ValueError on bad args."""
+        if batch_size <= 0:
+            raise ValueError("batch_size must be positive")
+        if not url:
+            raise ValueError("url is required")
+        from ..cognitive.base import jsonable_value
+
+        cols = table.column_names
+        rows: List[Dict[str, Any]] = [
+            {c: jsonable_value(table[c][i]) for c in cols}
+            for i in range(table.num_rows)
+        ]
+        batches = [rows[i:i + batch_size]
+                   for i in range(0, len(rows), batch_size)]
+        reqs = [HTTPRequestData(
+            url=url, method="POST",
+            headers={"Content-Type": "application/json"},
+            entity=json.dumps(batch).encode()) for batch in batches]
+        client = AsyncHTTPClient(concurrency, timeout, list(backoffs))
+        responses = client.send_all(reqs)
+        status = np.array([r.status_code for r in responses], dtype=np.int64)
+        errors = np.empty(len(responses), dtype=object)
+        for i, r in enumerate(responses):
+            errors[i] = None if 200 <= r.status_code < 300 else r.to_dict()
+        return Table({"status": status, "errors": errors})

@@ -1,0 +1,18 @@
+"""Sequence/context parallelism for long-sequence inference.
+
+SURVEY.md §5 marks this NET-NEW: the reference predates LLM-scale sequence
+lengths (its only long-input handling is audio chunking,
+``SpeechToTextSDK.scala:232-339``). This package fills the capability gap
+the TPU-first way: attention over sequences sharded across the ICI mesh,
+with XLA collectives (``ppermute`` ring / ``all_to_all`` head exchange)
+doing the communication.
+"""
+
+from .ring import (
+    ring_attention,
+    sequence_sharded_attention,
+    ulysses_attention,
+)
+
+__all__ = ["ring_attention", "ulysses_attention",
+           "sequence_sharded_attention"]

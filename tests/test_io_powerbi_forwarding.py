@@ -1,0 +1,152 @@
+"""PowerBI writer + port forwarding tests.
+
+Reference: ``io/powerbi/PowerBIWriter.scala`` (batched JSON pushes),
+``io/http/PortForwarding.scala`` (reverse tunnels with port-scan retry).
+"""
+
+import json
+import os
+import stat
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+import pytest
+import urllib.request
+
+from synapseml_tpu.core import Table
+from synapseml_tpu.io.forwarding import TcpForwarder, forward_port_to_remote
+from synapseml_tpu.io.powerbi import PowerBIWriter
+
+RECORDED = []
+
+
+@pytest.fixture()
+def push_server():
+    class H(BaseHTTPRequestHandler):
+        def do_POST(self):
+            n = int(self.headers.get("Content-Length") or 0)
+            RECORDED.append(json.loads(self.rfile.read(n)))
+            if "/fail" in self.path:
+                self.send_error(429, "throttled")
+                return
+            self.send_response(200)
+            self.send_header("Content-Length", "0")
+            self.end_headers()
+
+        def log_message(self, *a):
+            pass
+
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), H)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    RECORDED.clear()
+    yield f"http://127.0.0.1:{httpd.server_address[1]}"
+    httpd.shutdown()
+
+
+def test_powerbi_writer_batches_rows(push_server):
+    t = Table({"name": np.array(["a", "b", "c"], dtype=object),
+               "value": np.array([1.5, 2.5, 3.5])})
+    out = PowerBIWriter.write(t, push_server + "/push", batch_size=2)
+    assert out.num_rows == 2
+    assert np.asarray(out["status"]).tolist() == [200, 200]
+    assert sorted(len(b) for b in RECORDED) == [1, 2]
+    flat = [r for b in RECORDED for r in b]
+    assert {r["name"] for r in flat} == {"a", "b", "c"}
+    assert all(isinstance(r["value"], float) for r in flat)
+
+
+def test_powerbi_writer_error_column(push_server):
+    t = Table({"x": np.arange(3).astype(np.float64)})
+    out = PowerBIWriter.write(t, push_server + "/fail", batch_size=10,
+                              backoffs=[])
+    assert np.asarray(out["status"])[0] == 429
+    assert out["errors"][0]["statusCode"] == 429
+
+
+def test_powerbi_writer_validates_args(push_server):
+    t = Table({"x": np.arange(2).astype(np.float64)})
+    with pytest.raises(ValueError, match="batch_size"):
+        PowerBIWriter.write(t, push_server, batch_size=0)
+    with pytest.raises(ValueError, match="url"):
+        PowerBIWriter.write(t, "")
+
+
+# -- TCP forwarding ------------------------------------------------------------------
+
+def test_tcp_forwarder_relays_http(push_server):
+    port = int(push_server.rsplit(":", 1)[1])
+    fwd = TcpForwarder([("127.0.0.1", port)]).start()
+    try:
+        req = urllib.request.Request(fwd.address + "/push",
+                                     data=json.dumps([{"k": 1}]).encode(),
+                                     method="POST")
+        with urllib.request.urlopen(req, timeout=10) as r:
+            assert r.status == 200
+        assert fwd.connections_forwarded >= 1
+    finally:
+        fwd.stop()
+
+
+def test_tcp_forwarder_round_robin():
+    hits = {"a": 0, "b": 0}
+
+    def make(name):
+        class H(BaseHTTPRequestHandler):
+            def do_GET(self):
+                hits[name] += 1
+                self.send_response(200)
+                self.send_header("Content-Length", "0")
+                self.end_headers()
+
+            def log_message(self, *a):
+                pass
+
+        httpd = ThreadingHTTPServer(("127.0.0.1", 0), H)
+        threading.Thread(target=httpd.serve_forever, daemon=True).start()
+        return httpd
+
+    s1, s2 = make("a"), make("b")
+    fwd = TcpForwarder([("127.0.0.1", s1.server_address[1]),
+                        ("127.0.0.1", s2.server_address[1])]).start()
+    try:
+        for _ in range(4):
+            with urllib.request.urlopen(fwd.address, timeout=10) as r:
+                r.read()
+        assert hits == {"a": 2, "b": 2}
+    finally:
+        fwd.stop()
+        s1.shutdown()
+        s2.shutdown()
+
+
+def test_forward_port_to_remote_port_scan(tmp_path):
+    """Fake ssh binary: fails (bind conflict) for the first port, stays alive
+    for the next — the scan loop must land on the second port."""
+    fake = tmp_path / "ssh"
+    fake.write_text("""#!/bin/sh
+for arg in "$@"; do
+  case "$arg" in
+    *:9000:*) exit 1 ;;  # first port: bind conflict
+  esac
+done
+sleep 30
+""")
+    fake.chmod(fake.stat().st_mode | stat.S_IEXEC)
+    proc, port = forward_port_to_remote(
+        "user", "frontend", 22, local_port=8080, remote_port_start=9000,
+        ssh_binary=str(fake))
+    try:
+        assert port == 9001
+        assert proc.poll() is None  # tunnel process alive
+    finally:
+        proc.kill()
+
+
+def test_forward_port_to_remote_exhausted(tmp_path):
+    fake = tmp_path / "ssh"
+    fake.write_text("#!/bin/sh\nexit 1\n")
+    fake.chmod(fake.stat().st_mode | stat.S_IEXEC)
+    with pytest.raises(RuntimeError, match="no remote port bound"):
+        forward_port_to_remote("u", "h", 22, 8080, 9000, max_attempts=3,
+                               ssh_binary=str(fake))
