@@ -1,0 +1,55 @@
+# %% [markdown]
+# # ONNX inference + image featurization pipeline
+#
+# Reference notebooks: `notebooks/features/onnx/` and
+# `notebooks/features/opencv/` — import an ONNX model, transform images
+# through XLA kernels, featurize with a headless CNN, and train a
+# classifier on the features (the ImageFeaturizer -> LightGBM demo).
+
+# %%
+import numpy as np
+
+from synapseml_tpu import Pipeline, Table
+from synapseml_tpu.dl import ImageFeaturizer
+from synapseml_tpu.gbdt import LightGBMClassifier
+from synapseml_tpu.image import ImageTransformer
+from synapseml_tpu.models import build_model_bytes
+from synapseml_tpu.onnx import OnnxFunction
+
+# %% raw ONNX execution: the importer turns bytes into a jittable function
+fn = OnnxFunction(build_model_bytes("ResNet18", num_classes=10))
+imgs = np.random.default_rng(0).normal(size=(4, 3, 224, 224)).astype(np.float32)
+out = fn({"data": imgs})
+print("logits:", np.asarray(out["logits"]).shape,
+      "features:", np.asarray(out["features"]).shape)
+
+# %% image preprocessing as a pipeline stage (resize/crop/flip on XLA)
+rng = np.random.default_rng(1)
+n = 16
+raw = np.empty(n, dtype=object)
+labels = np.zeros(n)
+for i in range(n):
+    base = rng.integers(0, 255, size=(48, 64, 3)).astype(np.uint8)
+    if i % 2:  # class 1: bright center square
+        base[16:32, 24:40] = 250
+        labels[i] = 1.0
+    raw[i] = base
+t = Table({"image": raw, "label": labels})
+
+pre = ImageTransformer(input_col="image", output_col="image", stages=[
+    {"action": "resize", "height": 32, "width": 32},
+    {"action": "centercrop", "width": 28, "height": 28},
+])
+print("stages:", pre.stages)
+
+# %% featurize -> classify, end to end
+pipe = Pipeline(stages=[
+    pre,
+    ImageFeaturizer(model_bytes=build_model_bytes("ResNet18", num_classes=4),
+                    input_col="image", output_col="features"),
+    LightGBMClassifier(num_iterations=5, num_leaves=4, min_data_in_leaf=2),
+])
+model = pipe.fit(t)
+scored = model.transform(t)
+train_acc = (np.asarray(scored["prediction"]) == labels).mean()
+print("train accuracy:", train_acc)

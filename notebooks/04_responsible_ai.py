@@ -1,0 +1,47 @@
+# %% [markdown]
+# # Responsible AI: explainers + data balance analysis
+#
+# Reference notebooks: `notebooks/features/responsible_ai/` — model-agnostic
+# LIME/KernelSHAP explanations, ICE plots, and dataset balance measures.
+
+# %%
+import numpy as np
+
+from synapseml_tpu import Table
+from synapseml_tpu.explainers import VectorSHAP
+from synapseml_tpu.exploratory import (
+    AggregateBalanceMeasure,
+    DistributionBalanceMeasure,
+    FeatureBalanceMeasure,
+)
+from synapseml_tpu.gbdt import LightGBMClassifier
+
+# %% train a model whose decisions we want to explain
+rng = np.random.default_rng(0)
+n = 3000
+x = rng.normal(size=(n, 5))
+y = (2 * x[:, 0] - x[:, 1] > 0).astype(float)  # features 0 and 1 matter
+t = Table({"features": x, "label": y})
+model = LightGBMClassifier(num_iterations=20, num_leaves=15).fit(t)
+
+# %% KernelSHAP attributions: features 0/1 should dominate
+shap = VectorSHAP(
+    model=model, input_col="features", output_col="shap",
+    target_col="probability", target_classes=[1],
+    background_data=Table({"features": x[:100]}), seed=7)
+explained = shap.transform(Table({"features": x[:20]}))
+mean_abs = np.abs(np.stack(
+    [np.asarray(v, dtype=np.float64)[0, 1:] for v in explained["shap"]]
+)).mean(0)
+print("mean |shap| per feature:", np.round(mean_abs, 4))
+assert mean_abs[0] > mean_abs[2] and mean_abs[1] > mean_abs[3]
+
+# %% dataset balance measures over a sensitive column
+gender = np.where(rng.random(n) < 0.7, "M", "F").astype(object)
+bt = Table({"gender": gender, "label": y})
+fbm = FeatureBalanceMeasure(sensitive_cols=["gender"]).transform(bt)
+print("feature balance (M vs F):", fbm["FeatureBalanceMeasure"][0]["dp"])
+dbm = DistributionBalanceMeasure(sensitive_cols=["gender"]).transform(bt)
+print("distribution vs uniform:", dbm["DistributionBalanceMeasure"][0])
+abm = AggregateBalanceMeasure(sensitive_cols=["gender"]).transform(bt)
+print("aggregate:", abm["AggregateBalanceMeasure"][0])

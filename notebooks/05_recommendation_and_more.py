@@ -1,0 +1,80 @@
+# %% [markdown]
+# # Recommendation, anomaly detection, and hyperparameter tuning
+#
+# Reference notebooks: `notebooks/features/other/` — SAR recommendations,
+# isolation-forest anomaly scores, CyberML access anomalies, and
+# TuneHyperparameters.
+
+# %%
+import numpy as np
+
+from synapseml_tpu import Table
+from synapseml_tpu.recommendation import (RankingAdapter, RankingEvaluator,
+                                          SAR)
+
+rng = np.random.default_rng(0)
+
+# %% SAR: two taste groups; recommendations should stay in-group
+users, items, ratings = [], [], []
+for u in range(40):
+    pool = range(0, 15) if u % 2 == 0 else range(15, 30)
+    for it in rng.choice(list(pool), size=8, replace=False):
+        users.append(u)
+        items.append(int(it))
+        ratings.append(float(rng.integers(3, 6)))
+t = Table({"user": np.array(users, np.int64),
+           "item": np.array(items, np.int64),
+           "rating": np.array(ratings)})
+model = SAR(support_threshold=1).fit(t)
+recs = model.recommend_for_all_users(5, remove_seen=True)
+print("user 0 recs:", recs["recommendations"][0])
+
+ranked = RankingAdapter(k=5, recommender=SAR(support_threshold=1)).fit(t).transform(t)
+print("ndcg@5:", RankingEvaluator(k=5, n_items=30).evaluate(ranked))
+
+# %% isolation forest: score a contaminated cluster
+from synapseml_tpu.isolationforest import IsolationForest
+
+inliers = rng.normal(size=(500, 4))
+outliers = rng.normal(size=(20, 4)) + 7.0
+iso = IsolationForest(num_estimators=50, contamination=20 / 520,
+                      random_seed=1).fit(Table({"features": np.vstack([inliers, outliers])}))
+scored = iso.transform(Table({"features": np.vstack([inliers, outliers])}))
+flagged = np.asarray(scored["predictedLabel"])[-20:]
+print("outliers flagged:", int(flagged.sum()), "/ 20")
+
+# %% CyberML: cross-group resource access is anomalous
+from synapseml_tpu.cyber import AccessAnomaly
+
+tenants, ausers, res = [], [], []
+for u in range(12):
+    pool = range(0, 5) if u < 6 else range(5, 10)
+    for _ in range(15):
+        tenants.append("t0")
+        ausers.append(f"user{u}")
+        res.append(f"res{rng.choice(list(pool))}")
+tenants += ["t0", "t0"]
+ausers += ["bridge", "bridge"]
+res += ["res0", "res9"]
+at = Table({"tenant": np.array(tenants, dtype=object),
+            "user": np.array(ausers, dtype=object),
+            "res": np.array(res, dtype=object)})
+aa = AccessAnomaly(max_iter=10, rank_param=8).fit(at)
+probe = Table({"tenant": np.array(["t0", "t0"], dtype=object),
+               "user": np.array(["user0", "user0"], dtype=object),
+               "res": np.array(["res1", "res8"], dtype=object)})
+scores = np.asarray(aa.transform(probe)["anomaly_score"])
+print("in-group score:", scores[0], " cross-group score:", scores[1])
+
+# %% hyperparameter tuning
+from synapseml_tpu.automl import TuneHyperparameters
+from synapseml_tpu.gbdt import LightGBMClassifier
+
+x = rng.normal(size=(2000, 6))
+y = ((x[:, 0] > 0) ^ (x[:, 1] > 0)).astype(float)
+tuner = TuneHyperparameters(
+    models=LightGBMClassifier(),
+    hyperparams={"num_leaves": [7, 31], "num_iterations": [10, 40]},
+    search_mode="grid", evaluation_metric="auc", seed=0)
+best = tuner.fit(Table({"features": x, "label": y}))
+print("best auc:", best.best_metric, "params:", best.best_params)

@@ -139,11 +139,4 @@ def _np_jsonable(v):
     raise TypeError(f"not JSON-serializable: {type(v)}")
 
 
-def jsonable_value(v):
-    """Coerce a table cell to a plain-JSON value (shared by the PowerBI and
-    AzureSearch writers)."""
-    if isinstance(v, np.generic):
-        return v.item()
-    if isinstance(v, np.ndarray):
-        return v.tolist()
-    return v
+from ..core.table import jsonable_value  # noqa: E402  (shared coercer)

@@ -29,7 +29,8 @@ from ..core import ComplexParam, Estimator, Model, Param, Table, Transformer
 from ..core.params import ParamValidators
 from ..io.clients import send_with_retries
 from ..io.http_schema import HTTPRequestData, HTTPResponseData
-from .base import CognitiveServiceBase, jsonable_value
+from ..core.table import jsonable_value
+from .base import CognitiveServiceBase
 
 __all__ = [
     "AddressGeocoder", "ReverseAddressGeocoder",

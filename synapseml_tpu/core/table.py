@@ -306,6 +306,16 @@ class Table:
         return f"Table[{self._num_rows} rows x {len(self._columns)} cols, {self.npartitions} parts]({schema})"
 
 
+def jsonable_value(v):
+    """Coerce a table cell to a plain-JSON value (shared by the PowerBI and
+    AzureSearch writers and any row-to-JSON path)."""
+    if isinstance(v, np.generic):
+        return v.item()
+    if isinstance(v, np.ndarray):
+        return v.tolist()
+    return v
+
+
 def features_matrix(col: np.ndarray, dtype=np.float64) -> np.ndarray:
     """Coerce a features column (dense 2-D or object array of vectors) to an
     (n, d) float matrix — the one shared conversion every vector-consuming

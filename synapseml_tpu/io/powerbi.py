@@ -32,7 +32,7 @@ class PowerBIWriter:
             raise ValueError("batch_size must be positive")
         if not url:
             raise ValueError("url is required")
-        from ..cognitive.base import jsonable_value
+        from ..core.table import jsonable_value
 
         cols = table.column_names
         rows: List[Dict[str, Any]] = [

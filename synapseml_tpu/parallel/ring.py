@@ -133,11 +133,11 @@ def sequence_sharded_attention(q, k, v, mesh, axis: str = "seq",
     n = mesh.shape[axis]
     S = q.shape[1]
     if S % n:
-        raise ValueError(f"sequence length {S} must divide the {axis!r} "
-                         f"axis size {n}")
+        raise ValueError(f"sequence length {S} must be divisible by the "
+                         f"{axis!r} axis size {n}")
     if strategy == "ulysses" and q.shape[2] % n:
-        raise ValueError(f"heads {q.shape[2]} must divide the axis size {n} "
-                         "for ulysses")
+        raise ValueError(f"heads {q.shape[2]} must be divisible by the axis "
+                         f"size {n} for ulysses")
     run = _sharded_attn_fn(mesh, axis, strategy, causal)
     sharding = NamedSharding(mesh, P(None, axis, None, None))
     return run(jax.device_put(q, sharding), jax.device_put(k, sharding),

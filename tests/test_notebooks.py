@@ -1,0 +1,27 @@
+"""Execute every demo notebook end-to-end.
+
+Reference: ``notebooks/features/**`` are run as E2E tests
+(``DatabricksTests.scala`` uploads and executes them; CI jobs
+``pipeline.yaml:88-172``). Here notebooks are ``# %%``-cell Python files and
+run in-process on the virtual mesh.
+"""
+
+import glob
+import os
+import runpy
+
+import pytest
+
+NOTEBOOK_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "notebooks")
+NOTEBOOKS = sorted(glob.glob(os.path.join(NOTEBOOK_DIR, "*.py")))
+
+
+def test_notebooks_exist():
+    assert len(NOTEBOOKS) >= 5
+
+
+@pytest.mark.parametrize("path", NOTEBOOKS,
+                         ids=[os.path.basename(p) for p in NOTEBOOKS])
+def test_notebook_runs(path):
+    runpy.run_path(path, run_name="__main__")

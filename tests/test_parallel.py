@@ -70,7 +70,7 @@ def test_ring_attention_bf16_inputs(mesh):
 
 def test_sequence_length_must_divide(mesh):
     q, k, v = _qkv(s=63)
-    with pytest.raises(ValueError, match="divide"):
+    with pytest.raises(ValueError, match="divisible"):
         sequence_sharded_attention(q, k, v, mesh)
 
 
