@@ -39,6 +39,19 @@ def _peak_flops(dev) -> float | None:
     return None
 
 
+def _best_of(k: int, run):
+    """Minimum wall time over k runs of ``run()`` — the hardware's number;
+    the rest is transient tunnel contention (identical runs measured 10x
+    apart on the shared tunnel)."""
+    best = None
+    for _ in range(k):
+        t0 = time.perf_counter()
+        run()
+        dt = time.perf_counter() - t0
+        best = dt if best is None else min(best, dt)
+    return best
+
+
 def _timed_device_loop(step, iters: int):
     """Time ``iters`` executions of ``step(x) -> scalar`` as ONE on-device
     fori_loop — a single dispatch, so per-call RPC latency on tunneled
@@ -56,9 +69,13 @@ def _timed_device_loop(step, iters: int):
         return jax.lax.fori_loop(0, iters, body, jnp.float32(0.0))
 
     float(loop())  # compile + warm
-    t0 = time.perf_counter()
-    out = float(loop())  # scalar pull: real completion barrier
-    return (time.perf_counter() - t0) / iters, out
+    out = []
+
+    def run():
+        out.append(float(loop()))  # scalar pull: real completion barrier
+
+    best = _best_of(3, run)
+    return best / iters, out[-1]
 
 
 def bench_resnet50(platform, peak):
@@ -130,12 +147,10 @@ def bench_gbdt_adult(platform):
 
     params = {"objective": "binary", "num_iterations": iters, "num_leaves": 31,
               "max_bin": 255}
-    # 2-iteration warmup populates the XLA compilation cache; the timed train
-    # runs iterations fully pipelined on device (no per-iter host sync)
-    train({**params, "num_iterations": 2}, x, y)
-    t0 = time.perf_counter()
+    # warmup populates the XLA compilation cache; the timed train runs
+    # iterations fully pipelined on device (no per-iter host sync)
     train(params, x, y)
-    dt = time.perf_counter() - t0
+    dt = _best_of(2, lambda: train(params, x, y))
     return {"train_rows_per_sec": round(n * iters / dt, 0), "rows": n,
             "iterations": iters}
 
@@ -155,9 +170,7 @@ def bench_gbdt_higgs(platform):
     # program keyed on num_iterations (and jit-specialized on shape), so any
     # other warmup would leave the timed run paying the full XLA compile
     train(params, x, y)
-    t0 = time.perf_counter()
-    train(params, x, y)
-    dt = time.perf_counter() - t0
+    dt = _best_of(2, lambda: train(params, x, y))
     return {"train_rows_per_sec": round(n * iters / dt, 0), "rows": n,
             "iterations": iters}
 
@@ -271,8 +284,17 @@ def main() -> None:
     ]:
         try:
             extra[key] = fn()
-        except Exception as e:  # record, keep benching
-            extra[key] = {"error": f"{type(e).__name__}: {e}"}
+        except Exception as first:
+            msg = f"{type(first).__name__}: {first}"
+            if "remote_compile" in str(first) or "INTERNAL" in str(first):
+                # the tunneled backend throws transient remote-compile/read
+                # errors unrelated to the workload: one retry, recorded
+                try:
+                    extra[key] = dict(fn(), retried_after=msg)
+                except Exception as e:
+                    extra[key] = {"error": f"{type(e).__name__}: {e}"}
+            else:
+                extra[key] = {"error": msg}
         if key == "resnet50_onnx" and "images_per_sec_per_chip" in extra[key]:
             headline = extra[key]["images_per_sec_per_chip"]
 
