@@ -17,6 +17,7 @@ bootstraps a socket allreduce ring from the driver (``LightGBMBase.scala:399-437
 """
 
 from .binning import BinMapper
+from .dataset import GBDTDataset
 from .boost import GBDTBooster, train
 from .estimators import (
     LightGBMClassificationModel,
@@ -28,6 +29,7 @@ from .estimators import (
 )
 
 __all__ = [
+    "GBDTDataset",
     "BinMapper",
     "GBDTBooster",
     "train",

@@ -152,3 +152,14 @@ class BinMapper:
         m.cat_values = {int(k): np.asarray(v)
                         for k, v in (d.get("cat_values") or {}).items()}
         return m
+
+
+def bin_dtype(n_bins: int):
+    """Narrowest integer dtype holding bin ids (shared by the trainer's
+    transfer path and GBDTDataset's cached device buffer — they must agree
+    or jitted steps retrace on dtype)."""
+    if n_bins <= 127:
+        return np.int8
+    if n_bins <= 32767:
+        return np.int16
+    return np.int32

@@ -1010,6 +1010,13 @@ def train(params: Dict[str, Any], x: np.ndarray, y: np.ndarray,
     p.update(params or {})
     obj_name = p["objective"]
     C = int(p["num_class"]) if obj_name in ("multiclass", "softmax") else 1
+    from .dataset import GBDTDataset
+
+    dataset = x if isinstance(x, GBDTDataset) else None
+    if dataset is not None:
+        x = dataset.x
+        if feature_names is None:
+            feature_names = dataset.feature_names
     x_f32_in = np.asarray(x).dtype == np.float32
     x32 = np.asarray(x) if x_f32_in else None  # keep: skips a f64->f32 roundtrip
     x = np.asarray(x, dtype=np.float64)
@@ -1046,19 +1053,43 @@ def train(params: Dict[str, Any], x: np.ndarray, y: np.ndarray,
     if mapper is None:
         if init_booster is not None:
             mapper = init_booster.mapper
+        elif dataset is not None:
+            # Dataset semantics (LightGBM): the dataset owns binning and
+            # overrides the call's max_bin/categorical params
+            mapper = dataset.mapper
+            import warnings
+
+            if "max_bin" in (params or {}) and \
+                    int(params["max_bin"]) != dataset.max_bin:
+                warnings.warn(
+                    f"max_bin={params['max_bin']} ignored: the GBDTDataset "
+                    f"was binned with max_bin={dataset.max_bin}",
+                    stacklevel=2)
+            if (params or {}).get("categorical_feature") and \
+                    sorted(cat_features) != sorted(mapper.categorical_features):
+                warnings.warn(
+                    f"categorical_feature={cat_features} conflicts with the "
+                    f"GBDTDataset's {sorted(mapper.categorical_features)}; "
+                    "the dataset's binning wins (pass categorical_features "
+                    "to GBDTDataset instead)", stacklevel=2)
         else:
             mapper = BinMapper(max_bin=int(p["max_bin"]), seed=int(p["seed"]),
                                categorical_features=cat_features).fit(x)
     has_cat = bool(mapper.categorical_features)
+    reuse_dataset = dataset is not None and mapper is dataset.mapper
     # Bin on DEVICE when exact: numeric features whose raw values are all
     # f32-representable bin identically via device_bin's floored-f32 edges
     # (see pack_edges), and the vectorized XLA binning replaces the host
     # searchsorted pass — the single largest fixed cost at multi-million-row
     # scale. f64-only values or categorical features keep the host path.
-    use_device_bin = (mesh is None and not mapper.cat_values
+    use_device_bin = (not reuse_dataset and mesh is None
+                      and not mapper.cat_values
                       and (x_f32_in
                            or bool(np.all(x == x.astype(np.float32)))))
-    binned_np = None if use_device_bin else mapper.transform(x)
+    if reuse_dataset:
+        binned_np = dataset.binned_np
+    else:
+        binned_np = None if use_device_bin else mapper.transform(x)
 
     if init_booster is not None:
         base = init_booster.base_score.copy()
@@ -1145,12 +1176,9 @@ def train(params: Dict[str, Any], x: np.ndarray, y: np.ndarray,
 
     # narrow binned storage: int8/int16 when bins fit — 4x/2x less transfer
     # and HBM traffic for the histogram reads (the engine's bandwidth bound)
-    if mapper.n_bins <= 127:
-        bin_dtype = np.int8
-    elif mapper.n_bins <= 32767:
-        bin_dtype = np.int16
-    else:
-        bin_dtype = np.int32
+    from .binning import bin_dtype as _bin_dtype
+
+    bin_dtype = _bin_dtype(mapper.n_bins)
 
     if mesh is not None:
         from jax.sharding import NamedSharding, PartitionSpec as Pspec
@@ -1169,6 +1197,11 @@ def train(params: Dict[str, Any], x: np.ndarray, y: np.ndarray,
         y_d = dev_put(y.astype(np.float32), data_spec)
         w_d = dev_put(w_np.astype(np.float32), data_spec)
         raw_d = dev_put(raw0.astype(np.float32), data_spec)
+    elif reuse_dataset:
+        binned_d = dataset.device_binned()  # uploaded once, shared across fits
+        y_d = jnp.asarray(y, dtype=jnp.float32)
+        w_d = jnp.asarray(w_np, dtype=jnp.float32)
+        raw_d = jnp.asarray(raw0, dtype=jnp.float32)
     elif use_device_bin:
         from .device_predict import device_bin, pack_edges
 
@@ -1204,6 +1237,8 @@ def train(params: Dict[str, Any], x: np.ndarray, y: np.ndarray,
     eval_binned = []
     if eval_set:
         for ex, ey in eval_set:
+            if isinstance(ex, GBDTDataset):
+                ex = ex.x  # symmetric with the x handling above
             ex = np.asarray(ex, dtype=np.float64)
             if init_booster is not None:  # continued training: seed with prior trees
                 eraw0 = init_booster.raw_predict(ex).reshape(len(ex), C).astype(np.float64)
