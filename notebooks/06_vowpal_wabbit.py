@@ -1,0 +1,94 @@
+# %% [markdown]
+# # Online linear learning (Vowpal-Wabbit-equivalent)
+#
+# Reference notebooks: `notebooks/features/vw/` — classification with the
+# VW featurizer, quadratic interactions, quantile regression, and a
+# contextual bandit. The engine is a jitted AdaGrad-SGD learner over
+# murmur-hashed sparse features; under a mesh, weights `pmean`-average at
+# pass boundaries (the reference's spanning-tree AllReduce as an XLA
+# collective).
+
+# %%
+import numpy as np
+
+from synapseml_tpu import Pipeline, Table
+from synapseml_tpu.vw import (VowpalWabbitClassifier,
+                              VowpalWabbitContextualBandit,
+                              VowpalWabbitFeaturizer,
+                              VowpalWabbitInteractions,
+                              VowpalWabbitRegressor)
+
+rng = np.random.default_rng(0)
+n = 4000
+
+# %% adult-income-style classification from mixed columns
+age = rng.uniform(18, 80, n)
+hours = rng.uniform(5, 60, n)
+city = rng.choice(["nyc", "sf", "chi"], n).astype(object)
+y = ((age * 0.03 + hours * 0.05 + (city == "sf") * 1.0
+      + rng.normal(0, 0.5, n)) > 3.2).astype(float)
+t = Table({"age": age, "hours": hours, "city": city, "label": y})
+
+feat = VowpalWabbitFeaturizer(input_cols=["age", "hours", "city"],
+                              output_col="features")
+model = Pipeline([feat, VowpalWabbitClassifier(
+    num_passes=5, pass_through_args="--loss_function logistic -l 0.8")]).fit(t)
+pred = model.transform(t)
+acc = float((np.asarray(pred["prediction"]) == y).mean())
+print("train accuracy:", round(acc, 3))
+assert acc > 0.8
+
+# %% quadratic interactions (VW -q): an XOR-style target no linear model
+# over the raw namespaces can fit — the cross features make it linear
+a = rng.choice(["u", "v"], n).astype(object)
+b = rng.choice(["u", "v"], n).astype(object)
+y_xor = (a == b).astype(float)
+tx = Table({"a": a, "b": b, "label": y_xor})
+fa = VowpalWabbitFeaturizer(input_cols=["a"], output_col="fa")
+fb = VowpalWabbitFeaturizer(input_cols=["b"], output_col="fb")
+crossed = VowpalWabbitInteractions(input_cols=["fa", "fb"],
+                                   output_col="features")
+xor_model = Pipeline([fa, fb, crossed,
+                      VowpalWabbitClassifier(num_passes=8)]).fit(tx)
+xor_acc = float((np.asarray(xor_model.transform(tx)["prediction"])
+                 == y_xor).mean())
+print("xor accuracy with interactions:", round(xor_acc, 3))
+assert xor_acc > 0.95
+
+# %% quantile regression (VW --quantile_tau)
+yr = age * 0.02 + rng.exponential(1.0, n)
+tr = Table({"age": age, "hours": hours, "label": yr})
+reg = Pipeline([
+    VowpalWabbitFeaturizer(input_cols=["age", "hours"], output_col="features"),
+    VowpalWabbitRegressor(
+        num_passes=30,
+        pass_through_args="--loss_function quantile --quantile_tau 0.9 -l 1.0"),
+]).fit(tr)
+q90 = np.asarray(reg.transform(tr)["prediction"])
+cover = float((yr <= q90).mean())
+print("fraction of labels under the q90 prediction:", round(cover, 3))
+assert 0.8 < cover < 0.99
+
+# %% contextual bandit: learn which action is cheapest per context.
+# Per-action features cross context x action (VW users add -q sa for
+# this); the model outputs an exploration distribution over actions.
+n_cb, n_actions = 1500, 3
+ctx = rng.integers(0, n_actions, n_cb)  # best action == context id
+shared = np.empty(n_cb, dtype=object)
+action_feats = np.empty(n_cb, dtype=object)
+for i in range(n_cb):
+    shared[i] = (np.array([100 + ctx[i]], np.uint32), np.ones(1, np.float32))
+    action_feats[i] = [
+        (np.array([200 + a, 1000 + 10 * ctx[i] + a], np.uint32),
+         np.ones(2, np.float32)) for a in range(n_actions)]
+chosen = rng.integers(1, n_actions + 1, n_cb)          # 1-based, logged uniform
+cost = (chosen - 1 != ctx).astype(np.float32)          # wrong action costs 1
+cb_table = Table({"shared": shared, "features": action_feats,
+                  "chosenAction": chosen, "label": cost,
+                  "probability": np.full(n_cb, 1 / n_actions)})
+cb = VowpalWabbitContextualBandit(num_passes=5).fit(cb_table)
+picked = np.array([int(np.argmax(p))
+                   for p in cb.transform(cb_table)["prediction"]])
+cb_acc = float((picked == ctx).mean())
+print("bandit picks the best action:", round(cb_acc, 3))
+assert cb_acc > 0.9

@@ -73,7 +73,11 @@ def _loss_grad(loss: str, quantile_tau: float):
     if loss == "hinge":
         return lambda p, y, w: jnp.where(y * p < 1.0, -y, 0.0) * w
     if loss == "quantile":
-        return lambda p, y, w: jnp.where(p >= y, quantile_tau, quantile_tau - 1.0) * w
+        # pinball: L = tau*(y-p) for p<y, (1-tau)*(p-y) for p>=y, so the
+        # fitted prediction sits above a tau-fraction of labels (VW's
+        # --quantile_tau convention)
+        return lambda p, y, w: jnp.where(p >= y, 1.0 - quantile_tau,
+                                         -quantile_tau) * w
     raise ValueError(f"unknown loss {loss!r}; use squared|logistic|hinge|quantile")
 
 

@@ -179,6 +179,24 @@ def test_vw_regressor_raw_scale_features(tabular):
     assert rmse < 0.5 * np.std(yr)  # --normalized handles unscaled features
 
 
+def test_vw_quantile_regression_coverage():
+    """--quantile_tau 0.9 predictions must sit ABOVE ~90% of labels (VW's
+    pinball convention); tau != 0.5 catches a sign-flipped gradient."""
+    rng = np.random.default_rng(11)
+    n = 4000
+    x = rng.uniform(0, 2, n)
+    yq = x + rng.exponential(1.0, n)
+    t = Table({"x": x, "label": yq})
+    feat = VowpalWabbitFeaturizer(input_cols=["x"], output_col="features")
+    for tau, lo, hi in [(0.9, 0.8, 0.99), (0.1, 0.01, 0.25)]:
+        m = Pipeline([feat, VowpalWabbitRegressor(
+            num_passes=20,
+            pass_through_args=f"--loss_function quantile --quantile_tau {tau}",
+        )]).fit(t)
+        cover = float((yq <= np.asarray(m.transform(t)["prediction"])).mean())
+        assert lo < cover < hi, (tau, cover)
+
+
 def test_vw_args_passthrough():
     assert parse_vw_args("--loss_function hinge -b 20 --passes 3 -l 0.1") == {
         "loss_function": "hinge", "num_bits": 20, "num_passes": 3,
