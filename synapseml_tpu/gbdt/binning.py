@@ -52,15 +52,21 @@ class BinMapper:
     def missing_bin(self) -> int:
         return self.max_bin
 
+    def sample_indices(self, n: int) -> Optional[np.ndarray]:
+        """Row indices ``fit`` would subsample for edge estimation (None =
+        all rows). The single source of truth — GBDTDataset's device path
+        pulls exactly these rows so both construction paths fit identical
+        edges."""
+        if n <= self.sample_cnt:
+            return None
+        rng = np.random.default_rng(self.seed)
+        return rng.choice(n, size=self.sample_cnt, replace=False)
+
     def fit(self, x: np.ndarray) -> "BinMapper":
         x = np.asarray(x, dtype=np.float64)
         n, d = x.shape
-        rng = np.random.default_rng(self.seed)
-        if n > self.sample_cnt:
-            idx = rng.choice(n, size=self.sample_cnt, replace=False)
-            sample = x[idx]
-        else:
-            sample = x
+        idx = self.sample_indices(n)
+        sample = x if idx is None else x[idx]
         edges: List[np.ndarray] = []
         self.cat_values = {}
         for j in range(d):

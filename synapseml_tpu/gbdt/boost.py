@@ -986,7 +986,7 @@ def _cached_step(obj_key, *, cfg, C, lr, boosting, d, cat_idx, ff, bf, bfreq,
                        n_eval=n_eval)
 
 
-def train(params: Dict[str, Any], x: np.ndarray, y: np.ndarray,
+def train(params: Dict[str, Any], x: np.ndarray, y: Optional[np.ndarray] = None,
           weight: Optional[np.ndarray] = None,
           eval_set: Optional[Sequence[Tuple[np.ndarray, np.ndarray]]] = None,
           group: Optional[np.ndarray] = None,
@@ -1017,11 +1017,40 @@ def train(params: Dict[str, Any], x: np.ndarray, y: np.ndarray,
         x = dataset.x
         if feature_names is None:
             feature_names = dataset.feature_names
-    x_f32_in = np.asarray(x).dtype == np.float32
-    x32 = np.asarray(x) if x_f32_in else None  # keep: skips a f64->f32 roundtrip
-    x = np.asarray(x, dtype=np.float64)
+    dev_data = dataset is not None and dataset.is_device
+    y_dev_in = y if isinstance(y, jnp.ndarray) else None
+    if y is None:
+        if dataset is None or dataset.label_np is None:
+            raise ValueError("y is required unless a GBDTDataset carries a "
+                             "label (GBDTDataset(x, label=y))")
+        y = dataset.label_np
+        # the dataset's cached device label serves host-built datasets too:
+        # one upload across a whole hyperparameter sweep (mesh fits need the
+        # sharded upload path instead)
+        y_dev_in = dataset.label_device() if mesh is None else None
+    if dev_data:
+        # device-resident dataset: the raw matrix never crosses to the host
+        if mesh is not None:
+            raise NotImplementedError(
+                "device-resident GBDTDataset under a mesh is not supported; "
+                "build the dataset from numpy for sharded training")
+        if init_booster is not None:
+            raise NotImplementedError(
+                "continued training from a device-resident GBDTDataset needs "
+                "raw-margin replay; pass numpy features for continuation")
+        if mapper is not None and mapper is not dataset.mapper:
+            raise ValueError("a device-resident GBDTDataset owns its binning; "
+                             "an overriding mapper would need the raw matrix "
+                             "on host")
+        x_f32_in, x32, x = True, None, None
+        n, d = dataset.x.shape
+    else:
+        x_f32_in = np.asarray(x).dtype == np.float32
+        x32 = np.asarray(x) if x_f32_in else None  # skips a f64->f32 roundtrip
+        x = np.asarray(x, dtype=np.float64)
+        n, d = x.shape
     y = np.asarray(y, dtype=np.float64)
-    n, d = x.shape
+    w_dev_in = weight if isinstance(weight, jnp.ndarray) else None
     w_np = np.ones(n) if weight is None else np.asarray(weight, dtype=np.float64)
 
     if obj_name == "lambdarank":
@@ -1097,7 +1126,9 @@ def train(params: Dict[str, Any], x: np.ndarray, y: np.ndarray,
         raw0 = raw0.reshape(n, C)
     else:
         base = np.atleast_1d(np.asarray(init_fn(y, w_np), dtype=np.float64))
-        raw0 = np.tile(base, (n, 1))
+        # host margin matrix only where it is actually consumed (mesh padding
+        # / sharded upload); the non-mesh path builds raw_d on device
+        raw0 = np.tile(base, (n, 1)) if mesh is not None else None
 
     boosting = p["boosting"]
     if boosting not in ("gbdt", "goss", "dart", "rf"):
@@ -1197,27 +1228,30 @@ def train(params: Dict[str, Any], x: np.ndarray, y: np.ndarray,
         y_d = dev_put(y.astype(np.float32), data_spec)
         w_d = dev_put(w_np.astype(np.float32), data_spec)
         raw_d = dev_put(raw0.astype(np.float32), data_spec)
-    elif reuse_dataset:
-        binned_d = dataset.device_binned()  # uploaded once, shared across fits
-        y_d = jnp.asarray(y, dtype=jnp.float32)
-        w_d = jnp.asarray(w_np, dtype=jnp.float32)
-        raw_d = jnp.asarray(raw0, dtype=jnp.float32)
-    elif use_device_bin:
-        from .device_predict import device_bin, pack_edges
-
-        edges, lens = pack_edges(mapper)
-        xb = jnp.asarray(np.ascontiguousarray(
-            x32 if x32 is not None else x.astype(np.float32)))
-        binned_d = device_bin(xb, jnp.asarray(edges), jnp.asarray(lens),
-                              mapper.missing_bin).astype(bin_dtype)
-        y_d = jnp.asarray(y, dtype=jnp.float32)
-        w_d = jnp.asarray(w_np, dtype=jnp.float32)
-        raw_d = jnp.asarray(raw0, dtype=jnp.float32)
     else:
-        binned_d = jnp.asarray(binned_np.astype(bin_dtype))
-        y_d = jnp.asarray(y, dtype=jnp.float32)
-        w_d = jnp.asarray(w_np, dtype=jnp.float32)
-        raw_d = jnp.asarray(raw0, dtype=jnp.float32)
+        if reuse_dataset:
+            binned_d = dataset.device_binned()  # uploaded once, reused
+        elif use_device_bin:
+            from .device_predict import device_bin, pack_edges
+
+            edges, lens = pack_edges(mapper)
+            xb = jnp.asarray(np.ascontiguousarray(
+                x32 if x32 is not None else x.astype(np.float32)))
+            binned_d = device_bin(xb, jnp.asarray(edges), jnp.asarray(lens),
+                                  mapper.missing_bin).astype(bin_dtype)
+        else:
+            binned_d = jnp.asarray(binned_np.astype(bin_dtype))
+        # y that arrived as a device array stays put; unit weights and the
+        # constant base margin are constructed ON device (at multi-million
+        # rows these uploads otherwise rival the feature matrix itself)
+        y_d = (y_dev_in.astype(jnp.float32) if y_dev_in is not None
+               else jnp.asarray(y, dtype=jnp.float32))
+        w_d = (jnp.ones(n, jnp.float32) if weight is None
+               else w_dev_in.astype(jnp.float32) if w_dev_in is not None
+               else jnp.asarray(w_np, dtype=jnp.float32))
+        raw_d = (jnp.zeros((n, C), jnp.float32) + jnp.asarray(base, jnp.float32)
+                 if init_booster is None
+                 else jnp.asarray(raw0, dtype=jnp.float32))
 
     # -- eval / early stopping state ----------------------------------------------
     if obj_name == "lambdarank":

@@ -10,6 +10,14 @@ multi-million-row scale. :class:`GBDTDataset` bins once, uploads once, and
 every ``train()`` that receives it reuses the device-resident buffer —
 hyperparameter sweeps and continued training stop paying the ingest cost
 per candidate.
+
+Device-resident construction: pass a ``jax.Array`` and the dataset never
+ships the raw matrix to the host — bin edges fit on a pulled row sample
+(bounded, BinMapper's own sample size) and the full matrix bins on device
+(``device_predict.device_bin``), so ingest cost is one small sample pull
+instead of an (n, d) float transfer in either direction. This is the
+TPU-first ingest path for data that is generated, loaded, or featurized on
+device (e.g. an upstream ONNX featurizer's output).
 """
 
 from __future__ import annotations
@@ -31,22 +39,81 @@ class GBDTDataset:
     semantics: the Dataset owns binning).
     """
 
-    def __init__(self, x: np.ndarray, max_bin: int = 255, seed: int = 0,
+    def __init__(self, x, *, label=None, max_bin: int = 255, seed: int = 0,
                  categorical_features: Optional[Sequence[int]] = None,
                  feature_names: Optional[List[str]] = None):
+        try:
+            import jax
+            is_device = isinstance(x, jax.Array)
+        except Exception:  # jax absent: host path only
+            is_device = False
+        self.is_device = is_device
+        # LightGBM Dataset semantics: the label may live on the dataset, so
+        # train(params, ds) needs no per-fit label transfer in either
+        # direction (host copy cached here for objective init / metrics,
+        # device copy cached for the training loop)
+        self._label_in = label
+        self._label_np = None
+        self._label_d = None
+        self.max_bin = int(max_bin)
+        self.feature_names = list(feature_names) if feature_names else None
+        cats = sorted(int(c) for c in (categorical_features or []))
+        if is_device:
+            if cats:
+                raise NotImplementedError(
+                    "categorical_features need the host value->code map; "
+                    "pass a numpy matrix for categorical data")
+            import jax.numpy as jnp
+
+            from .device_predict import device_bin, pack_edges
+
+            if x.ndim != 2:
+                raise ValueError(f"x must be (n, d), got shape {x.shape}")
+            x = x.astype(jnp.float32)
+            self.x = x
+            n = x.shape[0]
+            # fit edges on a bounded host-side sample — the SAME rows
+            # BinMapper.fit would subsample (sample_indices is the single
+            # source of truth); the full matrix never leaves the device
+            self.mapper = BinMapper(max_bin=self.max_bin, seed=int(seed))
+            idx = self.mapper.sample_indices(n)
+            if idx is not None:
+                sample = np.asarray(jnp.take(x, jnp.asarray(np.sort(idx)),
+                                             axis=0))
+            else:
+                sample = np.asarray(x)
+            self.mapper.fit(sample)
+            self.bin_dtype = bin_dtype(self.mapper.n_bins)
+            edges, lens = pack_edges(self.mapper)
+            self._device = device_bin(
+                x, jnp.asarray(edges), jnp.asarray(lens),
+                self.mapper.missing_bin).astype(self.bin_dtype)
+            self.binned_np = None  # materialized lazily (host_binned pulls)
+            return
         self.x = np.asarray(x, dtype=np.float64)
         if self.x.ndim != 2:
             raise ValueError(f"x must be (n, d), got shape {self.x.shape}")
-        self.max_bin = int(max_bin)
-        self.feature_names = list(feature_names) if feature_names else None
         self.mapper = BinMapper(
-            max_bin=self.max_bin, seed=int(seed),
-            categorical_features=sorted(int(c) for c in
-                                        (categorical_features or []))
+            max_bin=self.max_bin, seed=int(seed), categorical_features=cats
         ).fit(self.x)
         self.binned_np = self.mapper.transform(self.x)
         self.bin_dtype = bin_dtype(self.mapper.n_bins)
         self._device = None
+
+    @property
+    def label_np(self) -> Optional[np.ndarray]:
+        """Host float64 label (pulled once and cached for device labels)."""
+        if self._label_np is None and self._label_in is not None:
+            self._label_np = np.asarray(self._label_in, dtype=np.float64)
+        return self._label_np
+
+    def label_device(self):
+        """Device float32 label (uploaded/cast once and cached)."""
+        if self._label_d is None and self._label_in is not None:
+            import jax.numpy as jnp
+
+            self._label_d = jnp.asarray(self._label_in, jnp.float32)
+        return self._label_d
 
     @property
     def num_rows(self) -> int:

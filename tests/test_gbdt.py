@@ -232,6 +232,39 @@ def test_gbdt_dataset_reuse(data):
                                rtol=1e-5, atol=1e-6)
 
 
+def test_gbdt_dataset_device_resident(data):
+    """Device-array construction: raw matrix never pulled to host, binning on
+    device, trained model identical to the host path (n < sample_cnt so both
+    fit edges from the same rows)."""
+    import jax.numpy as jnp
+
+    from synapseml_tpu.gbdt import GBDTDataset
+
+    x, y, _, _ = data
+    xd = jnp.asarray(x[:2400], jnp.float32)
+    ds = GBDTDataset(xd, max_bin=63)
+    assert ds.is_device and ds.binned_np is None
+    params = {"objective": "binary", "num_iterations": 10, "num_leaves": 15,
+              "min_data_in_leaf": 5, "max_bin": 63}
+    b_dev = train(params, ds, jnp.asarray(y[:2400], jnp.float32))
+    b_host = train(params, x[:2400], y[:2400])
+    np.testing.assert_allclose(b_dev.predict(x[:2400]), b_host.predict(x[:2400]),
+                               rtol=1e-6, atol=1e-7)
+    # device binning agrees with the host mapper on the SAME f32 values (the
+    # documented exactness contract covers f32-representable inputs; binning
+    # the f64 originals could legitimately differ at bin edges)
+    from synapseml_tpu.gbdt.binning import BinMapper
+    np.testing.assert_array_equal(
+        np.asarray(ds.device_binned(), np.int32),
+        ds.mapper.transform(x[:2400].astype(np.float32)))
+    # guards: mesh / continuation / conflicting mapper need the host matrix
+    import pytest as _pt
+    with _pt.raises(NotImplementedError):
+        GBDTDataset(xd, max_bin=63, categorical_features=[0])
+    with _pt.raises(ValueError):
+        train(params, ds, y[:2400], mapper=BinMapper(max_bin=63).fit(x[:2400]))
+
+
 def test_gbdt_dataset_on_mesh(data, eight_device_mesh):
     from jax.sharding import Mesh
 
