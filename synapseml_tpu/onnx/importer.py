@@ -299,23 +299,33 @@ class OnnxFunction:
                 "accum_dtype": accum,
                 "subgraph_runner": subgraph_runner,
             }
+            # Constant folding: all-constant inputs => evaluate OUTSIDE the
+            # trace (omnistaging would otherwise stage jnp ops on concrete
+            # values into tracers) and pin outputs as numpy, so shape chains
+            # (Shape -> Gather/Mod/Add -> Reshape -> Slice.ends) stay static.
+            const_in = (all(v is None or _is_const(v) for v in inputs)
+                        and node.op_type != "Dropout")
             try:
-                out = fn(inputs, node.attrs(), ctx)
+                if const_in:
+                    import jax
+
+                    with jax.ensure_compile_time_eval():
+                        out = fn(inputs, node.attrs(), ctx)
+                else:
+                    out = fn(inputs, node.attrs(), ctx)
             except Exception as e:
                 raise type(e)(
                     f"while executing node {node.name or '?'} ({node.op_type}) "
                     f"inputs={node.input}: {e}"
                 ) from e
             outs = out if isinstance(out, tuple) else (out,)
-            # Constant folding: all-constant inputs => pin outputs as numpy so shape
-            # chains (Shape -> Gather -> Concat -> Reshape) stay static under tracing.
-            if all(v is None or _is_const(v) for v in inputs) and node.op_type != "Dropout":
+            if const_in:
                 pinned = []
                 for o in outs:
                     try:
                         pinned.append(np.asarray(o))
                     except Exception:
-                        pinned.append(o)  # traced despite const inputs (shouldn't happen)
+                        pinned.append(o)  # traced despite const inputs (subgraph capture)
                 outs = tuple(pinned)
             for name, val in zip(node.output, outs):
                 if name:

@@ -1,13 +1,17 @@
 """Third-party ONNX wire-format parsing.
 
 VERDICT r02 weak item 6: every tested graph came from ``onnx/builder.py``, so
-the codec was only ever parsing its own output. The environment is zero-egress
-and has no ``onnx``/``onnxscript`` package (torch.onnx.export needs them), so
-these bytes are HAND-ENCODED protobuf following the onnx.proto3 spec — an
-independent second encoder exercising real-exporter idioms the builder never
-emits: out-of-order fields, unknown fields (forward compatibility), packed
-varint dims, raw_data and float_data tensor variants, and default-omitted
-zero fields.
+the codec was only ever parsing its own output. Two independent producers are
+exercised here:
+
+1. HAND-ENCODED protobuf following the onnx.proto3 spec — a second encoder
+   emitting real-exporter idioms the builder never does: out-of-order fields,
+   unknown fields (forward compatibility), packed varint dims, raw_data and
+   float_data tensor variants, and default-omitted zero fields.
+2. REAL ``torch.onnx.export`` bytes (torch's C++ protobuf serializer). The
+   final ``_add_onnxscript_fn`` step needs the absent ``onnx`` package but is
+   a no-op for plain modules (it only splices onnxscript custom functions),
+   so it is patched out and the untouched exporter output flows through.
 """
 
 import struct
@@ -145,3 +149,87 @@ def test_handmade_attrs_and_unknown_fields():
     x = np.arange(6, dtype=np.float32).reshape(2, 3)
     out = np.asarray(fn({"X": x})["Y"])
     np.testing.assert_allclose(out, x.sum(axis=1, keepdims=True))
+
+
+# -- real torch.onnx exports (torch's own C++ protobuf serializer) --------------------
+
+def _torch_export(mod, example, opset=13):
+    import io
+    import warnings
+
+    torch = pytest.importorskip("torch")
+    try:
+        from torch.onnx._internal.torchscript_exporter import onnx_proto_utils
+    except ImportError:
+        pytest.skip("torchscript exporter internals moved; update the patch")
+    saved = onnx_proto_utils._add_onnxscript_fn
+    onnx_proto_utils._add_onnxscript_fn = lambda model_bytes, custom_opsets: \
+        model_bytes
+    try:
+        buf = io.BytesIO()
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            torch.onnx.export(mod.eval(), example, buf, opset_version=opset,
+                              input_names=["x"], output_names=["y"],
+                              dynamo=False)
+        return buf.getvalue()
+    finally:
+        onnx_proto_utils._add_onnxscript_fn = saved
+
+
+def test_torch_export_cnn():
+    """conv/bn/relu/maxpool/flatten/gemm as torch serializes them."""
+    torch = pytest.importorskip("torch")
+    nn = torch.nn
+    torch.manual_seed(0)
+
+    class M(nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.c = nn.Conv2d(3, 8, 3, padding=1)
+            self.b = nn.BatchNorm2d(8)
+            self.l = nn.Linear(8 * 4 * 4, 5)
+
+        def forward(self, x):
+            h = torch.relu(self.b(self.c(x)))
+            h = torch.nn.functional.max_pool2d(h, 2)
+            return self.l(h.flatten(1))
+
+    m = M()
+    m.b.running_mean.normal_()
+    m.b.running_var.uniform_(0.5, 2.0)
+    xin = torch.randn(2, 3, 8, 8)
+    fn = OnnxFunction(_torch_export(m, xin))
+    got = np.asarray(fn({"x": xin.numpy()})["y"])
+    ref = m.eval()(xin).detach().numpy()
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_torch_export_transformer_block():
+    """MultiheadAttention + LayerNorm + GELU: the messy real-export graph
+    (Shape/Gather/Unsqueeze/Concat shape arithmetic, Transpose/Reshape
+    attention plumbing, Where masks) that builder.py never emits."""
+    torch = pytest.importorskip("torch")
+    nn = torch.nn
+    torch.manual_seed(1)
+
+    class Block(nn.Module):
+        def __init__(self, d=32, h=4):
+            super().__init__()
+            self.attn = nn.MultiheadAttention(d, h, batch_first=True)
+            self.ln1 = nn.LayerNorm(d)
+            self.ln2 = nn.LayerNorm(d)
+            self.ff = nn.Sequential(nn.Linear(d, 64), nn.GELU(),
+                                    nn.Linear(64, d))
+
+        def forward(self, x):
+            a, _ = self.attn(x, x, x, need_weights=False)
+            x = self.ln1(x + a)
+            return self.ln2(x + self.ff(x))
+
+    blk = Block()
+    xb = torch.randn(2, 10, 32)
+    fn = OnnxFunction(_torch_export(blk, xb, opset=14))
+    got = np.asarray(fn({"x": xb.numpy()})["y"])
+    ref = blk.eval()(xb).detach().numpy()
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
