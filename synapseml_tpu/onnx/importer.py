@@ -45,7 +45,8 @@ class OnnxFunction:
     """
 
     def __init__(self, model: "ModelProto | bytes", dtype_policy: str = "float32",
-                 channels_last: bool = False):
+                 channels_last: bool = False,
+                 external_data_dir: "str | None" = None):
         import jax
 
         if isinstance(model, (bytes, bytearray, memoryview)):
@@ -65,8 +66,10 @@ class OnnxFunction:
         # 16.4 ms/fwd at batch 128) — hence default OFF; kept for backends
         # whose layout assignment is weaker.
         self.channels_last = bool(channels_last)
+        self._external_dir = external_data_dir
         self.constants: Dict[str, np.ndarray] = {
-            t.name: tensor_to_numpy(t) for t in self.graph.initializer
+            t.name: tensor_to_numpy(t, external_dir=external_data_dir)
+            for t in self.graph.initializer
         }
         init_names = set(self.constants)
         # Graph inputs that are not initializers are the real feeds.
@@ -206,7 +209,8 @@ class OnnxFunction:
                 node.input[0] in nhwc and len(node.output) == 1:
             inputs = [env[i] if i else None for i in node.input]
             ctx = {"op_type": op_type, "opset": self.opset, "n_outputs": 1,
-                   "accum_dtype": accum, "subgraph_runner": None}
+                   "accum_dtype": accum, "subgraph_runner": None,
+                   "external_dir": self._external_dir}
             env[node.output[0]] = OPS[op_type](inputs, node.attrs(), ctx)
             nhwc.add(node.output[0])
             return True
@@ -251,7 +255,8 @@ class OnnxFunction:
             else:
                 return False
             ctx = {"op_type": op_type, "opset": self.opset, "n_outputs": 1,
-                   "accum_dtype": accum, "subgraph_runner": None}
+                   "accum_dtype": accum, "subgraph_runner": None,
+                   "external_dir": self._external_dir}
             env[node.output[0]] = OPS[op_type](
                 [xa, xb] + [env[i] if i else None for i in node.input[2:]],
                 node.attrs(), ctx)
@@ -298,6 +303,7 @@ class OnnxFunction:
                 "n_outputs": len(node.output),
                 "accum_dtype": accum,
                 "subgraph_runner": subgraph_runner,
+                "external_dir": self._external_dir,
             }
             # Constant folding: all-constant inputs => evaluate OUTSIDE the
             # trace (omnistaging would otherwise stage jnp ops on concrete
@@ -335,10 +341,20 @@ class OnnxFunction:
 
 
 def load_model(path_or_bytes, dtype_policy: str = "float32") -> OnnxFunction:
-    """Load an ``.onnx`` file (path or bytes) into an executable function."""
+    """Load an ``.onnx`` file (path or bytes) into an executable function.
+
+    Loading by PATH resolves external-data tensors (``data_location=EXTERNAL``,
+    the real-exporter format past protobuf's 2GB limit) relative to the
+    model's directory; from raw bytes pass ``external_data_dir`` to
+    :class:`OnnxFunction` directly."""
     if isinstance(path_or_bytes, (bytes, bytearray, memoryview)):
         data = bytes(path_or_bytes)
+        ext_dir = None
     else:
+        import os
+
         with open(path_or_bytes, "rb") as f:
             data = f.read()
-    return OnnxFunction(data, dtype_policy=dtype_policy)
+        ext_dir = os.path.dirname(os.path.abspath(path_or_bytes))
+    return OnnxFunction(data, dtype_policy=dtype_policy,
+                        external_data_dir=ext_dir)

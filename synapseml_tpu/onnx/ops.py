@@ -705,7 +705,7 @@ def _constant_of_shape(inputs, attrs, ctx):
     t = attrs.get("value")
     if t is None:
         return np.zeros(shape, dtype=np.float32)
-    v = tensor_to_numpy(t)
+    v = tensor_to_numpy(t, external_dir=ctx.get("external_dir"))
     return np.full(shape, v.reshape(-1)[0], dtype=v.dtype)
 
 
@@ -714,7 +714,8 @@ def _constant(inputs, attrs, ctx):
     from .wire import tensor_to_numpy
 
     if attrs.get("value") is not None:
-        return tensor_to_numpy(attrs["value"])
+        return tensor_to_numpy(attrs["value"],
+                               external_dir=ctx.get("external_dir"))
     for k in ("value_float", "value_int"):
         if attrs.get(k) is not None:
             return np.asarray(attrs[k])

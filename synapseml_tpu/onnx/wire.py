@@ -195,6 +195,11 @@ class TensorProto:
     double_data: List[float] = dataclasses.field(default_factory=list)
     uint64_data: List[int] = dataclasses.field(default_factory=list)
     string_data: List[bytes] = dataclasses.field(default_factory=list)
+    # data_location 1 = EXTERNAL: bytes live in a side file described by the
+    # external_data entries (location / offset / length), the format real
+    # exporters use past protobuf's 2GB limit
+    data_location: int = 0
+    external_data: Dict[str, str] = dataclasses.field(default_factory=dict)
 
 
 @dataclasses.dataclass
@@ -307,10 +312,17 @@ def _parse_tensor(data: memoryview) -> TensorProto:
             else:
                 t.uint64_data.append(v)
         elif field == 13:
-            raise ValueError(
-                "ONNX tensor uses external_data, which is not supported; re-export the "
-                "model with embedded weights"
-            )
+            # StringStringEntryProto {key=1, value=2}
+            k = val = ""
+            for f2, wt2, v2 in _iter_fields(v):
+                if f2 == 1:
+                    k = bytes(v2).decode("utf-8")
+                elif f2 == 2:
+                    val = bytes(v2).decode("utf-8")
+            if k:
+                t.external_data[k] = val
+        elif field == 14:
+            t.data_location = v
     return t
 
 
@@ -454,9 +466,32 @@ def parse_model(data: bytes) -> ModelProto:
 # tensor <-> numpy
 # ---------------------------------------------------------------------------------
 
-def tensor_to_numpy(t: TensorProto) -> np.ndarray:
+def tensor_to_numpy(t: TensorProto, external_dir: Optional[str] = None) -> np.ndarray:
     np_dtype = DataType.to_numpy(t.data_type)
     shape = tuple(t.dims)
+    if t.data_location == 1:  # EXTERNAL
+        import os
+
+        if external_dir is None:
+            raise ValueError(
+                f"tensor {t.name!r} stores its data externally "
+                f"({t.external_data.get('location')!r}); load the model by "
+                "path (load_model) or pass external_data_dir")
+        loc = t.external_data.get("location", "")
+        if not loc:
+            raise ValueError(f"external tensor {t.name!r} has no 'location' "
+                             "entry in external_data")
+        base = os.path.realpath(external_dir)
+        path = os.path.realpath(os.path.join(base, loc))
+        if not path.startswith(base + os.sep):
+            raise ValueError(f"external data location {loc!r} escapes the "
+                             "model directory")
+        offset = int(t.external_data.get("offset", 0) or 0)
+        length = t.external_data.get("length")
+        with open(path, "rb") as f:
+            f.seek(offset)
+            buf = f.read(int(length)) if length else f.read()
+        return np.frombuffer(buf, dtype=np_dtype).reshape(shape)
     if t.raw_data:
         arr = np.frombuffer(t.raw_data, dtype=np_dtype)
     elif t.data_type == DataType.FLOAT and t.float_data:
@@ -509,6 +544,13 @@ def _ser_tensor(t: TensorProto) -> bytes:
         for x in t.int64_data:
             _write_varint(packed, x)
         _put_bytes(out, 7, bytes(packed))
+    for k, v in t.external_data.items():  # round-trip external references
+        entry = bytearray()
+        _put_str(entry, 1, k)
+        _put_str(entry, 2, v)
+        _put_bytes(out, 13, bytes(entry))
+    if t.data_location:
+        _put_varint_field(out, 14, t.data_location)
     return bytes(out)
 
 
