@@ -67,6 +67,11 @@ class OnnxFunction:
         # whose layout assignment is weaker.
         self.channels_last = bool(channels_last)
         self._external_dir = external_data_dir
+        # model-local functions: nodes whose (domain, op_type) matches expand
+        # to the function body (real exporters emit e.g. LayerNormalization
+        # or custom ops this way from IR 8 on)
+        self.functions = {(f.domain, f.name): f
+                          for f in getattr(model, "functions", [])}
         self.constants: Dict[str, np.ndarray] = {
             t.name: tensor_to_numpy(t, external_dir=external_data_dir)
             for t in self.graph.initializer
@@ -103,10 +108,16 @@ class OnnxFunction:
     # -- execution ---------------------------------------------------------------
 
     def _validate_ops(self, graph: GraphProto) -> None:
-        missing = sorted({n.op_type for n in graph.node if n.op_type not in OPS})
+        missing = sorted({n.op_type for n in graph.node
+                          if n.op_type not in OPS
+                          and (n.domain, n.op_type) not in self.functions})
+        for f in self.functions.values():
+            missing += [n.op_type for n in f.node
+                        if n.op_type not in OPS
+                        and (n.domain, n.op_type) not in self.functions]
         if missing:
             raise NotImplementedError(
-                f"ONNX ops not supported by the importer: {missing}. "
+                f"ONNX ops not supported by the importer: {sorted(set(missing))}. "
                 f"Supported: {len(OPS)} ops; extend synapseml_tpu/onnx/ops.py."
             )
 
@@ -265,9 +276,69 @@ class OnnxFunction:
 
         return False
 
-    def _run_graph(self, graph: GraphProto, env: Dict[str, Any]) -> None:
+    def _run_function(self, fdef, call, env: Dict[str, Any], to_std) -> None:
+        """Inline-expand a model-local function call: bind formal inputs,
+        substitute ``ref_attr_name`` attributes from the call site (falling
+        back to ``attribute_proto`` defaults, recursing into subgraph
+        attributes), run the body in a private scope under the function's
+        own opset, and export the formal outputs."""
+        import dataclasses
+
+        for i in call.input:
+            to_std(i)
+        call_attrs = {a.name: a for a in call.attribute}
+        for a in fdef.attribute_proto:  # declared params with defaults
+            call_attrs.setdefault(a.name, a)
+
+        def resolve_node(node):
+            changed = False
+            resolved = []
+            for a in node.attribute:
+                if a.ref_attr_name:
+                    src = call_attrs.get(a.ref_attr_name)
+                    if src is not None:
+                        resolved.append(dataclasses.replace(src, name=a.name))
+                    # absent optional attr: drop (ONNX function semantics)
+                    changed = True
+                elif a.g is not None or a.graphs:
+                    # refs are legal inside If/Loop bodies of the function
+                    a2 = dataclasses.replace(
+                        a,
+                        g=resolve_graph(a.g) if a.g is not None else None,
+                        graphs=[resolve_graph(g) for g in a.graphs])
+                    resolved.append(a2)
+                    changed = True
+                else:
+                    resolved.append(a)
+            return dataclasses.replace(node, attribute=resolved) if changed \
+                else node
+
+        def resolve_graph(g):
+            return dataclasses.replace(g, node=[resolve_node(n)
+                                                for n in g.node])
+
+        fenv: Dict[str, Any] = {"": None}
+        for formal in fdef.input:  # trailing optionals may be uncalled
+            fenv[formal] = None
+        for formal, actual in zip(fdef.input, call.input):
+            fenv[formal] = env[actual] if actual else None
+        body = GraphProto(
+            node=[resolve_node(n) for n in fdef.node],
+            output=[ValueInfo(name=o) for o in fdef.output],
+        )
+        # the body executes under ITS opset (pre-13 bodies keep e.g.
+        # attribute-form Unsqueeze even inside an opset-13+ model)
+        self._run_graph(body, fenv,
+                        opset=fdef.opset_imports.get("") or None)
+        for formal, actual in zip(fdef.output, call.output):
+            if actual:
+                env[actual] = fenv[formal]
+
+    def _run_graph(self, graph: GraphProto, env: Dict[str, Any],
+                   opset: "int | None" = None) -> None:
         import jax.numpy as jnp
 
+        opset = self.opset if opset is None else opset
         accum = jnp.float32 if self.dtype_policy == "bfloat16" else None
         nhwc: set = set()  # value names currently stored channels-last
 
@@ -281,13 +352,20 @@ class OnnxFunction:
                 for name in list(nhwc):  # subgraphs see standard layout
                     to_std(name)
                 sub_env = dict(env)
-                self._run_graph(sub, sub_env)
+                self._run_graph(sub, sub_env, opset=opset)
                 vals = [sub_env[o.name] for o in sub.output]
                 return vals[0] if len(vals) == 1 else tuple(vals)
 
             return run
 
         for node in graph.node:
+            fdef = self.functions.get((node.domain, node.op_type))
+            # builtins win only in the standard domains; a custom-domain
+            # function whose name collides with a builtin must still expand
+            if fdef is not None and (node.domain not in ("", "ai.onnx")
+                                     or node.op_type not in OPS):
+                self._run_function(fdef, node, env, to_std)
+                continue
             try:
                 fn = OPS[node.op_type]
             except KeyError:
@@ -299,7 +377,7 @@ class OnnxFunction:
             inputs = [env[i] if i else None for i in node.input]
             ctx = {
                 "op_type": node.op_type,
-                "opset": self.opset,
+                "opset": opset,
                 "n_outputs": len(node.output),
                 "accum_dtype": accum,
                 "subgraph_runner": subgraph_runner,

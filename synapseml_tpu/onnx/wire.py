@@ -215,6 +215,9 @@ class AttributeProto:
     ints: List[int] = dataclasses.field(default_factory=list)
     strings: List[bytes] = dataclasses.field(default_factory=list)
     graphs: List["GraphProto"] = dataclasses.field(default_factory=list)
+    # inside a FunctionProto body: take the value of the CALL node's
+    # attribute with this name instead of a literal
+    ref_attr_name: str = ""
 
     def value(self):
         return {
@@ -256,11 +259,29 @@ class GraphProto:
 
 
 @dataclasses.dataclass
+class FunctionProto:
+    """Model-local operator definition (ONNX functions, IR >= 8): nodes
+    calling (domain, name) expand to the body with inputs bound and
+    ``ref_attr_name`` attributes substituted from the call site."""
+
+    name: str = ""
+    domain: str = ""
+    input: List[str] = dataclasses.field(default_factory=list)
+    output: List[str] = dataclasses.field(default_factory=list)
+    attribute: List[str] = dataclasses.field(default_factory=list)  # param names
+    attribute_proto: List[AttributeProto] = dataclasses.field(
+        default_factory=list)  # params with defaults
+    node: List[NodeProto] = dataclasses.field(default_factory=list)
+    opset_imports: Dict[str, int] = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
 class ModelProto:
     ir_version: int = 8
     producer_name: str = ""
     graph: GraphProto = dataclasses.field(default_factory=GraphProto)
     opset_imports: Dict[str, int] = dataclasses.field(default_factory=dict)  # domain -> version
+    functions: List[FunctionProto] = dataclasses.field(default_factory=list)
 
     @property
     def opset_version(self) -> int:
@@ -357,6 +378,8 @@ def _parse_attribute(data: memoryview) -> AttributeProto:
             a.graphs.append(_parse_graph(v))
         elif field == 20:
             a.type = v
+        elif field == 21:
+            a.ref_attr_name = bytes(v).decode("utf-8")
     if a.type == 0:
         # Older exporters omit type; infer from which field is populated.
         if a.t is not None:
@@ -459,7 +482,37 @@ def parse_model(data: bytes) -> ModelProto:
                 elif f2 == 2:
                     version = v2
             m.opset_imports[domain] = version
+        elif field == 25:
+            m.functions.append(_parse_function(v))
     return m
+
+
+def _parse_function(data: memoryview) -> FunctionProto:
+    f = FunctionProto()
+    for field, wt, v in _iter_fields(data):
+        if field == 1:
+            f.name = bytes(v).decode("utf-8")
+        elif field == 4:
+            f.input.append(bytes(v).decode("utf-8"))
+        elif field == 5:
+            f.output.append(bytes(v).decode("utf-8"))
+        elif field == 6:
+            f.attribute.append(bytes(v).decode("utf-8"))
+        elif field == 7:
+            f.node.append(_parse_node(v))
+        elif field == 9:
+            domain, version = "", 0
+            for f2, _w2, v2 in _iter_fields(v):
+                if f2 == 1:
+                    domain = bytes(v2).decode("utf-8")
+                elif f2 == 2:
+                    version = v2
+            f.opset_imports[domain] = version
+        elif field == 10:
+            f.domain = bytes(v).decode("utf-8")
+        elif field == 11:
+            f.attribute_proto.append(_parse_attribute(v))
+    return f
 
 
 # ---------------------------------------------------------------------------------
