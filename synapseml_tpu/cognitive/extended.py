@@ -31,6 +31,7 @@ from ..io.clients import send_with_retries
 from ..io.http_schema import HTTPRequestData, HTTPResponseData
 from ..core.table import jsonable_value
 from .base import CognitiveServiceBase
+from .services import _TextAnalyticsBase, _VisionBase
 
 __all__ = [
     "AddressGeocoder", "ReverseAddressGeocoder",
@@ -87,11 +88,55 @@ class _AsyncReplyMixin:
                            f"{self.max_polling_retries} polls")
 
 
+class _PerRowAsyncBase(_AsyncReplyMixin, CognitiveServiceBase):
+    """Per-row request -> 202 -> poll Operation-Location (the reference's
+    ``BasicAsyncReply`` contract). Hooks: ``_poll_suffix`` appends to the
+    poll URL's query (e.g. maps re-signing), ``_unwrap`` post-processes the
+    parsed success body."""
+
+    _abstract_stage = True
+
+    def _poll_suffix(self, table: Table, row: int) -> str:
+        return ""
+
+    def _unwrap(self, parsed):
+        return parsed
+
+    def _transform(self, table: Table) -> Table:
+        n = table.num_rows
+        out = np.empty(n, dtype=object)
+        errors = np.empty(n, dtype=object)
+        for i in range(n):
+            req = self.build_request(table, i)
+            if req is None:
+                out[i] = errors[i] = None
+                continue
+            resp = send_with_retries(req, timeout=self.timeout,
+                                     backoffs_ms=self.backoffs)
+            try:
+                resp = self.await_result(
+                    resp, headers=req.headers,
+                    location_suffix=self._poll_suffix(table, i))
+            except (RuntimeError, TimeoutError) as e:
+                out[i] = None
+                errors[i] = {"statusCode": getattr(e, "status", None),
+                             "reason": str(e)}
+                continue
+            if 200 <= resp.status_code < 300:
+                out[i] = self._unwrap(self.parse_response(resp))
+                errors[i] = None
+            else:
+                out[i] = None
+                errors[i] = resp.to_dict()
+        return (table.with_column(self.output_col, out)
+                .with_column(self.error_col, errors))
+
+
 # ---------------------------------------------------------------------------------
 # Geospatial (reference geospatial/AzureMapsSearch.scala)
 # ---------------------------------------------------------------------------------
 
-class _AzureMapsBase(_AsyncReplyMixin, CognitiveServiceBase):
+class _AzureMapsBase(_PerRowAsyncBase):
     _abstract_stage = True
 
     api_version = Param("maps API version", str, default="1.0")
@@ -118,41 +163,18 @@ class _AzureMapsBase(_AsyncReplyMixin, CognitiveServiceBase):
         return HTTPRequestData(url=url, method=req.method,
                                headers=req.headers, entity=req.entity)
 
-    def _transform(self, table: Table) -> Table:
-        # batch endpoints answer 202; poll each row's batch to completion
-        n = table.num_rows
-        out = np.empty(n, dtype=object)
-        errors = np.empty(n, dtype=object)
-        for i in range(n):
-            req = self.build_request(table, i)
-            if req is None:
-                out[i] = errors[i] = None
-                continue
-            resp = send_with_retries(req, timeout=self.timeout,
-                                     backoffs_ms=self.backoffs)
-            try:
-                # maps auth rides the query string, on polls too (reference
-                # MapsAsyncReply re-signs the status GET)
-                key = self.svc_value(table, i, "subscription_key")
-                suffix = f"api-version={self.api_version}"
-                if key:
-                    suffix += f"&subscription-key={key}"
-                resp = self.await_result(resp, location_suffix=suffix)
-            except (RuntimeError, TimeoutError) as e:
-                out[i] = None
-                errors[i] = {"statusCode": getattr(e, "status", None),
-                             "reason": str(e)}
-                continue
-            if 200 <= resp.status_code < 300:
-                parsed = self.parse_response(resp)
-                out[i] = (parsed or {}).get("batchItems", parsed) \
-                    if isinstance(parsed, dict) else parsed
-                errors[i] = None
-            else:
-                out[i] = None
-                errors[i] = resp.to_dict()
-        return (table.with_column(self.output_col, out)
-                .with_column(self.error_col, errors))
+    def _poll_suffix(self, table, row):
+        # maps auth rides the query string, on polls too (reference
+        # MapsAsyncReply re-signs the status GET)
+        key = self.svc_value(table, row, "subscription_key")
+        suffix = f"api-version={self.api_version}"
+        if key:
+            suffix += f"&subscription-key={key}"
+        return suffix
+
+    def _unwrap(self, parsed):
+        return ((parsed or {}).get("batchItems", parsed)
+                if isinstance(parsed, dict) else parsed)
 
 
 class AddressGeocoder(_AzureMapsBase):
@@ -650,3 +672,68 @@ class SpeechToTextSDK(CognitiveServiceBase):
             errors[i] = err
         return (table.with_column(self.output_col, out)
                 .with_column(self.error_col, errors))
+
+
+# ---------------------------------------------------------------------------------
+# Async text analytics / vision (reference TextAnalytics.scala:482,
+# ComputerVision.scala:358 — BasicAsyncReply services)
+# ---------------------------------------------------------------------------------
+
+class TextAnalyze(_PerRowAsyncBase, _TextAnalyticsBase):
+    """Multi-task text analysis in one call (reference ``TextAnalyze``,
+    ``TextAnalytics.scala:482``): entity recognition / linking / PII / key
+    phrases / sentiment tasks over the async /analyze endpoint. Document
+    construction (text/language params) comes from ``_TextAnalyticsBase``."""
+
+    url_path = "/text/analytics/v3.1/analyze"
+    entity_recognition_tasks = Param("task params list", list, default=[{}])
+    entity_linking_tasks = Param("task params list", list, default=[])
+    entity_recognition_pii_tasks = Param("task params list", list, default=[])
+    key_phrase_extraction_tasks = Param("task params list", list, default=[])
+    sentiment_analysis_tasks = Param("task params list", list, default=[])
+
+    def build_payload(self, table: Table, row: int):
+        docs = _TextAnalyticsBase.build_payload(self, table, row)
+        if docs is None:
+            return None
+        tasks = {}
+        for key, plist in [
+            ("entityRecognitionTasks", self.entity_recognition_tasks),
+            ("entityLinkingTasks", self.entity_linking_tasks),
+            ("entityRecognitionPiiTasks", self.entity_recognition_pii_tasks),
+            ("keyPhraseExtractionTasks", self.key_phrase_extraction_tasks),
+            ("sentimentAnalysisTasks", self.sentiment_analysis_tasks),
+        ]:
+            if plist:
+                tasks[key] = [{"parameters": dict(p)} for p in plist]
+        return {"displayName": self.uid, "analysisInput": docs,
+                "tasks": tasks}
+
+
+class RecognizeText(_PerRowAsyncBase, _VisionBase):
+    """Async printed/handwritten text recognition (reference
+    ``RecognizeText``, ``ComputerVision.scala:358``). Image input handling
+    (url/bytes params, octet-stream header) comes from ``_VisionBase``."""
+
+    url_path = "/vision/v2.0/recognizeText"
+    mode = Param("'Printed' | 'Handwritten'", str, default="Printed",
+                 validator=ParamValidators.in_list(["Printed", "Handwritten"]))
+
+    def build_url(self, table, row):
+        return super().build_url(table, row) + f"?mode={self.mode}"
+
+
+class ConversationTranscription(SpeechToTextSDK):
+    """Multi-speaker conversation transcription (reference
+    ``ConversationTranscription``, ``SpeechToTextSDK.scala`` — the second
+    SDK-streaming class, adding speaker diarization over the same chunked
+    audio path)."""
+
+    url_path = "/speech/recognition/conversation/cognitiveservices/v1"
+
+    def build_url(self, table, row):
+        return super().build_url(table, row) + "&diarizationEnabled=true"
+
+
+__all__ += ["TextAnalyze", "RecognizeText", "ConversationTranscription",
+            "AsyncPollError"]

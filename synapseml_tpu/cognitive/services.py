@@ -17,6 +17,8 @@ import urllib.parse
 from typing import Any, Dict, Optional
 
 from ..core import Param, Table
+from ..core.params import ParamValidators
+from ..io.http_schema import HTTPRequestData
 from .base import CognitiveServiceBase
 
 __all__ = [
@@ -587,3 +589,134 @@ class AnalyzeInvoices(_FormRecognizerBase):
 
 class AnalyzeIDDocuments(_FormRecognizerBase):
     url_path = "/formrecognizer/v2.1/prebuilt/idDocument/analyze"
+
+
+# ---------------------------------------------------------------------------------
+# Legacy v2 text analytics (reference TextAnalytics.scala:224-276 — kept for
+# API parity with the reference's *V2 classes over the /v2.x endpoints)
+# ---------------------------------------------------------------------------------
+
+class TextSentimentV2(_TextAnalyticsBase):
+    url_path = "/text/analytics/v2.0/sentiment"
+
+
+class LanguageDetectorV2(_TextAnalyticsBase):
+    url_path = "/text/analytics/v2.0/languages"
+
+
+class EntityDetectorV2(_TextAnalyticsBase):
+    url_path = "/text/analytics/v2.0/entities"
+
+
+class NERV2(_TextAnalyticsBase):
+    url_path = "/text/analytics/v2.1/entities"
+
+
+class KeyPhraseExtractorV2(_TextAnalyticsBase):
+    url_path = "/text/analytics/v2.0/keyPhrases"
+
+
+# ---------------------------------------------------------------------------------
+# Remaining translator endpoints (reference TextTranslator.scala:414,487)
+# ---------------------------------------------------------------------------------
+
+class Detect(DetectLanguage):
+    """The reference's name for translator /detect (``TextTranslator.scala:414``)
+    — same endpoint and behavior as :class:`DetectLanguage`, registered under
+    both names for API parity."""
+
+
+class DictionaryExamples(_TranslatorBase):
+    """Contextual usage examples for (text, translation) pairs (reference
+    ``DictionaryExamples``, ``TextTranslator.scala:487``)."""
+
+    url_path = "/dictionary/examples"
+    from_language = Param("source language", object, default="en")
+    to_language = Param("target language", object, default="es")
+    text_and_translation = Param("(text, translation) pair or list of pairs "
+                                 "(static)", object, default=None)
+    text_and_translation_col = Param("(text, translation) pairs column", str,
+                                     default=None)
+
+    def _query(self, table, row):
+        q = super()._query(table, row)
+        q["from"] = self.from_language
+        q["to"] = self.to_language
+        return q
+
+    def build_payload(self, table: Table, row: int):
+        pairs = self.svc_value(table, row, "text_and_translation")
+        if pairs is None:
+            return None
+        if pairs and not isinstance(pairs[0], (list, tuple)):
+            pairs = [pairs]
+        return [{"Text": str(t), "Translation": str(tr)} for t, tr in pairs]
+
+
+# ---------------------------------------------------------------------------------
+# Form-recognizer custom models (reference FormRecognizer.scala:259-334)
+# ---------------------------------------------------------------------------------
+
+class ListCustomModels(CognitiveServiceBase):
+    """GET the trained custom models (reference ``ListCustomModels``,
+    ``FormRecognizer.scala:259``)."""
+
+    url_path = "/formrecognizer/v2.1/custom/models"
+    op = Param("'full' | 'summary'", str, default="full",
+               validator=ParamValidators.in_list(["full", "summary"]))
+
+    def build_request(self, table, row):
+        url = self.build_url(table, row) + f"?op={self.op}"
+        return HTTPRequestData(url=url, method="GET",
+                               headers=self.build_headers(table, row))
+
+
+class GetCustomModel(CognitiveServiceBase):
+    """GET one custom model's detail (reference ``GetCustomModel``,
+    ``FormRecognizer.scala:284``)."""
+
+    url_path = "/formrecognizer/v2.1/custom/models"
+    model_id = Param("custom model id (static)", object, default=None)
+    model_id_col = Param("custom model id column", str, default=None)
+    include_keys = Param("include extracted keys", bool, default=True)
+
+    def build_request(self, table, row):
+        mid = self.svc_value(table, row, "model_id")
+        if mid is None:
+            return None
+        url = (self.build_url(table, row) + f"/{mid}"
+               + ("?includeKeys=true" if self.include_keys else ""))
+        return HTTPRequestData(url=url, method="GET",
+                               headers=self.build_headers(table, row))
+
+
+class AnalyzeCustomModel(_FormRecognizerBase):
+    """Analyze a document with a trained custom model (reference
+    ``AnalyzeCustomModel``, ``FormRecognizer.scala:326``)."""
+
+    model_id = Param("custom model id (static)", object, default=None)
+    model_id_col = Param("custom model id column", str, default=None)
+    include_text_details = Param("include text lines/elements", bool,
+                                 default=False)
+
+    url_path = "/formrecognizer/v2.1/custom/models"
+
+    def build_request(self, table, row):
+        if self.svc_value(table, row, "model_id") is None:
+            return None  # skip like sibling GetCustomModel, not POST .../None
+        return super().build_request(table, row)
+
+    def build_url(self, table, row):
+        mid = self.svc_value(table, row, "model_id")
+        base = super().build_url(table, row)
+        url = f"{base}/{mid}/analyze"
+        if self.include_text_details:
+            url += "?includeTextDetails=true"
+        return url
+
+
+__all__ += [
+    "TextSentimentV2", "LanguageDetectorV2", "EntityDetectorV2", "NERV2",
+    "KeyPhraseExtractorV2", "Detect", "DictionaryExamples",
+    "ListCustomModels", "GetCustomModel", "AnalyzeCustomModel",
+]

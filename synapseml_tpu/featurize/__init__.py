@@ -1,7 +1,7 @@
 """Auto-featurization (reference ``core/.../featurize/``, SURVEY.md §2.3)."""
 
 from .stages import (
-    CleanMissingData, CleanMissingDataModel, CountSelector, CountSelectorModel,
+    CleanMissingData, FastVectorAssembler, CleanMissingDataModel, CountSelector, CountSelectorModel,
     DataConversion, Featurize, FeaturizeModel, IndexToValue, ValueIndexer,
     ValueIndexerModel,
 )
@@ -10,6 +10,6 @@ from .text import MultiNGram, PageSplitter, TextFeaturizer, TextFeaturizerModel
 __all__ = [
     "CleanMissingData", "CleanMissingDataModel", "ValueIndexer",
     "ValueIndexerModel", "IndexToValue", "DataConversion", "CountSelector",
-    "CountSelectorModel", "Featurize", "FeaturizeModel",
+    "CountSelectorModel", "Featurize", "FeaturizeModel", "FastVectorAssembler",
     "TextFeaturizer", "TextFeaturizerModel", "MultiNGram", "PageSplitter",
 ]

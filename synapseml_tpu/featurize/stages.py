@@ -255,3 +255,54 @@ class FeaturizeModel(Model):
                         out[r, murmur3_32(tok) % dim] += 1.0
                 parts.append(out)
         return table.with_column(self.output_col, np.concatenate(parts, axis=1))
+
+
+class FastVectorAssembler(Transformer):
+    """Assemble numeric/vector columns into one vector, categoricals first.
+
+    Reference ``org/apache/spark/ml/feature/FastVectorAssembler.scala:23``:
+    categorical columns must precede all others (downstream learners map
+    categorical slots by index), and only categorical slot metadata is
+    propagated — spurious numeric attributes are dropped for speed. Here a
+    column is categorical when its Table metadata carries ``categorical:
+    True``; the output column's ``slot_names`` lists the categorical slots."""
+
+    input_cols = Param("columns to assemble", list, default=[])
+    output_col = Param("assembled vector column", str, default="features")
+
+    def _transform(self, table: Table) -> Table:
+        if not self.input_cols:
+            raise ValueError(
+                f"FastVectorAssembler({self.uid}): input_cols is empty")
+        self._validate_input(table, *self.input_cols)
+        parts: List[np.ndarray] = []
+        slot_names: List[str] = []
+        seen_numeric = False
+        for c in self.input_cols:
+            col = table[c]
+            if col.dtype == object:
+                raise ValueError(
+                    f"FastVectorAssembler({self.uid}): column {c!r} is not "
+                    "numeric/vector (featurize or index it first)")
+            block = (np.asarray(col, np.float64).reshape(table.num_rows, -1))
+            is_cat = bool(table.meta.get(c, {}).get("categorical"))
+            if is_cat:
+                if seen_numeric:
+                    raise ValueError(
+                        "Categorical columns must precede all others, "
+                        f"column out of order: {c}")
+                names = table.meta.get(c, {}).get("slot_names")
+                if names is None:
+                    names = ([c] if block.shape[1] == 1 else
+                             [f"{c}_{i}" for i in range(block.shape[1])])
+                slot_names.extend(names)
+            else:
+                seen_numeric = True
+            parts.append(block)
+        out = np.concatenate(parts, axis=1)
+        meta = {"slot_names": slot_names + [""] * (out.shape[1] - len(slot_names)),
+                "num_categorical": len(slot_names)} if slot_names else None
+        return table.with_column(self.output_col, out, meta=meta)
+
+
+__all__.append("FastVectorAssembler")

@@ -1,6 +1,7 @@
 """Image ops and stages (reference: ``opencv`` module + ``core/.../image/``)."""
 
 from . import ops
-from .stages import ImageSetAugmenter, ImageTransformer, ResizeImageTransformer, UnrollImage
+from .stages import (ImageSetAugmenter, ImageTransformer,
+                     ResizeImageTransformer, UnrollBinaryImage, UnrollImage)
 
-__all__ = ["ops", "ImageTransformer", "ResizeImageTransformer", "UnrollImage", "ImageSetAugmenter"]
+__all__ = ["ops", "ImageTransformer", "ResizeImageTransformer", "UnrollImage", "UnrollBinaryImage", "ImageSetAugmenter"]

@@ -184,3 +184,60 @@ class ImageSetAugmenter(Transformer):
         if self.output_col != self.input_col:
             tables = [t.drop(self.input_col) if self.input_col in t else t for t in tables]
         return concat_tables(tables)
+
+
+class UnrollBinaryImage(Transformer):
+    """Decode a binary (bytes) image column and unroll to a CHW vector.
+
+    Reference ``core/.../image/UnrollImage.scala:187`` (``UnrollBinaryImage``):
+    same unroll order as :class:`UnrollImage`, but fed raw encoded bytes;
+    optional ``width``/``height`` resize to a uniform target (required when
+    source sizes vary). Undecodable/None rows yield None."""
+
+    input_col = Param("binary image column", str, default="image")
+    output_col = Param("output vector column", str, default="features")
+    width = Param("target width (resize when set)", int, default=None)
+    height = Param("target height (resize when set)", int, default=None)
+    n_channels = Param("target channel count", int, default=None)
+
+    def _transform(self, table: Table) -> Table:
+        from ..io.binary import decode_image
+
+        self._validate_input(table, self.input_col)
+        col = table[self.input_col]
+        n = table.num_rows
+        decoded: List[Optional[np.ndarray]] = []
+        for r in range(n):
+            v = col[r]
+            if v is None:
+                decoded.append(None)
+                continue
+            try:
+                img = decode_image(bytes(v))
+            except Exception:
+                decoded.append(None)
+                continue
+            if self.width and self.height:
+                img = np.asarray(iops.resize(
+                    np.asarray(img, np.float32)[None], self.height,
+                    self.width))[0]
+            if self.n_channels:
+                c = img.shape[-1]
+                if c == 1 and self.n_channels == 3:
+                    img = np.repeat(img, 3, axis=-1)
+                elif c != self.n_channels:
+                    img = img[..., : self.n_channels]
+            decoded.append(np.asarray(img, np.float32))
+        shapes = {d.shape for d in decoded if d is not None}
+        if len(shapes) > 1:
+            raise ValueError(
+                f"UnrollBinaryImage({self.uid}): decoded sizes differ "
+                f"({sorted(shapes)}); set width/height to resize")
+        out = np.empty(n, dtype=object)
+        for r, img in enumerate(decoded):
+            if img is not None:
+                out[r] = np.transpose(img, (2, 0, 1)).ravel().astype(np.float32)
+        return table.with_column(self.output_col, out)
+
+
+__all__.append("UnrollBinaryImage")

@@ -23,12 +23,14 @@ from .estimators import (
     VowpalWabbitRegressionModel,
     VowpalWabbitRegressor,
 )
-from .featurizer import VowpalWabbitFeaturizer, VowpalWabbitInteractions
+from .featurizer import (VectorZipper, VowpalWabbitFeaturizer,
+                         VowpalWabbitInteractions)
 from .learner import LinearLearnerState, train_linear
 
 __all__ = [
     "VowpalWabbitFeaturizer",
     "VowpalWabbitInteractions",
+    "VectorZipper",
     "VowpalWabbitClassifier",
     "VowpalWabbitClassificationModel",
     "VowpalWabbitRegressor",

@@ -179,3 +179,34 @@ class VowpalWabbitInteractions(Transformer):
                 idx, val = uniq, sums
             out[r] = (idx.astype(np.uint32), val.astype(np.float32))
         return table.with_column(self.output_col, out, meta=sparse_meta())
+
+
+class VectorZipper(Transformer):
+    """Combine one or more input columns into a per-row sequence column.
+
+    Reference ``vw/.../VectorZipper.scala:21-41``: ``array(inputCols...)`` —
+    used to build the per-action feature sequences the contextual bandit
+    consumes. All input columns must share a kind (the reference asserts
+    matching DataTypes)."""
+
+    input_cols = Param("columns to zip (1+)", list, default=[])
+    output_col = Param("output sequence column", str, default="output")
+
+    def _transform(self, table: Table) -> Table:
+        if not self.input_cols:
+            raise ValueError(f"VectorZipper({self.uid}): input_cols is empty")
+        self._validate_input(table, *self.input_cols)
+        cols = [table[c] for c in self.input_cols]
+        kinds = {(c.dtype == object, c.ndim) for c in cols}
+        if len(kinds) > 1:
+            raise ValueError(
+                f"VectorZipper({self.uid}): input columns must share a type; "
+                f"got {[str(table[c].dtype) for c in self.input_cols]}")
+        n = table.num_rows
+        out = np.empty(n, dtype=object)
+        for r in range(n):
+            out[r] = [c[r] for c in cols]
+        return table.with_column(self.output_col, out)
+
+
+__all__.append("VectorZipper")

@@ -191,3 +191,55 @@ def test_missing_column_for_service_param(stub):
     ts = TextSentiment(subscription_key="k", url=stub, text_col="nope")
     with pytest.raises(ValueError, match="nope"):
         ts.transform(t)
+
+
+def test_cognitive_tail_request_shapes():
+    """URL/method/payload contracts for the v2 text-analytics, translator
+    detect/dictionary-examples, and form custom-model additions (reference
+    TextAnalytics.scala:224-276, TextTranslator.scala:414,487,
+    FormRecognizer.scala:259-334)."""
+    import json as _json
+
+    from synapseml_tpu.cognitive import (AnalyzeCustomModel, Detect,
+                                         DictionaryExamples, GetCustomModel,
+                                         KeyPhraseExtractorV2,
+                                         LanguageDetectorV2, ListCustomModels,
+                                         NERV2, TextSentimentV2)
+
+    t = Table({"text": np.array(["bonjour"], dtype=object),
+               "mid": np.array(["model-7"], dtype=object)})
+
+    for cls, path in [(TextSentimentV2, "/text/analytics/v2.0/sentiment"),
+                      (LanguageDetectorV2, "/text/analytics/v2.0/languages"),
+                      (NERV2, "/text/analytics/v2.1/entities"),
+                      (KeyPhraseExtractorV2, "/text/analytics/v2.0/keyPhrases")]:
+        req = cls(subscription_key="k", location="eastus").build_request(t, 0)
+        assert path in req.url and req.method == "POST"
+        assert _json.loads(req.entity)["documents"][0]["text"] == "bonjour"
+
+    req = Detect(subscription_key="k").build_request(t, 0)
+    assert "/detect?" in req.url and "api-version=3.0" in req.url
+    assert _json.loads(req.entity) == [{"Text": "bonjour"}]
+
+    de = DictionaryExamples(subscription_key="k", from_language="fr",
+                            to_language="en",
+                            text_and_translation=("bonjour", "hello"))
+    req = de.build_request(t, 0)
+    assert "from=fr" in req.url and "to=en" in req.url
+    assert _json.loads(req.entity) == [{"Text": "bonjour",
+                                        "Translation": "hello"}]
+
+    req = ListCustomModels(subscription_key="k", location="eastus",
+                           op="summary").build_request(t, 0)
+    assert req.method == "GET" and req.url.endswith("custom/models?op=summary")
+
+    req = GetCustomModel(subscription_key="k", location="eastus",
+                         model_id_col="mid").build_request(t, 0)
+    assert req.method == "GET" and "custom/models/model-7" in req.url
+    assert "includeKeys=true" in req.url
+
+    req = AnalyzeCustomModel(subscription_key="k", location="eastus",
+                             model_id="m1", include_text_details=True,
+                             image_url="http://x/y.png").build_request(t, 0)
+    assert "custom/models/m1/analyze" in req.url
+    assert "includeTextDetails=true" in req.url and req.method == "POST"

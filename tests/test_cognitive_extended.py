@@ -232,3 +232,50 @@ def test_async_poll_timeout_reports_error(stub):
                           address_col="addr")
     out = geo.transform(t)  # /neverdone answers 200 {'ok': True} directly
     assert out["output"][0] == {"ok": True}
+
+
+def test_text_analyze_async_tasks(stub):
+    from synapseml_tpu.cognitive import TextAnalyze
+
+    t = Table({"text": np.array(["hello world"], dtype=object)})
+    ta = TextAnalyze(url=stub + "/asyncsubmit", subscription_key="K",
+                     polling_delay=0.01,
+                     key_phrase_extraction_tasks=[{"model-version": "latest"}])
+    out = ta.transform(t)
+    assert out["errors"][0] is None and out["output"][0] is not None
+    submit = next(r for r in RECORDED if r["path"].startswith("/asyncsubmit"))
+    body = json.loads(submit["body"])
+    assert body["analysisInput"]["documents"][0]["text"] == "hello world"
+    assert "entityRecognitionTasks" in body["tasks"]
+    assert "keyPhraseExtractionTasks" in body["tasks"]
+    assert submit["headers"].get("Ocp-Apim-Subscription-Key") == "K"
+
+
+def test_recognize_text_async_mode(stub):
+    from synapseml_tpu.cognitive import RecognizeText
+
+    t = Table({"url": np.array(["http://img/x.png"], dtype=object)})
+    rt = RecognizeText(url=stub + "/asyncsubmit", subscription_key="K",
+                       image_url_col="url", mode="Handwritten",
+                       polling_delay=0.01)
+    out = rt.transform(t)
+    assert out["errors"][0] is None
+    submit = next(r for r in RECORDED if r["path"].startswith("/asyncsubmit"))
+    assert "mode=Handwritten" in submit["path"]
+    assert json.loads(submit["body"])["url"] == "http://img/x.png"
+
+
+def test_conversation_transcription_streams(stub):
+    from synapseml_tpu.cognitive import ConversationTranscription
+
+    audio = bytes(range(256)) * 8
+    t = Table({"audio": np.array([audio], dtype=object)})
+    ct = ConversationTranscription(url=stub + "/speech", subscription_key="K",
+                                   chunk_size=1024)
+    out = ct.transform(t)
+    assert out["errors"][0] is None
+    # diarization rides the query string; chunks merged in order
+    sp = [r for r in RECORDED if r["path"].startswith("/speech")]
+    assert all("diarizationEnabled=true" in r["path"] for r in sp)
+    assert len(sp) == 2  # 2048 bytes / 1024
+    assert out["output"][0]["DisplayText"] == "part0 part1"

@@ -138,3 +138,23 @@ def test_plot_functions_render(tmp_path):
     ax2 = plot_roc(t, "y", "score")
     assert ax2.get_xlabel() == "False Positive Rate"
     plt.close("all")
+
+
+def test_unroll_binary_image(image_dir):
+    """Reference UnrollBinaryImage (UnrollImage.scala:187): bytes -> decoded
+    -> CHW vector; resize unifies ragged sources; bad bytes yield None."""
+    from synapseml_tpu.image import UnrollBinaryImage
+    from synapseml_tpu.io.binary import read_binary_files
+
+    t = read_binary_files(str(image_dir), pattern="*.png")
+    t = t.with_column("image", t["bytes"])
+    out = UnrollBinaryImage(width=8, height=8, n_channels=3,
+                            output_col="vec").transform(t)
+    vecs = [v for v in out["vec"] if v is not None]
+    assert vecs and all(v.shape == (8 * 8 * 3,) for v in vecs)
+    # undecodable row -> None, decodable rows unaffected
+    import numpy as _np
+    bad = t.with_column("image", _np.array([b"not-an-image"] * t.num_rows,
+                                           dtype=object))
+    out_bad = UnrollBinaryImage(output_col="vec").transform(bad)
+    assert all(v is None for v in out_bad["vec"])

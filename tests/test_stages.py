@@ -198,3 +198,23 @@ def test_lambda_save_load_drops_callable(tmp_path):
     loaded = load_stage(p)
     out = loaded.transform(t)  # warns, passes through
     assert "y" not in out
+
+
+def test_fast_vector_assembler():
+    from synapseml_tpu.featurize import FastVectorAssembler
+
+    t = Table({"cat": np.array([0.0, 1.0, 2.0]),
+               "num": np.array([0.5, 1.5, 2.5]),
+               "vec": np.arange(6, dtype=np.float64).reshape(3, 2)})
+    t = t.with_column("cat", t["cat"],
+                      meta={"categorical": True, "slot_names": ["cat"]})
+    out = FastVectorAssembler(input_cols=["cat", "num", "vec"],
+                              output_col="f").transform(t)
+    np.testing.assert_allclose(out["f"][1], [1.0, 1.5, 2.0, 3.0])
+    meta = out.meta["f"]
+    assert meta["num_categorical"] == 1 and meta["slot_names"][0] == "cat"
+    # categorical after numeric: the reference's ordering error
+    t2 = t.with_column("late", t["cat"], meta={"categorical": True})
+    import pytest as _pt
+    with _pt.raises(ValueError, match="out of order"):
+        FastVectorAssembler(input_cols=["num", "late"]).transform(t2)

@@ -242,3 +242,16 @@ def test_vw_additional_features(tabular):
                                  num_passes=5)
     m = clf.fit(tt)
     assert _auc(y, m.transform(tt)["probability"][:, 1].astype(float)) > 0.9
+
+
+def test_vector_zipper():
+    from synapseml_tpu.vw import VectorZipper
+
+    t = Table({"a": np.array([1.0, 2.0]), "b": np.array([3.0, 4.0])})
+    out = VectorZipper(input_cols=["a", "b"], output_col="z").transform(t)
+    assert out["z"][0] == [1.0, 3.0] and out["z"][1] == [2.0, 4.0]
+    t2 = Table({"a": np.array([1.0]), "s": np.array(["x"], dtype=object)})
+    with pytest.raises(ValueError, match="share a type"):
+        VectorZipper(input_cols=["a", "s"]).transform(t2)
+    with pytest.raises(ValueError, match="empty"):
+        VectorZipper().transform(t)
