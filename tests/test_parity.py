@@ -1,0 +1,29 @@
+"""Reference-parity ratchet: every stage-like class in the reference's main
+sources must map to a registered stage, a documented redesign, or internal
+plumbing — the executable form of VERDICT's component-inventory check."""
+
+import os
+
+import pytest
+
+REF = "/root/reference"
+
+
+@pytest.mark.skipif(not os.path.isdir(REF), reason="reference not mounted")
+def test_no_reference_stage_unaccounted():
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))), "tools"))
+    import parity_audit
+
+    from synapseml_tpu.codegen.generate import import_all_stage_modules
+    import_all_stage_modules()
+    from synapseml_tpu.core.stage import STAGE_REGISTRY
+
+    ref = parity_audit.collect_reference()
+    assert len(ref) > 150  # the scan itself must keep finding the surface
+    missing = [n for n in ref
+               if n not in parity_audit.INTERNAL
+               and n not in parity_audit.ALIASES
+               and n not in STAGE_REGISTRY]
+    assert not missing, f"unaccounted reference stages: {sorted(missing)}"
