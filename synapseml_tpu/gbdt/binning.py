@@ -32,25 +32,47 @@ class BinMapper:
     """
 
     def __init__(self, max_bin: int = 255, sample_cnt: int = 200_000, seed: int = 0,
-                 categorical_features: Optional[List[int]] = None):
+                 categorical_features: Optional[List[int]] = None,
+                 max_bin_by_feature: Optional[List[int]] = None):
         if max_bin < 2:
             raise ValueError(f"max_bin must be >= 2, got {max_bin}")
         self.max_bin = int(max_bin)
         self.sample_cnt = int(sample_cnt)
         self.seed = seed
         self.categorical_features = sorted(set(categorical_features or []))
+        # per-feature override of max_bin (LightGBM maxBinByFeature); entries
+        # <= 0 fall back to max_bin
+        self.max_bin_by_feature = ([int(b) for b in max_bin_by_feature]
+                                   if max_bin_by_feature else None)
+        if self.max_bin_by_feature and any(
+                0 < b < 2 for b in self.max_bin_by_feature):
+            raise ValueError("max_bin_by_feature entries must be >= 2 (or <= 0 "
+                             "for the max_bin default)")
         self.upper_edges: Optional[List[np.ndarray]] = None  # per-feature ascending edges
         self.cat_values: dict = {}  # feature -> ascending array of category values
         self.n_features: Optional[int] = None
 
+    def _feature_max_bin(self, j: int) -> int:
+        mbf = self.max_bin_by_feature
+        if mbf and j < len(mbf) and mbf[j] > 0:
+            return mbf[j]
+        return self.max_bin
+
+    @property
+    def _effective_max_bin(self) -> int:
+        if self.max_bin_by_feature:
+            return max(self.max_bin, *[b for b in self.max_bin_by_feature
+                                       if b > 0] or [self.max_bin])
+        return self.max_bin
+
     @property
     def n_bins(self) -> int:
         """Total bins per feature including the reserved missing bin."""
-        return self.max_bin + 1
+        return self._effective_max_bin + 1
 
     @property
     def missing_bin(self) -> int:
-        return self.max_bin
+        return self._effective_max_bin
 
     def sample_indices(self, n: int) -> Optional[np.ndarray]:
         """Row indices ``fit`` would subsample for edge estimation (None =
@@ -65,6 +87,12 @@ class BinMapper:
     def fit(self, x: np.ndarray) -> "BinMapper":
         x = np.asarray(x, dtype=np.float64)
         n, d = x.shape
+        if self.max_bin_by_feature and len(self.max_bin_by_feature) != d:
+            # a typo'd list would silently inflate n_bins (and every
+            # histogram buffer) via _effective_max_bin
+            raise ValueError(
+                f"max_bin_by_feature has {len(self.max_bin_by_feature)} "
+                f"entries for {d} features")
         idx = self.sample_indices(n)
         sample = x if idx is None else x[idx]
         edges: List[np.ndarray] = []
@@ -74,8 +102,9 @@ class BinMapper:
             col = col[np.isfinite(col)]
             if j in self.categorical_features:
                 vals, counts = np.unique(col, return_counts=True)
-                if len(vals) > self.max_bin:  # keep the most frequent categories
-                    keep = np.argsort(-counts, kind="stable")[: self.max_bin]
+                fmb = self._feature_max_bin(j)
+                if len(vals) > fmb:  # keep the most frequent categories
+                    keep = np.argsort(-counts, kind="stable")[: fmb]
                     vals = vals[keep]
                 self.cat_values[j] = np.sort(vals)
                 edges.append(np.array([np.inf]))  # placeholder, unused for cat
@@ -84,14 +113,15 @@ class BinMapper:
                 edges.append(np.array([np.inf]))
                 continue
             uniq = np.unique(col)
-            if len(uniq) <= self.max_bin:
+            fmb = self._feature_max_bin(j)
+            if len(uniq) <= fmb:
                 # exact: one bin per distinct value; upper edge = midpoint to next
                 ue = np.empty(len(uniq))
                 ue[:-1] = (uniq[:-1] + uniq[1:]) / 2
                 ue[-1] = np.inf
                 edges.append(ue)
             else:
-                qs = np.quantile(col, np.linspace(0, 1, self.max_bin + 1)[1:-1])
+                qs = np.quantile(col, np.linspace(0, 1, fmb + 1)[1:-1])
                 ue = np.unique(qs)
                 edges.append(np.concatenate([ue, [np.inf]]))
         self.upper_edges = edges
@@ -141,6 +171,7 @@ class BinMapper:
     def to_dict(self) -> dict:
         return {
             "max_bin": self.max_bin,
+            "max_bin_by_feature": self.max_bin_by_feature,
             "sample_cnt": self.sample_cnt,
             "seed": self.seed,
             "upper_edges": [e.tolist() for e in (self.upper_edges or [])],
@@ -151,7 +182,8 @@ class BinMapper:
     @staticmethod
     def from_dict(d: dict) -> "BinMapper":
         m = BinMapper(max_bin=d["max_bin"], sample_cnt=d["sample_cnt"], seed=d["seed"],
-                      categorical_features=d.get("categorical_features"))
+                      categorical_features=d.get("categorical_features"),
+                      max_bin_by_feature=d.get("max_bin_by_feature"))
         if d.get("upper_edges"):
             m.upper_edges = [np.asarray(e) for e in d["upper_edges"]]
             m.n_features = len(m.upper_edges)

@@ -762,8 +762,12 @@ _DEFAULTS = dict(
     max_bin=255, lambda_l1=0.0, lambda_l2=0.0, min_data_in_leaf=20,
     min_sum_hessian_in_leaf=1e-3, min_gain_to_split=0.0, feature_fraction=1.0,
     bagging_fraction=1.0, bagging_freq=0, boosting="gbdt",
+    max_depth=-1, max_delta_step=0.0, boost_from_average=True,
+    pos_bagging_fraction=1.0, neg_bagging_fraction=1.0,  # binary class-aware bag
+    bin_sample_count=200_000, max_bin_by_feature=None,
     top_rate=0.2, other_rate=0.1,         # goss
     drop_rate=0.1, max_drop=50, skip_drop=0.5,  # dart
+    uniform_drop=False, xgboost_dart_mode=False,
     categorical_feature=None, cat_smooth=10.0, max_cat_threshold=32,
     parallelism="data_parallel", top_k=20,
     num_class=1, seed=0, bagging_seed=3, metric=None, early_stopping_round=0,
@@ -794,6 +798,7 @@ def _resolve_objective(params):
 
 def _build_step(grad_fn=None, fobj=None, *, cfg, C, lr, boosting, d, cat_idx,
                 ff, bf, bfreq, use_goss, top_rate, other_rate, mesh, axis,
+                pos_bf=1.0, neg_bf=1.0,
                 scan_iters=None, eval_metric=None, n_eval=0):
     """Build the jitted per-iteration training step.
 
@@ -817,7 +822,7 @@ def _build_step(grad_fn=None, fobj=None, *, cfg, C, lr, boosting, d, cat_idx,
         cat_mask_np = np.zeros(d, np.float32)
         cat_mask_np[list(cat_idx)] = 1.0
 
-    def make_weights(key, grad_abs, n_rows):
+    def make_weights(key, grad_abs, yv, n_rows):
         """Bagging/GOSS row mask. Starts from ones: sample weights already live in
         the objective's grad/hess (multiplying again would square them)."""
         ones = jnp.ones(n_rows, jnp.float32)
@@ -828,6 +833,12 @@ def _build_step(grad_fn=None, fobj=None, *, cfg, C, lr, boosting, d, cat_idx,
                 other_rate / max(1e-12, 1.0 - top_rate))
             amp = (1.0 - top_rate) / max(other_rate, 1e-12)
             return jnp.where(is_top, 1.0, jnp.where(keep_small, amp, 0.0))
+        if (pos_bf < 1.0 or neg_bf < 1.0) and bfreq > 0:
+            # class-aware bagging (LightGBM pos/negBaggingFraction): sample
+            # positives and negatives independently
+            frac = jnp.where(yv > 0, pos_bf, neg_bf)
+            keep = jax.random.uniform(key, grad_abs.shape) < frac
+            return keep.astype(jnp.float32)
         if bf < 1.0 and bfreq > 0:
             keep = jax.random.uniform(key, grad_abs.shape) < bf
             return keep.astype(jnp.float32)
@@ -852,7 +863,7 @@ def _build_step(grad_fn=None, fobj=None, *, cfg, C, lr, boosting, d, cat_idx,
         # never mask every feature
         fmask = jnp.where(fmask.sum() == 0, jnp.ones((d,), jnp.float32), fmask)
 
-        bw = make_weights(key, jnp.abs(g).sum(axis=1), g.shape[0])
+        bw = make_weights(key, jnp.abs(g).sum(axis=1), yv, g.shape[0])
 
         cmask = (jnp.asarray(cat_mask_np) if cat_mask_np is not None else None)
 
@@ -969,7 +980,8 @@ def _build_step(grad_fn=None, fobj=None, *, cfg, C, lr, boosting, d, cat_idx,
 
 @lru_cache(maxsize=64)
 def _cached_step(obj_key, *, cfg, C, lr, boosting, d, cat_idx, ff, bf, bfreq,
-                 use_goss, top_rate, other_rate, mesh, axis, scan_iters=None,
+                 use_goss, top_rate, other_rate, mesh, axis,
+                 pos_bf=1.0, neg_bf=1.0, scan_iters=None,
                  eval_metric=None, n_eval=0):
     """Compiled-step cache for built-in objectives (custom fobj / lambdarank
     close over data and stay uncached). Keyed on every static that shapes the
@@ -982,6 +994,7 @@ def _cached_step(obj_key, *, cfg, C, lr, boosting, d, cat_idx, ff, bf, bfreq,
                        d=d, cat_idx=cat_idx, ff=ff, bf=bf, bfreq=bfreq,
                        use_goss=use_goss, top_rate=top_rate,
                        other_rate=other_rate, mesh=mesh, axis=axis,
+                       pos_bf=pos_bf, neg_bf=neg_bf,
                        scan_iters=scan_iters, eval_metric=eval_metric,
                        n_eval=n_eval)
 
@@ -1094,6 +1107,18 @@ def train(params: Dict[str, Any], x: np.ndarray, y: Optional[np.ndarray] = None,
                     f"max_bin={params['max_bin']} ignored: the GBDTDataset "
                     f"was binned with max_bin={dataset.max_bin}",
                     stacklevel=2)
+            for k, current in (("max_bin_by_feature",
+                                mapper.max_bin_by_feature),
+                               ("bin_sample_count", mapper.sample_cnt)):
+                requested = (params or {}).get(k)
+                if requested is not None and (requested or None) != \
+                        (current or None):
+                    # only on a real mismatch: estimators always pass their
+                    # defaults, which must not warn
+                    warnings.warn(
+                        f"{k}={requested} ignored: the GBDTDataset owns "
+                        "binning (pass binning params to GBDTDataset instead)",
+                        stacklevel=2)
             if (params or {}).get("categorical_feature") and \
                     sorted(cat_features) != sorted(mapper.categorical_features):
                 warnings.warn(
@@ -1103,6 +1128,8 @@ def train(params: Dict[str, Any], x: np.ndarray, y: Optional[np.ndarray] = None,
                     "to GBDTDataset instead)", stacklevel=2)
         else:
             mapper = BinMapper(max_bin=int(p["max_bin"]), seed=int(p["seed"]),
+                               sample_cnt=int(p["bin_sample_count"]),
+                               max_bin_by_feature=p["max_bin_by_feature"],
                                categorical_features=cat_features).fit(x)
     has_cat = bool(mapper.categorical_features)
     reuse_dataset = dataset is not None and mapper is dataset.mapper
@@ -1126,6 +1153,8 @@ def train(params: Dict[str, Any], x: np.ndarray, y: Optional[np.ndarray] = None,
         raw0 = raw0.reshape(n, C)
     else:
         base = np.atleast_1d(np.asarray(init_fn(y, w_np), dtype=np.float64))
+        if not p["boost_from_average"]:
+            base = np.zeros_like(base)  # LightGBM boost_from_average=false
         # host margin matrix only where it is actually consumed (mesh padding
         # / sharded upload); the non-mesh path builds raw_d on device
         raw0 = np.tile(base, (n, 1)) if mesh is not None else None
@@ -1143,12 +1172,19 @@ def train(params: Dict[str, Any], x: np.ndarray, y: Optional[np.ndarray] = None,
                       "DART rescales earlier trees after the best iteration, so "
                       "truncating at best_iteration is not reproducible",
                       stacklevel=2)
-    if boosting == "rf" and not (float(p["bagging_fraction"]) < 1.0
-                                 and int(p["bagging_freq"]) > 0):
+    class_bagging = (float(p["pos_bagging_fraction"]) < 1.0
+                     or float(p["neg_bagging_fraction"]) < 1.0)
+    if class_bagging and obj_name != "binary":
+        # LightGBM: pos/neg_bagging_fraction are binary-only (yv > 0 would
+        # silently missample any other objective)
+        raise ValueError("pos/neg_bagging_fraction require objective='binary'")
+    if boosting == "rf" and not (
+            (float(p["bagging_fraction"]) < 1.0 or class_bagging)
+            and int(p["bagging_freq"]) > 0):
         # without bagging every rf tree sees identical gradients -> T copies of
         # one tree (LightGBM rejects this config the same way)
-        raise ValueError("boosting='rf' requires bagging_fraction < 1.0 and "
-                         "bagging_freq > 0")
+        raise ValueError("boosting='rf' requires bagging_fraction < 1.0 (or "
+                         "class-aware pos/neg fractions) and bagging_freq > 0")
     lr = float(p["learning_rate"]) if boosting != "rf" else 1.0
 
     parallelism = p["parallelism"]
@@ -1161,6 +1197,8 @@ def train(params: Dict[str, Any], x: np.ndarray, y: Optional[np.ndarray] = None,
         min_data_in_leaf=float(p["min_data_in_leaf"]),
         min_sum_hessian=float(p["min_sum_hessian_in_leaf"]),
         min_gain_to_split=float(p["min_gain_to_split"]),
+        max_depth=int(p["max_depth"]),
+        max_delta_step=float(p["max_delta_step"]),
         hist_method=p["hist_method"], hist_chunk=int(p["hist_chunk"]),
         cat_smooth=float(p["cat_smooth"]),
         max_cat_threshold=int(p["max_cat_threshold"]),
@@ -1187,7 +1225,9 @@ def train(params: Dict[str, Any], x: np.ndarray, y: Optional[np.ndarray] = None,
     step_args = dict(cfg=cfg, C=C, lr=lr, boosting=boosting, d=d,
                      cat_idx=cat_idx, ff=ff, bf=bf, bfreq=bfreq,
                      use_goss=use_goss, top_rate=top_rate,
-                     other_rate=other_rate, mesh=mesh, axis=axis)
+                     other_rate=other_rate, mesh=mesh, axis=axis,
+                     pos_bf=float(p['pos_bagging_fraction']),
+                     neg_bf=float(p['neg_bagging_fraction']))
     obj_key = (obj_name, C, float(p["alpha"]),
                float(p["tweedie_variance_power"]), float(p["sigmoid"]))
     step_cacheable = fobj is None and obj_name != "lambdarank"
@@ -1303,6 +1343,8 @@ def train(params: Dict[str, Any], x: np.ndarray, y: Optional[np.ndarray] = None,
     dart_drop_rate = float(p["drop_rate"])
     dart_max_drop = int(p["max_drop"])
     dart_skip = float(p["skip_drop"])
+    dart_uniform = bool(p["uniform_drop"])
+    dart_xgb_mode = bool(p["xgboost_dart_mode"])
 
     trees_host: List[Any] = []
     tree_scales: List[float] = []
@@ -1409,7 +1451,16 @@ def train(params: Dict[str, Any], x: np.ndarray, y: Optional[np.ndarray] = None,
 
         dart_dropped: List[int] = []
         if boosting == "dart" and trees_host and rng.random() >= dart_skip:
-            mask = rng.random(len(trees_host)) < dart_drop_rate
+            u = rng.random(len(trees_host))
+            if dart_uniform:
+                mask = u < dart_drop_rate
+            else:
+                # LightGBM default: drop probability proportional to tree
+                # weight (heavier trees drop more often), expected count
+                # matching drop_rate (dart.cpp DroppingTrees)
+                w = np.asarray(tree_scales, np.float64)
+                inv_avg = len(w) / max(w.sum(), 1e-12)
+                mask = u < dart_drop_rate * w * inv_avg
             dart_dropped = list(np.nonzero(mask)[0][:dart_max_drop])
             if dart_dropped:
                 # remove dropped trees from raw score before fitting the new tree
@@ -1429,12 +1480,17 @@ def train(params: Dict[str, Any], x: np.ndarray, y: Optional[np.ndarray] = None,
         scale = 1.0
         if boosting == "dart" and dart_dropped:
             k_d = len(dart_dropped)
-            scale = 1.0 / (k_d + 1)
-            # normalize: dropped trees keep k/(k+1) of their weight; re-add them
+            if dart_xgb_mode:
+                # xgboost normalization: new tree lr/(k+lr), dropped k/(k+lr)
+                scale = 1.0 / (k_d + lr)
+                factor = k_d / (k_d + lr)
+            else:
+                scale = 1.0 / (k_d + 1)
+                factor = k_d / (k_d + 1.0)
+            # normalize: dropped trees keep ``factor`` of their weight
             raw_np = np.array(raw_d)
             for c in range(C):
                 raw_np[:, c] -= (1.0 - scale) * lr * predict_tree_binned(tree_np, host_binned(), c)
-            factor = k_d / (k_d + 1.0)
             for t in dart_dropped:
                 old = tree_scales[t]
                 tree_scales[t] = old * factor

@@ -67,9 +67,25 @@ class _LightGBMBase(Estimator):
                           validator=ParamValidators.gt(0))
     num_leaves = Param("max leaves per tree", int, default=31,
                        validator=ParamValidators.gt(1))
+    max_depth = Param("max tree depth, <= 0 unlimited (reference maxDepth)",
+                      int, default=-1)
+    max_delta_step = Param("clamp leaf outputs, 0 = off (reference "
+                           "maxDeltaStep)", float, default=0.0)
+    boost_from_average = Param("start from the label average (reference "
+                               "boostFromAverage)", bool, default=True)
     max_bin = Param("max histogram bins per feature", int, default=255,
                     validator=ParamValidators.gt(1))
+    max_bin_by_feature = Param("per-feature max_bin overrides (reference "
+                               "maxBinByFeature; empty = max_bin)", list,
+                               default=[])
+    bin_sample_count = Param("rows sampled for bin-edge estimation (reference "
+                             "binSampleCount)", int, default=200_000,
+                             validator=ParamValidators.gt(0))
     bagging_fraction = Param("row subsample fraction", float, default=1.0)
+    pos_bagging_fraction = Param("positive-row subsample fraction (reference "
+                                 "posBaggingFraction)", float, default=1.0)
+    neg_bagging_fraction = Param("negative-row subsample fraction (reference "
+                                 "negBaggingFraction)", float, default=1.0)
     bagging_freq = Param("bag every k iterations (0 = off)", int, default=0)
     bagging_seed = Param("bagging seed", int, default=3)
     feature_fraction = Param("feature subsample fraction per tree", float, default=1.0)
@@ -87,6 +103,10 @@ class _LightGBMBase(Estimator):
     drop_rate = Param("dart: tree dropout rate", float, default=0.1)
     max_drop = Param("dart: max trees dropped per iteration", int, default=50)
     skip_drop = Param("dart: probability of skipping dropout", float, default=0.5)
+    uniform_drop = Param("dart: drop uniformly instead of weight-proportional "
+                         "(reference uniformDrop)", bool, default=False)
+    xgboost_dart_mode = Param("dart: xgboost normalization lr/(k+lr) "
+                              "(reference xgboostDartMode)", bool, default=False)
     metric = Param("eval metric name ('' = objective default)", str, default="")
     parallelism = Param("data_parallel (full histogram allreduce) | "
                         "voting_parallel (PV-tree: top-k feature vote + "
@@ -126,8 +146,15 @@ class _LightGBMBase(Estimator):
             "num_iterations": self.num_iterations,
             "learning_rate": self.learning_rate,
             "num_leaves": self.num_leaves,
+            "max_depth": self.max_depth,
+            "max_delta_step": self.max_delta_step,
+            "boost_from_average": self.boost_from_average,
             "max_bin": self.max_bin,
+            "max_bin_by_feature": list(self.max_bin_by_feature) or None,
+            "bin_sample_count": self.bin_sample_count,
             "bagging_fraction": self.bagging_fraction,
+            "pos_bagging_fraction": self.pos_bagging_fraction,
+            "neg_bagging_fraction": self.neg_bagging_fraction,
             "bagging_freq": self.bagging_freq,
             "feature_fraction": self.feature_fraction,
             "lambda_l1": self.lambda_l1,
@@ -140,6 +167,8 @@ class _LightGBMBase(Estimator):
             "top_rate": self.top_rate, "other_rate": self.other_rate,
             "drop_rate": self.drop_rate, "max_drop": self.max_drop,
             "skip_drop": self.skip_drop,
+            "uniform_drop": self.uniform_drop,
+            "xgboost_dart_mode": self.xgboost_dart_mode,
             "metric": self.metric or None,
             "seed": self.seed,
             "bagging_seed": self.bagging_seed,

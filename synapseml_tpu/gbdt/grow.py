@@ -51,6 +51,8 @@ class TreeConfig(NamedTuple):
     hist_chunk: int = 1 << 20
     cat_smooth: float = 10.0
     max_cat_threshold: int = 32
+    max_depth: int = -1          # <= 0: unlimited (LightGBM maxDepth)
+    max_delta_step: float = 0.0  # > 0: clamp leaf outputs (LightGBM maxDeltaStep)
     parallelism: str = "data"   # 'data' | 'voting'
     top_k: int = 20             # voting: local vote size (global select = 2k)
     # Leaf-local histograms (LightGBM's ConstructHistograms scans only the
@@ -269,8 +271,12 @@ def grow_tree(binned, grad, hess, row_weight, feature_mask, cfg: TreeConfig,
         return jnp.arange(B) <= b_sel, jnp.zeros((), jnp.bool_)
 
     def step(s, state):
-        node, hists, parent, feat, bin_, gains, cat_sets = state
+        node, hists, parent, feat, bin_, gains, cat_sets, depth = state
         leaf_gain, leaf_f, leaf_b = best_splits(hists, s + 1)
+        if cfg.max_depth > 0:
+            # leaves at the depth cap cannot split (LightGBM leaf-wise
+            # growth under maxDepth)
+            leaf_gain = jnp.where(depth < cfg.max_depth, leaf_gain, -jnp.inf)
         l = jnp.argmax(leaf_gain)
         g_best = leaf_gain[l]
         ok = g_best > jnp.maximum(cfg.min_gain_to_split, 0.0)
@@ -322,7 +328,10 @@ def grow_tree(binned, grad, hess, row_weight, feature_mask, cfg: TreeConfig,
         gains = gains.at[s].set(jnp.where(ok, g_best, 0.0).astype(jnp.float32))
         cat_sets = cat_sets.at[s].set(
             (in_set & is_cat & ok).astype(jnp.int8))
-        return node, hists, parent, feat, bin_, gains, cat_sets
+        child_depth = jnp.where(ok, depth[l] + 1, depth[l]).astype(jnp.int32)
+        depth = jnp.where(ok, depth.at[s + 1].set(child_depth)
+                          .at[l].set(child_depth), depth)
+        return node, hists, parent, feat, bin_, gains, cat_sets, depth
 
     root_hist = hist_of(row_weight)
     hists0 = jnp.zeros((L, d, B, 3), dtype=jnp.float32).at[0].set(root_hist)
@@ -334,8 +343,9 @@ def grow_tree(binned, grad, hess, row_weight, feature_mask, cfg: TreeConfig,
         jnp.zeros(L - 1, dtype=jnp.int32),
         jnp.zeros(L - 1, dtype=jnp.float32),
         jnp.zeros((L - 1, B), dtype=jnp.int8),
+        jnp.zeros(L, dtype=jnp.int32),  # per-leaf depth
     )
-    node, hists, parent, feat, bin_, gains, cat_sets = lax.fori_loop(
+    node, hists, parent, feat, bin_, gains, cat_sets, _depth = lax.fori_loop(
         0, L - 1, step, state0)
 
     # leaf totals: sum over bins of any one feature covers every row exactly once
@@ -346,6 +356,9 @@ def grow_tree(binned, grad, hess, row_weight, feature_mask, cfg: TreeConfig,
         H_leaf = lax.psum(H_leaf, axis_name)
     leaf_value = -_thresh_l1(G_leaf, l1) / (H_leaf + l2)
     leaf_value = jnp.where(H_leaf > 0, leaf_value, 0.0)
+    if cfg.max_delta_step > 0:
+        leaf_value = jnp.clip(leaf_value, -cfg.max_delta_step,
+                              cfg.max_delta_step)
     return GrownTree(parent, feat, bin_, gains, leaf_value, H_leaf, cat_sets), node
 
 

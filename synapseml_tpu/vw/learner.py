@@ -189,11 +189,12 @@ def train_linear(
                 jax.lax.pmax(s, axis_name))
 
         ds = P(axis)
-        step_jit = jax.jit(shard_map(
+        sharded_pass = shard_map(
             pass_fn, mesh=mesh,
             in_specs=(P(), ds, ds, ds, ds), out_specs=P(),
             check_vma=False,
-        ))
+        )
+        step_fn = sharded_pass
         args = (jax.device_put(bi, NamedSharding(mesh, ds)),
                 jax.device_put(bv, NamedSharding(mesh, ds)),
                 jax.device_put(by, NamedSharding(mesh, ds)),
@@ -208,14 +209,27 @@ def train_linear(
                 a = np.concatenate([a, np.zeros(pad_shape, a.dtype)])
             return a.reshape(nb, batch_size, *a.shape[1:])
 
-        step_jit = jax.jit(lambda st, bi, bv, by, bw: LinearLearnerState(
-            *one_pass(st, bi, bv, by, bw)))
+        step_fn = lambda st, bi, bv, by, bw: LinearLearnerState(
+            *one_pass(st, bi, bv, by, bw))
         args = (reshape(idx), reshape(val), reshape(y.astype(np.float32)),
                 reshape(w_np))
 
+    passes = max(1, int(num_passes))
+
+    @jax.jit
+    def run(state, bi, bv, by, bw):
+        # ALL passes in one compiled program (a scan over the pass loop):
+        # one dispatch per fit instead of one per pass. Besides dispatch
+        # latency, per-pass dispatch of the 8-way shard_map program
+        # intermittently aborted inside XLA CPU's collective rendezvous
+        # under the virtual-device test mesh; a single program forms the
+        # rendezvous once.
+        def body(st, _):
+            return step_fn(st, bi, bv, by, bw), None
+        return jax.lax.scan(body, state, None, length=passes)[0]
+
     state = LinearLearnerState(*(np.asarray(s) for s in state0))
-    for _ in range(max(1, int(num_passes))):
-        state = step_jit(state, *args)
+    state = run(state, *args)
     state = LinearLearnerState(*(np.asarray(s) for s in state))
     # fold the feature scales into the weights: raw-space w = w' / s
     scale = np.asarray(state.scale)

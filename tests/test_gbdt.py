@@ -689,3 +689,94 @@ def test_device_pipeline_predict_matches_host():
     # f32 device binning can flip rows that sit exactly on a bin edge; with
     # random data none do, so predictions agree to f32 precision
     np.testing.assert_allclose(host, dev, rtol=1e-5, atol=1e-5)
+
+
+def test_gbdt_max_depth_and_delta_step(data):
+    """maxDepth caps leaf-wise growth; maxDeltaStep clamps leaf outputs
+    (reference LightGBMParams maxDepth/maxDeltaStep)."""
+    x, y, _, _ = data
+    yr = x[:, 0] * 2.0
+    one = {"objective": "regression", "num_iterations": 1, "learning_rate": 1.0,
+           "num_leaves": 31, "min_data_in_leaf": 2, "max_bin": 63}
+    b2 = train({**one, "max_depth": 2}, x, yr)
+    b0 = train(one, x, yr)
+    # depth-2 tree has at most 4 leaves -> at most 4 distinct predictions
+    assert len(np.unique(b2.predict(x).round(9))) <= 4
+    assert len(np.unique(b0.predict(x).round(9))) > 4
+    bd = train({**one, "max_delta_step": 0.05}, x, yr)
+    base = bd.base_score[0]
+    assert np.abs(bd.predict(x) - base).max() <= 0.05 + 1e-6
+
+
+def test_gbdt_boost_from_average_off(data):
+    x, y, _, _ = data
+    b = train({"objective": "binary", "num_iterations": 3,
+               "boost_from_average": False, "max_bin": 63}, x, y)
+    assert b.base_score[0] == 0.0
+    b_on = train({"objective": "binary", "num_iterations": 3, "max_bin": 63},
+                 x, y)
+    assert b_on.base_score[0] != 0.0
+
+
+def test_gbdt_class_aware_bagging(data):
+    x, y, _, _ = data
+    params = {"objective": "binary", "num_iterations": 20, "max_bin": 63,
+              "bagging_freq": 1, "pos_bagging_fraction": 0.4,
+              "neg_bagging_fraction": 0.9, "seed": 1}
+    b = train(params, x, y)
+    assert _auc(y, b.predict(x)) > 0.8
+    # class-aware sampling changes the trees vs plain bagging
+    b_plain = train({**params, "pos_bagging_fraction": 1.0,
+                     "neg_bagging_fraction": 1.0,
+                     "bagging_fraction": 0.7}, x, y)
+    assert not np.allclose(b.predict(x), b_plain.predict(x))
+
+
+def test_gbdt_dart_modes(data):
+    x, y, _, _ = data
+    common = {"objective": "binary", "boosting": "dart", "num_iterations": 25,
+              "drop_rate": 0.5, "skip_drop": 0.0, "max_bin": 63, "seed": 2}
+    b_def = train(common, x, y)
+    b_uni = train({**common, "uniform_drop": True}, x, y)
+    b_xgb = train({**common, "xgboost_dart_mode": True}, x, y)
+    for b in (b_def, b_uni, b_xgb):
+        assert _auc(y, b.predict(x)) > 0.85
+    # xgboost normalization produces different tree weights
+    assert not np.allclose(b_def.predict(x), b_xgb.predict(x))
+
+
+def test_binmapper_max_bin_by_feature():
+    from synapseml_tpu.gbdt.binning import BinMapper
+
+    rng = np.random.default_rng(5)
+    x = rng.normal(size=(5000, 3))
+    m = BinMapper(max_bin=63, max_bin_by_feature=[4, 0, 200]).fit(x)
+    binned = m.transform(x)
+    assert m.n_bins == 201  # overrides may exceed max_bin
+    # feature 0 capped at 4 bins, feature 1 falls back to max_bin
+    assert len(np.unique(binned[:, 0])) <= 4
+    assert 4 < len(np.unique(binned[:, 1])) <= 64
+    assert len(np.unique(binned[:, 2])) > 64
+    m2 = BinMapper.from_dict(m.to_dict())
+    np.testing.assert_array_equal(m2.transform(x), binned)
+    # trains end-to-end through params
+    y = (x[:, 0] > 0).astype(np.float64)
+    b = train({"objective": "binary", "num_iterations": 5, "max_bin": 63,
+               "max_bin_by_feature": [4, 0, 200], "bin_sample_count": 1000},
+              x, y)
+    assert b.mapper.sample_cnt == 1000
+
+
+def test_gbdt_param_guards(data):
+    x, y, _, _ = data
+    with pytest.raises(ValueError, match="binary"):
+        train({"objective": "regression", "pos_bagging_fraction": 0.5,
+               "bagging_freq": 1}, x, x[:, 0])
+    with pytest.raises(ValueError, match="entries for"):
+        train({"objective": "binary", "num_iterations": 2,
+               "max_bin_by_feature": [4, 4]}, x, y)
+    # rf accepts class-aware bagging in place of bagging_fraction
+    b = train({"objective": "binary", "boosting": "rf", "num_iterations": 5,
+               "bagging_freq": 1, "pos_bagging_fraction": 0.5,
+               "neg_bagging_fraction": 0.5}, x, y)
+    assert b.num_trees == 5
