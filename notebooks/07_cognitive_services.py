@@ -1,0 +1,78 @@
+# %% [markdown]
+# # Cognitive services on pipelines
+#
+# Reference notebooks: `notebooks/features/cognitive_services/`. Service
+# transformers compose into ordinary pipelines: pack per-row params, call
+# the service with bounded concurrency and retries, parse JSON, split
+# errors into their own column. This demo runs against an in-notebook stub
+# service (the environment is zero-egress); swap `url=` for a real
+# endpoint + key to run live.
+
+# %% stand up a local stub that answers like the text-analytics API
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+
+from synapseml_tpu import Table
+
+
+class Stub(BaseHTTPRequestHandler):
+    def do_POST(self):
+        body = json.loads(self.rfile.read(
+            int(self.headers.get("Content-Length", 0)) or 0) or b"{}")
+        text = body["documents"][0]["text"]
+        score = 0.9 if "love" in text else 0.1
+        out = json.dumps({"documents": [{
+            "id": "0", "sentiment": "positive" if score > 0.5 else "negative",
+            "confidenceScores": {"positive": score, "negative": 1 - score},
+        }]}).encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(out)))
+        self.end_headers()
+        self.wfile.write(out)
+
+    def log_message(self, *a):
+        pass
+
+
+httpd = ThreadingHTTPServer(("127.0.0.1", 0), Stub)
+threading.Thread(target=httpd.serve_forever, daemon=True).start()
+url = f"http://127.0.0.1:{httpd.server_address[1]}/sentiment"
+
+# %% sentiment over a column of reviews
+from synapseml_tpu.cognitive import TextSentiment
+
+reviews = Table({"text": np.array(
+    ["I love this framework", "terrible latency", "love the mesh API"],
+    dtype=object)})
+ts = TextSentiment(url=url, subscription_key="key", output_col="sentiment")
+out = ts.transform(reviews)
+labels = [d["documents"][0]["sentiment"] for d in out["sentiment"]]
+print("sentiments:", labels)
+assert labels == ["positive", "negative", "positive"]
+assert all(e is None for e in out["errors"])
+
+# %% error columns: a dead endpoint lands in `errors`, rows keep flowing
+dead = TextSentiment(url="http://127.0.0.1:1/nope", subscription_key="key",
+                     backoffs=[], output_col="sentiment")
+bad = dead.transform(reviews)
+print("error rows:", sum(e is not None for e in bad["errors"]))
+assert all(v is None for v in bad["sentiment"])
+
+# %% pipe the parsed service output into downstream ML
+from synapseml_tpu.gbdt import LightGBMClassifier
+
+scored = out.with_column(
+    "features",
+    np.array([[d["documents"][0]["confidenceScores"]["positive"]]
+              for d in out["sentiment"]]))
+scored = scored.with_column("label",
+                            np.array([1.0, 0.0, 1.0]))
+model = LightGBMClassifier(num_iterations=5, min_data_in_leaf=1).fit(scored)
+print("downstream predictions:",
+      np.asarray(model.transform(scored)["prediction"]))
+
+httpd.shutdown()
