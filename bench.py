@@ -229,6 +229,32 @@ def bench_vit_gbdt(platform, peak):
             "mfu_vit_only": round(mfu, 4) if mfu else None}
 
 
+def bench_flash_attention(platform, peak):
+    """Pallas flash attention at long sequence (the regime dense attention
+    cannot reach: S=32k scores alone would be ~34 GB)."""
+    import jax
+    import jax.numpy as jnp
+
+    from synapseml_tpu.parallel import flash_attention
+
+    B, H, D = 1, 8, 64
+    S = 32768 if platform != "cpu" else 512
+    rng = np.random.default_rng(9)
+    mk = lambda: jax.device_put(
+        rng.normal(size=(B, S, H, D)).astype(np.float32)).astype(jnp.bfloat16)
+    q, k, v = mk(), mk(), mk()
+
+    def step(eps):
+        return flash_attention(q + eps.astype(jnp.bfloat16), k, v,
+                               causal=True).astype(jnp.float32).sum()
+
+    dt, _ = _timed_device_loop(step, 5 if platform != "cpu" else 1)
+    flops = 4 * B * H * S * S * D  # nominal; causal skips ~half
+    return {"seq_len": S, "ms_per_fwd": round(dt * 1000, 2),
+            "tflops_nominal": round(flops / dt / 1e12, 1),
+            "mfu_vs_bf16_peak": round(flops / dt / peak, 4) if peak else None}
+
+
 def bench_serving(platform):
     """Serving latency p50/p99: continuous (push) vs micro-batch engines over
     a trivial pipeline. Reference north-star: sub-millisecond continuous p50
@@ -303,6 +329,7 @@ def main() -> None:
         ("bert_base_onnx", lambda: bench_bert(platform, peak)),
         ("gbdt_higgs_scale", lambda: bench_gbdt_higgs(platform)),
         ("vit_to_gbdt_pipeline", lambda: bench_vit_gbdt(platform, peak)),
+        ("flash_attention_32k", lambda: bench_flash_attention(platform, peak)),
         ("serving_latency", lambda: bench_serving(platform)),
     ]:
         try:

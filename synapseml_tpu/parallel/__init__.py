@@ -8,6 +8,7 @@ with XLA collectives (``ppermute`` ring / ``all_to_all`` head exchange)
 doing the communication.
 """
 
+from .flash import dense_attention, flash_attention
 from .ring import (
     ring_attention,
     sequence_sharded_attention,
@@ -15,4 +16,5 @@ from .ring import (
 )
 
 __all__ = ["ring_attention", "ulysses_attention",
-           "sequence_sharded_attention"]
+           "sequence_sharded_attention",
+           "flash_attention", "dense_attention"]

@@ -1,0 +1,193 @@
+"""Pallas flash attention — the TPU kernel for long-sequence inference.
+
+SURVEY.md §5 marks long-context support as net-new; ``ring.py`` provides the
+cross-chip recipes (ppermute ring / all-to-all). This module provides the
+ON-CHIP kernel: blockwise attention with online softmax running entirely in
+VMEM, so the (S_q, S_k) score matrix never materializes in HBM. XLA's dense
+attention allocates the full score tensor per head — at S=8k, H=12 that is
+B * 12 * 8k * 8k * 4 bytes = 3 GB HBM traffic per batch element; the flash
+kernel streams K/V blocks through VMEM instead (the standard
+memory-bound-to-compute-bound move).
+
+Layout: inputs (B, S, H, D) like ``ring.py``; the kernel runs per (batch,
+head) over query blocks, with a ``lax.fori_loop`` over key blocks carrying
+the (m, l, acc) online-softmax state as register values. Masking uses a
+finite ``-1e30`` (an actual ``-inf`` makes ``exp(m - m_new)`` produce NaN
+for fully-masked leading causal rows).
+
+``interpret=True`` runs the same kernel through the Pallas interpreter on
+CPU — the parity tests exercise the kernel logic without TPU hardware.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+__all__ = ["flash_attention", "dense_attention"]
+
+_NEG = -1e30
+
+
+def dense_attention(q, k, v, causal: bool = False):
+    """Reference dense attention, (B, S, H, D) layout, f32 accumulation."""
+    import jax.numpy as jnp
+
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    s = jnp.einsum("bqhd,bkhd->bqhk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    if causal:
+        S_q, S_k = s.shape[1], s.shape[3]
+        mask = (jnp.arange(S_q)[:, None] + (S_k - S_q)
+                >= jnp.arange(S_k)[None, :])
+        s = jnp.where(mask[None, :, None, :], s, _NEG)
+    p = jnp.exp(s - s.max(-1, keepdims=True))
+    out = jnp.einsum("bqhk,bkhd->bqhd", p / p.sum(-1, keepdims=True),
+                     v.astype(jnp.float32))
+    return out.astype(q.dtype)
+
+
+def flash_attention(q, k, v, causal: bool = False, block_q: int = 512,
+                    block_k: int = 512, interpret: bool = False):
+    """Blockwise-online-softmax attention as ONE Pallas kernel.
+
+    ``q`` (B, S_q, H, D), ``k``/``v`` (B, S_k, H, D) -> (B, S_q, H, D).
+    ``causal`` aligns the diagonal to the END of the key sequence (queries
+    are the LAST S_q positions), matching decode/ring conventions. Block
+    sizes must divide the respective sequence lengths.
+
+    ``bench.py``'s ``flash_attention_32k`` config records throughput on the
+    round's TPU; at short S the kernel is dispatch-bound and roughly ties
+    XLA's dense attention, so it is the long-sequence path (dense attention
+    at S=32k would need ~34 GB for the score tensor alone).
+    """
+    import jax.numpy as jnp
+
+    b, s_q, h, d = q.shape
+    s_k = k.shape[1]
+    if k.shape != (b, s_k, h, d) or v.shape != (b, s_k, h, d):
+        raise ValueError(f"shape mismatch: q {q.shape}, k {k.shape}, "
+                         f"v {v.shape}")
+    if causal and s_q > s_k:
+        # queries are the LAST s_q positions of the key sequence; more
+        # queries than keys would leave leading rows with no visible key
+        # (and silently all-zero outputs)
+        raise ValueError(f"causal flash attention needs s_q <= s_k, got "
+                         f"s_q={s_q} > s_k={s_k}")
+    block_q = min(block_q, s_q)
+    block_k = min(block_k, s_k)
+    if s_q % block_q or s_k % block_k:
+        raise ValueError(f"block sizes ({block_q}, {block_k}) must divide "
+                         f"sequence lengths ({s_q}, {s_k})")
+
+    # (B, S, H, D) -> (B*H, S, D): batch*head is the embarrassing grid axis
+    def to_bh(x):
+        return jnp.transpose(x, (0, 2, 1, 3)).reshape(b * h, x.shape[1], d)
+
+    out = _flash_bh(to_bh(q), to_bh(k), to_bh(v), bool(causal), int(block_q),
+                    int(block_k), bool(interpret))
+    return (out.reshape(b, h, s_q, d).transpose(0, 2, 1, 3)).astype(q.dtype)
+
+
+@functools.lru_cache(maxsize=1)
+def _flash_bh_jit():
+    """jax.jit applied lazily so importing the package never imports jax."""
+    import jax
+
+    return jax.jit(_flash_bh_impl,
+                   static_argnames=("causal", "block_q", "block_k",
+                                    "interpret"))
+
+
+def _flash_bh(q, k, v, causal, block_q, block_k, interpret):
+    return _flash_bh_jit()(q, k, v, causal=causal, block_q=block_q,
+                           block_k=block_k, interpret=interpret)
+
+
+def _flash_bh_impl(q, k, v, causal, block_q, block_k, interpret):
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    bh, s_q, d = q.shape
+    s_k = k.shape[1]
+    scale = 1.0 / math.sqrt(d)
+    nk = s_k // block_k
+    # causal diagonal sits at the END of the key axis (ring/decode layout)
+    diag_off = s_k - s_q
+
+    # bf16 inputs run the two dots at the MXU's native rate with f32
+    # accumulation (p is cast to the value dtype for the PV dot — the
+    # standard flash-kernel precision tradeoff); f32 inputs stay exact
+    in_dt = q.dtype
+
+    def kernel(q_ref, k_ref, v_ref, o_ref, m_s, l_s, acc_s):
+        iq = pl.program_id(1)
+        jk = pl.program_id(2)
+
+        @pl.when(jk == 0)
+        def _():
+            m_s[:] = jnp.full_like(m_s, _NEG)
+            l_s[:] = jnp.zeros_like(l_s)
+            acc_s[:] = jnp.zeros_like(acc_s)
+
+        def compute():
+            qb = q_ref[0]                                    # (bq, d)
+            kb = k_ref[0]
+            vb = v_ref[0]
+            s = jax.lax.dot_general(qb, kb, (((1,), (1,)), ((), ())),
+                                    preferred_element_type=jnp.float32)
+            s = s * scale
+            if causal:
+                qpos = iq * block_q + jax.lax.broadcasted_iota(
+                    jnp.int32, (block_q, block_k), 0) + diag_off
+                kpos = jk * block_k + jax.lax.broadcasted_iota(
+                    jnp.int32, (block_q, block_k), 1)
+                s = jnp.where(qpos >= kpos, s, _NEG)
+            m = m_s[:, 0:1]
+            m_new = jnp.maximum(m, s.max(-1, keepdims=True))
+            p = jnp.exp(s - m_new)
+            corr = jnp.exp(m - m_new)
+            l_s[:, 0:1] = l_s[:, 0:1] * corr + p.sum(-1, keepdims=True)
+            acc_s[:] = acc_s[:] * corr + jax.lax.dot_general(
+                p.astype(in_dt), vb, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            m_s[:, 0:1] = m_new
+
+        if causal:
+            # key blocks strictly above the diagonal contribute nothing
+            first_masked = ((iq + 1) * block_q + diag_off
+                            + block_k - 1) // block_k
+            pl.when(jk < first_masked)(compute)
+        else:
+            compute()
+
+        @pl.when(jk == pl.num_programs(2) - 1)
+        def _():
+            o_ref[0] = (acc_s[:] / jnp.maximum(l_s[:, 0:1], 1e-30)
+                        ).astype(o_ref.dtype)
+
+    grid = (bh, s_q // block_q, nk)
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bhi, i, j: (bhi, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bhi, i, j: (bhi, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bhi, i, j: (bhi, j, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d),
+                               lambda bhi, i, j: (bhi, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, s_q, d), jnp.float32),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 128), jnp.float32),  # running max
+            pltpu.VMEM((block_q, 128), jnp.float32),  # running denominator
+            pltpu.VMEM((block_q, d), jnp.float32),    # running numerator
+        ],
+        compiler_params=None if interpret else pltpu.CompilerParams(
+            # bh/q-block steps are independent; only the key-block walk
+            # carries state -> Mosaic can pipeline block DMAs across steps
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(q, k, v)

@@ -104,3 +104,53 @@ def test_ring_peak_memory_is_blockwise(mesh):
     # largest score-shaped buffer is (b, s_local, h, s_local), never (.., s)
     text = str(jaxpr)
     assert f"{s_local},{h},{s}" not in text.replace(" ", "")
+
+
+# -- pallas flash attention (interpret mode on the CPU test mesh) -------------------
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("sq,sk", [(256, 256), (128, 512)])
+def test_flash_attention_matches_dense(causal, sq, sk):
+    from synapseml_tpu.parallel import dense_attention, flash_attention
+
+    rng = np.random.default_rng(7)
+    q = jnp.asarray(rng.normal(size=(2, sq, 4, 64)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(2, sk, 4, 64)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(2, sk, 4, 64)), jnp.float32)
+    ref = dense_attention(q, k, v, causal=causal)
+    out = flash_attention(q, k, v, causal=causal, block_q=64, block_k=128,
+                          interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_flash_attention_bf16():
+    from synapseml_tpu.parallel import dense_attention, flash_attention
+
+    rng = np.random.default_rng(8)
+    q = jnp.asarray(rng.normal(size=(1, 256, 2, 64)), jnp.bfloat16)
+    k = jnp.asarray(rng.normal(size=(1, 256, 2, 64)), jnp.bfloat16)
+    v = jnp.asarray(rng.normal(size=(1, 256, 2, 64)), jnp.bfloat16)
+    ref = dense_attention(q.astype(jnp.float32), k.astype(jnp.float32),
+                          v.astype(jnp.float32), causal=True)
+    out = flash_attention(q, k, v, causal=True, block_q=128, block_k=128,
+                          interpret=True)
+    # bf16 dots: ~1e-2 absolute agreement is the expected precision
+    assert float(jnp.abs(out.astype(jnp.float32) - ref).max()) < 5e-2
+
+
+def test_flash_attention_shape_errors():
+    from synapseml_tpu.parallel import flash_attention
+
+    q = jnp.zeros((1, 256, 2, 64), jnp.float32)
+    k = jnp.zeros((1, 200, 2, 64), jnp.float32)
+    with pytest.raises(ValueError, match="divide"):
+        flash_attention(q, jnp.zeros((1, 256, 2, 64)), jnp.zeros((1, 256, 2, 64)),
+                        block_q=96, interpret=True)
+    with pytest.raises(ValueError, match="mismatch"):
+        flash_attention(q, k, jnp.zeros((1, 200, 4, 64), jnp.float32),
+                        interpret=True)
+    with pytest.raises(ValueError, match="s_q <= s_k"):
+        flash_attention(q, jnp.zeros((1, 128, 2, 64), jnp.float32),
+                        jnp.zeros((1, 128, 2, 64), jnp.float32),
+                        causal=True, interpret=True)
