@@ -83,13 +83,16 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False):
     return out.astype(q.dtype)
 
 
-def ulysses_attention(q, k, v, axis_name: str, causal: bool = False):
+def ulysses_attention(q, k, v, axis_name: str, causal: bool = False,
+                      local: str = "dense", interpret: bool = False):
     """All-to-all sequence parallelism (DeepSpeed-Ulysses style).
 
     Call INSIDE ``shard_map`` with (B, s_local, H, D) blocks; H must divide
-    by the axis size. Re-shards to (B, S_global, H/n, D), runs exact local
-    attention, re-shards back.
-    """
+    by the axis size. Re-shards to (B, S_global, H/n, D), runs local
+    attention over the full gathered sequence, re-shards back.
+    ``local='flash'`` runs that local attention as the Pallas flash kernel
+    (``flash.py``) — at long S the head-sharded score tensor is exactly the
+    HBM blow-up flash avoids; ``'dense'`` stays exact-XLA."""
     import jax.numpy as jnp
     from jax import lax
 
@@ -105,6 +108,11 @@ def ulysses_attention(q, k, v, axis_name: str, causal: bool = False):
                               tiled=True)
 
     qh, kh, vh = to_heads(q), to_heads(k), to_heads(v)  # (B, S, H/n, D)
+    if local == "flash":
+        from .flash import flash_attention
+
+        out = flash_attention(qh, kh, vh, causal=causal, interpret=interpret)
+        return to_seq(out.astype(q.dtype))
     scale = 1.0 / math.sqrt(d)
     s = jnp.einsum("bqhd,bkhd->bqhk", qh.astype(jnp.float32),
                    kh.astype(jnp.float32)) * scale
@@ -120,7 +128,9 @@ def ulysses_attention(q, k, v, axis_name: str, causal: bool = False):
 
 def sequence_sharded_attention(q, k, v, mesh, axis: str = "seq",
                                strategy: str = "ring",
-                               causal: bool = False):
+                               causal: bool = False,
+                               local: str = "dense",
+                               interpret: bool = False):
     """Host-level entry: GLOBAL (B, S, H, D) arrays -> attention output,
     with S sharded over ``mesh`` axis ``axis`` and the chosen strategy's
     collectives over the ICI ring."""
@@ -138,24 +148,31 @@ def sequence_sharded_attention(q, k, v, mesh, axis: str = "seq",
     if strategy == "ulysses" and q.shape[2] % n:
         raise ValueError(f"heads {q.shape[2]} must be divisible by the axis "
                          f"size {n} for ulysses")
-    run = _sharded_attn_fn(mesh, axis, strategy, causal)
+    if local not in ("dense", "flash"):
+        raise ValueError(f"unknown local attention {local!r}")
+    run = _sharded_attn_fn(mesh, axis, strategy, causal, local, interpret)
     sharding = NamedSharding(mesh, P(None, axis, None, None))
     return run(jax.device_put(q, sharding), jax.device_put(k, sharding),
                jax.device_put(v, sharding))
 
 
 @lru_cache(maxsize=64)
-def _sharded_attn_fn(mesh, axis: str, strategy: str, causal: bool):
+def _sharded_attn_fn(mesh, axis: str, strategy: str, causal: bool,
+                     local: str = "dense", interpret: bool = False):
     # cached per (mesh, axis, strategy, causal): a fresh jit closure per call
     # would retrace + recompile on every invocation (per layer / per step)
     import jax
     from jax import shard_map
     from jax.sharding import PartitionSpec as P
 
-    fn = ring_attention if strategy == "ring" else ulysses_attention
+    if strategy == "ring":
+        fn = partial(ring_attention, axis_name=axis, causal=causal)
+    else:
+        fn = partial(ulysses_attention, axis_name=axis, causal=causal,
+                     local=local, interpret=interpret)
     spec = P(None, axis, None, None)
     return jax.jit(shard_map(
-        partial(fn, axis_name=axis, causal=causal),
+        fn,
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
         check_vma=False,
     ))

@@ -154,3 +154,23 @@ def test_flash_attention_shape_errors():
         flash_attention(q, jnp.zeros((1, 128, 2, 64), jnp.float32),
                         jnp.zeros((1, 128, 2, 64), jnp.float32),
                         causal=True, interpret=True)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ulysses_flash_local_matches_dense(mesh, causal):
+    """Ulysses with the Pallas flash kernel as its local attention (through
+    the interpreter on the CPU mesh) must match dense sequence-sharded
+    attention."""
+    from synapseml_tpu.parallel import (dense_attention,
+                                        sequence_sharded_attention)
+
+    rng = np.random.default_rng(9)
+    q = jnp.asarray(rng.normal(size=(2, 512, 8, 64)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(2, 512, 8, 64)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(2, 512, 8, 64)), jnp.float32)
+    ref = dense_attention(q, k, v, causal=causal)
+    out = sequence_sharded_attention(q, k, v, mesh, strategy="ulysses",
+                                     causal=causal, local="flash",
+                                     interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
