@@ -1038,15 +1038,14 @@ def train(params: Dict[str, Any], x: np.ndarray, y: Optional[np.ndarray] = None,
                              "label (GBDTDataset(x, label=y))")
         y = dataset.label_np
         # the dataset's cached device label serves host-built datasets too:
-        # one upload across a whole hyperparameter sweep (mesh fits need the
-        # sharded upload path instead)
-        y_dev_in = dataset.label_device() if mesh is None else None
+        # one upload across a whole hyperparameter sweep. Mesh fits keep it
+        # only in the device-resident branch (which pads/reshards on
+        # device); the host mesh branch pads y in numpy
+        y_dev_in = (dataset.label_device()
+                    if (mesh is None or dataset.is_device) else None)
     if dev_data:
         # device-resident dataset: the raw matrix never crosses to the host
-        if mesh is not None:
-            raise NotImplementedError(
-                "device-resident GBDTDataset under a mesh is not supported; "
-                "build the dataset from numpy for sharded training")
+        # (under a mesh the cached binned buffer reshards device-side)
         if init_booster is not None:
             raise NotImplementedError(
                 "continued training from a device-resident GBDTDataset needs "
@@ -1256,18 +1255,39 @@ def train(params: Dict[str, Any], x: np.ndarray, y: Optional[np.ndarray] = None,
 
         n_shards = mesh.shape[axis]
         pad = (-n) % n_shards
-        if pad:
-            binned_np = np.concatenate([binned_np, binned_np[:pad]], axis=0)
-            y = np.concatenate([y, y[:pad]])
-            w_np = np.concatenate([w_np, np.zeros(pad)])  # zero weight: no effect
-            raw0 = np.concatenate([raw0, raw0[:pad]], axis=0)
-
         data_spec = Pspec(axis)
         dev_put = lambda a, spec: jax.device_put(a, NamedSharding(mesh, spec))
-        binned_d = dev_put(binned_np.astype(bin_dtype), data_spec)
-        y_d = dev_put(y.astype(np.float32), data_spec)
-        w_d = dev_put(w_np.astype(np.float32), data_spec)
-        raw_d = dev_put(raw0.astype(np.float32), data_spec)
+        if dev_data:
+            # device-resident dataset: RESHARD on device (device->device
+            # collective placement, no host round-trip); padding rows wrap
+            # to the front with zero weight
+            def dpad(a, fill_first=True):
+                if pad:
+                    a = jnp.concatenate(
+                        [a, a[:pad] if fill_first else
+                         jnp.zeros((pad,) + a.shape[1:], a.dtype)], axis=0)
+                return a
+            binned_d = dev_put(dpad(dataset.device_binned()), data_spec)
+            y_d = dev_put(dpad(
+                y_dev_in.astype(jnp.float32) if y_dev_in is not None
+                else jnp.asarray(y, jnp.float32)), data_spec)
+            w_d = dev_put(dpad(
+                jnp.ones(n, jnp.float32) if weight is None
+                else (w_dev_in.astype(jnp.float32) if w_dev_in is not None
+                      else jnp.asarray(w_np, jnp.float32)),
+                fill_first=False), data_spec)
+            raw_d = dev_put(dpad(jnp.zeros((n, C), jnp.float32)
+                                 + jnp.asarray(base, jnp.float32)), data_spec)
+        else:
+            if pad:
+                binned_np = np.concatenate([binned_np, binned_np[:pad]], axis=0)
+                y = np.concatenate([y, y[:pad]])
+                w_np = np.concatenate([w_np, np.zeros(pad)])  # zero wt: no-op
+                raw0 = np.concatenate([raw0, raw0[:pad]], axis=0)
+            binned_d = dev_put(binned_np.astype(bin_dtype), data_spec)
+            y_d = dev_put(y.astype(np.float32), data_spec)
+            w_d = dev_put(w_np.astype(np.float32), data_spec)
+            raw_d = dev_put(raw0.astype(np.float32), data_spec)
     else:
         if reuse_dataset:
             binned_d = dataset.device_binned()  # uploaded once, reused
