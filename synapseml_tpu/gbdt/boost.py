@@ -706,6 +706,21 @@ class GBDTBooster:
                      if d.get("cat_set") is not None else None),
         )
 
+    def save_native_model(self) -> str:
+        """LightGBM text-model string a stock LightGBM can load
+        (reference ``saveNativeModel``, ``LightGBMBooster.scala:454``)."""
+        from .native_model import booster_to_native
+
+        return booster_to_native(self)
+
+    @staticmethod
+    def from_native_model(model_str: str) -> "GBDTBooster":
+        """Import a LightGBM text model (reference ``setModelString``) —
+        existing LightGBM models get this engine's device predict path."""
+        from .native_model import booster_from_native
+
+        return booster_from_native(model_str)
+
     def to_json(self) -> str:
         """Model string — reference ``saveNativeModel``/``getNativeModel``
         (``LightGBMBooster.scala:454``)."""

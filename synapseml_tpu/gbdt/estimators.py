@@ -274,10 +274,26 @@ class _LightGBMModelBase(Model):
             out = out.with_column(self.features_shap_col, contrib)
         return out
 
-    def save_native_model(self, path: str) -> None:
-        """Reference ``saveNativeModel`` (``LightGBMModelMethods``)."""
+    def save_native_model(self, path: str, fmt: str = "lightgbm") -> None:
+        """Reference ``saveNativeModel`` (``LightGBMModelMethods``).
+
+        ``fmt='lightgbm'`` writes LightGBM's text model format (loadable by a
+        stock LightGBM); ``'json'`` writes this engine's JSON model string."""
+        if fmt not in ("lightgbm", "json"):
+            raise ValueError(f"fmt must be lightgbm|json, got {fmt!r}")
         with open(path, "w") as f:
-            f.write(self.booster.to_json())
+            f.write(self.booster.save_native_model() if fmt == "lightgbm"
+                    else self.booster.to_json())
+
+    @classmethod
+    def load_native_model(cls, path: str, **params):
+        """Build a model stage from a LightGBM text-model file (reference
+        ``setModelString`` ingestion path)."""
+        from .boost import GBDTBooster
+
+        with open(path) as f:
+            text = f.read()
+        return cls(booster=GBDTBooster.from_native_model(text), **params)
 
     def get_feature_importances(self, importance_type: str = "split") -> np.ndarray:
         return self.booster.feature_importance(importance_type)

@@ -1,0 +1,319 @@
+"""LightGBM native text-model interop.
+
+Reference: ``saveNativeModel``/``setModelString``
+(``lightgbm/.../LightGBMBooster.scala:454``, ``LightGBMModelMethods.scala``) —
+the reference round-trips boosters through LightGBM's text model format. Here
+the format is implemented directly, which buys two-way interop:
+
+- :func:`booster_to_native` exports a trained :class:`GBDTBooster` as
+  LightGBM text a stock LightGBM install can load and predict with;
+- :func:`booster_from_native` imports a real LightGBM text model into a
+  :class:`GBDTBooster`, so existing LightGBM models get this framework's
+  device-resident prediction/serving path.
+
+Structure mapping: this engine's trees are replay lists (split ``s`` turns
+leaf-slot ``parent[s]`` into slots ``(parent[s], s+1)``); LightGBM's are
+pointer trees (``left_child``/``right_child``, negative = ~leaf). The two are
+interconvertible for any binary tree by replaying splits parent-first. Split
+semantics match exactly: numerical ``value <= threshold`` goes left, NaN
+follows the right branch (``missing_type=NaN``, ``default_left=False``).
+Import builds a synthetic :class:`BinMapper` whose per-feature edges are the
+model's own thresholds — ``value <= t`` ⇔ ``bin(value) <= bin(t)`` holds
+exactly, so the binned replay path (device predict included) reproduces the
+pointer-tree decisions bit-for-bit.
+
+v1 scope: numerical splits. Categorical splits (LightGBM bitset thresholds)
+and ``default_left`` missing handling raise with a clear message.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from .binning import BinMapper
+
+__all__ = ["booster_to_native", "booster_from_native"]
+
+# LightGBM decision_type bit field: bit0 categorical, bit1 default_left,
+# bits 2-3 missing_type (0 none, 1 zero, 2 NaN)
+_DT_CATEGORICAL = 1
+_DT_DEFAULT_LEFT = 2
+_DT_MISSING_NAN = 2 << 2
+
+
+def _fmt(v: float) -> str:
+    return repr(float(v))
+
+
+# ---------------------------------------------------------------------------------
+# export
+# ---------------------------------------------------------------------------------
+
+def _replay_to_pointer(parent, feature, threshold, gain, leaf_value,
+                       leaf_hess):
+    """One replay-list tree -> LightGBM pointer arrays (leaves re-indexed
+    densely in slot order)."""
+    steps = [s for s in range(parent.shape[0]) if parent[s] >= 0]
+    if not steps:  # stump: single leaf
+        return dict(num_leaves=1, split_feature=[], split_gain=[],
+                    threshold=[], decision_type=[], left_child=[],
+                    right_child=[], leaf_value=[float(leaf_value[0])],
+                    leaf_weight=[float(leaf_hess[0])])
+    # internal node ids = positions in `steps`; slots -> current tree attach
+    # point: (internal id, 'l'|'r') whose child pointer tracks the slot
+    internal_of_step = {s: i for i, s in enumerate(steps)}
+    left = [0] * len(steps)
+    right = [0] * len(steps)
+    link: Dict[int, tuple] = {}  # slot -> (internal id, side)
+    for i, s in enumerate(steps):
+        p = int(parent[s])
+        if p in link:
+            j, side = link[p]
+            if side == "l":
+                left[j] = i
+            else:
+                right[j] = i
+        link[p] = (i, "l")
+        link[s + 1] = (i, "r")
+    # remaining links are leaves; dense leaf ids in slot order
+    slots = sorted(link)
+    leaf_id = {slot: n for n, slot in enumerate(slots)}
+    for slot, (j, side) in link.items():
+        enc = ~leaf_id[slot]  # LightGBM: negative child = ~leaf index
+        if side == "l":
+            left[j] = enc
+        else:
+            right[j] = enc
+    return dict(
+        num_leaves=len(slots),
+        split_feature=[int(feature[s]) for s in steps],
+        split_gain=[float(gain[s]) for s in steps],
+        threshold=[float(threshold[s]) for s in steps],
+        decision_type=[_DT_MISSING_NAN] * len(steps),
+        left_child=left, right_child=right,
+        leaf_value=[float(leaf_value[slot]) for slot in slots],
+        leaf_weight=[float(leaf_hess[slot]) for slot in slots],
+    )
+
+
+def booster_to_native(booster) -> str:
+    """Serialize a :class:`GBDTBooster` as a LightGBM text model."""
+    if booster.cat_set is not None:
+        raise NotImplementedError(
+            "native-model export of categorical splits (LightGBM bitset "
+            "thresholds) is not supported; use to_json")
+    T, C = booster.parent.shape[:2]
+    d = booster.mapper.n_features or (int(booster.feature.max()) + 1
+                                      if booster.feature.size else 1)
+    names = booster.feature_names or [f"Column_{j}" for j in range(d)]
+    obj = {"binary": "binary sigmoid:1",
+           "multiclass": "multiclass num_class:%d" % booster.num_class,
+           "softmax": "multiclass num_class:%d" % booster.num_class,
+           "regression": "regression",
+           }.get(booster.objective, booster.objective)
+    rf = booster.boosting == "rf"
+    lines = [
+        "tree",
+        "version=v3",
+        f"num_class={booster.num_class}",
+        f"num_tree_per_iteration={booster.num_class}",
+        "label_index=0",
+        f"max_feature_idx={d - 1}",
+        f"objective={obj}",
+        "feature_names=" + " ".join(names),
+        "feature_infos=" + " ".join(["[-inf:inf]"] * d),
+    ]
+    if rf:
+        lines.append("average_output")
+    lines.append("")
+
+    for t in range(booster.num_trees):
+        for c in range(C):
+            tree = _replay_to_pointer(
+                booster.parent[t, c], booster.feature[t, c],
+                booster.threshold[t, c], booster.gain[t, c],
+                booster.leaf_value[t, c], booster.leaf_hess[t, c])
+            # fold shrinkage/dart scale into leaf values; fold base_score in
+            # (first tree per class normally; EVERY tree under rf averaging)
+            sc = float(booster.tree_scale[t])
+            add = float(booster.base_score[c]) if (t == 0 or rf) else 0.0
+            vals = [v * sc + add for v in tree["leaf_value"]]
+            lines += [
+                f"Tree={t * C + c}",
+                f"num_leaves={tree['num_leaves']}",
+                "num_cat=0",
+                "split_feature=" + " ".join(map(str, tree["split_feature"])),
+                "split_gain=" + " ".join(map(_fmt, tree["split_gain"])),
+                "threshold=" + " ".join(map(_fmt, tree["threshold"])),
+                "decision_type=" + " ".join(map(str, tree["decision_type"])),
+                "left_child=" + " ".join(map(str, tree["left_child"])),
+                "right_child=" + " ".join(map(str, tree["right_child"])),
+                "leaf_value=" + " ".join(map(_fmt, vals)),
+                "leaf_weight=" + " ".join(map(_fmt, tree["leaf_weight"])),
+                "shrinkage=1",
+                "",
+            ]
+    lines += ["end of trees", ""]
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------------
+# import
+# ---------------------------------------------------------------------------------
+
+def _parse_kv(block: List[str]) -> Dict[str, str]:
+    out = {}
+    for line in block:
+        if "=" in line:
+            k, _, v = line.partition("=")
+            out[k.strip()] = v.strip()
+        elif line.strip():
+            out[line.strip()] = ""
+    return out
+
+
+def _pointer_to_replay(num_leaves, split_feature, threshold, split_gain,
+                       left_child, right_child, leaf_value, leaf_weight,
+                       max_leaves):
+    """Pointer tree -> replay arrays sized to ``max_leaves`` slots."""
+    L1 = max_leaves - 1
+    parent = np.full(L1, -1, np.int32)
+    feat = np.zeros(L1, np.int32)
+    thr = np.zeros(L1, np.float64)
+    gain = np.zeros(L1, np.float32)
+    lv = np.zeros(max_leaves, np.float32)
+    lh = np.zeros(max_leaves, np.float32)
+    if num_leaves == 1:
+        lv[0] = leaf_value[0]
+        lh[0] = leaf_weight[0] if leaf_weight is not None else 0.0
+        return parent, feat, thr, gain, lv, lh
+    # replay order: walk internal nodes parent-first (BFS from root node 0);
+    # slot bookkeeping inverts the export mapping
+    slot_of_node = {0: 0}  # internal node -> slot it currently splits
+    order: List[int] = []
+    queue = [0]
+    while queue:
+        nd = queue.pop(0)
+        order.append(nd)
+        s = len(order) - 1  # replay step index
+        p_slot = slot_of_node[nd]
+        parent[s] = p_slot
+        feat[s] = split_feature[nd]
+        thr[s] = threshold[nd]
+        gain[s] = split_gain[nd] if split_gain is not None else 0.0
+        for child, child_slot in ((left_child[nd], p_slot),
+                                  (right_child[nd], s + 1)):
+            if child >= 0:
+                slot_of_node[child] = child_slot
+                queue.append(child)
+            else:
+                leaf = ~child if child < 0 else child
+                lv[child_slot] = leaf_value[leaf]
+                if leaf_weight is not None:
+                    lh[child_slot] = leaf_weight[leaf]
+    return parent, feat, thr, gain, lv, lh
+
+
+def booster_from_native(model_str: str):
+    """Parse a LightGBM text model into a :class:`GBDTBooster`."""
+    from .boost import GBDTBooster
+
+    text = model_str.replace("\r\n", "\n")
+    if not text.lstrip().startswith("tree"):
+        raise ValueError("not a LightGBM text model (missing 'tree' header)")
+    body = text.split("end of trees")[0]
+    chunks = body.split("Tree=")
+    header = _parse_kv(chunks[0].splitlines())
+    num_class = int(header.get("num_class", 1))
+    per_iter = int(header.get("num_tree_per_iteration", num_class))
+    d = int(header["max_feature_idx"]) + 1
+    obj_field = header.get("objective", "regression").split()
+    objective = {"binary": "binary", "multiclass": "multiclass",
+                 "multiclassova": "multiclass",
+                 "regression_l1": "l1"}.get(obj_field[0], obj_field[0])
+    average_output = "average_output" in header
+    feature_names = (header.get("feature_names") or "").split() or None
+
+    trees = []
+    for chunk in chunks[1:]:
+        kv = _parse_kv(chunk.splitlines())
+        nl = int(kv["num_leaves"])
+        if int(kv.get("num_cat", "0")):
+            raise NotImplementedError(
+                "categorical splits in native models are not supported yet")
+        ints = lambda key: [int(x) for x in kv.get(key, "").split()]
+        flts = lambda key: ([float(x) for x in kv.get(key, "").split()]
+                            or None)
+        dts = ints("decision_type")
+        if any(dt & _DT_CATEGORICAL for dt in dts):
+            raise NotImplementedError("categorical decision_type")
+        if any(dt & _DT_DEFAULT_LEFT for dt in dts):
+            raise NotImplementedError(
+                "default_left missing handling is not supported (this "
+                "engine routes missing values right)")
+        trees.append(dict(
+            num_leaves=nl, split_feature=ints("split_feature"),
+            threshold=flts("threshold") or [],
+            split_gain=flts("split_gain"),
+            left_child=ints("left_child"), right_child=ints("right_child"),
+            leaf_value=flts("leaf_value") or [0.0],
+            leaf_weight=flts("leaf_weight")))
+    if not trees:
+        raise ValueError("model has no trees")
+    if len(trees) % per_iter:
+        raise ValueError(f"{len(trees)} trees not divisible by "
+                         f"num_tree_per_iteration={per_iter}")
+
+    # synthetic BinMapper: per-feature edges = the model's own thresholds,
+    # so 'value <= t' == 'bin(value) <= bin(t)' exactly
+    thr_by_feat: List[set] = [set() for _ in range(d)]
+    for tr in trees:
+        for f, t in zip(tr["split_feature"], tr["threshold"]):
+            thr_by_feat[f].add(float(t))
+    mapper = BinMapper(max_bin=max(
+        2, max((len(s) + 1) for s in thr_by_feat)))
+    mapper.upper_edges = [
+        np.concatenate([np.sort(np.array(sorted(s), np.float64)), [np.inf]])
+        for s in thr_by_feat]
+    mapper.n_features = d
+    # missing bin must exceed every real bin id: max_bin covers edges count
+    mapper.max_bin = max(len(e) for e in mapper.upper_edges)
+
+    T = len(trees) // per_iter
+    C = per_iter
+    max_leaves = max(tr["num_leaves"] for tr in trees)
+    max_leaves = max(max_leaves, 2)
+    shape1 = (T, C, max_leaves - 1)
+    parent = np.full(shape1, -1, np.int32)
+    feature = np.zeros(shape1, np.int32)
+    threshold = np.zeros(shape1, np.float64)
+    bin_ = np.zeros(shape1, np.int32)
+    gain = np.zeros(shape1, np.float32)
+    leaf_value = np.zeros((T, C, max_leaves), np.float32)
+    leaf_hess = np.zeros((T, C, max_leaves), np.float32)
+    for idx, tr in enumerate(trees):
+        t, c = divmod(idx, C)
+        (parent[t, c], feature[t, c], threshold[t, c], gain[t, c],
+         leaf_value[t, c], leaf_hess[t, c]) = _pointer_to_replay(
+            tr["num_leaves"], tr["split_feature"], tr["threshold"],
+            tr["split_gain"], tr["left_child"], tr["right_child"],
+            tr["leaf_value"], tr["leaf_weight"], max_leaves)
+    # bins for each split = position of its threshold in the feature's edges
+    for t in range(T):
+        for c in range(C):
+            for s in range(max_leaves - 1):
+                if parent[t, c, s] >= 0:
+                    f = feature[t, c, s]
+                    bin_[t, c, s] = int(np.searchsorted(
+                        mapper.upper_edges[f], threshold[t, c, s]))
+    return GBDTBooster(
+        mapper=mapper, objective=objective, num_class=num_class,
+        base_score=np.zeros(num_class),
+        parent=parent, feature=feature, threshold=threshold, bin_=bin_,
+        gain=gain, leaf_value=leaf_value, leaf_hess=leaf_hess,
+        tree_scale=np.ones(T, np.float64),
+        boosting="rf" if average_output else "gbdt",
+        feature_names=feature_names,
+    )

@@ -1,0 +1,133 @@
+"""LightGBM native text-model interop (reference saveNativeModel /
+setModelString, LightGBMBooster.scala:454)."""
+
+import numpy as np
+import pytest
+
+from synapseml_tpu.gbdt import GBDTBooster, train
+
+
+@pytest.fixture(scope="module")
+def data():
+    rng = np.random.default_rng(3)
+    x = rng.normal(size=(3000, 6))
+    y = (x[:, 0] - 0.8 * x[:, 2] > 0).astype(float)
+    yr = x[:, 0] * 2 + x[:, 1]
+    return x, y, yr
+
+
+@pytest.mark.parametrize("cfg", [
+    {"objective": "binary", "num_iterations": 12},
+    {"objective": "regression", "num_iterations": 8},
+    {"objective": "multiclass", "num_class": 3, "num_iterations": 6},
+    {"objective": "binary", "boosting": "dart", "num_iterations": 10,
+     "drop_rate": 0.4, "skip_drop": 0.0},
+    {"objective": "binary", "boosting": "rf", "num_iterations": 6,
+     "bagging_fraction": 0.6, "bagging_freq": 1},
+])
+def test_native_roundtrip_predictions(data, cfg):
+    x, y, yr = data
+    if cfg["objective"] == "multiclass":
+        target = np.digitize(x[:, 0], [-0.5, 0.5]).astype(float)
+    elif cfg["objective"] == "regression":
+        target = yr
+    else:
+        target = y
+    b = train({"num_leaves": 15, "max_bin": 63, **cfg}, x, target)
+    text = b.save_native_model()
+    assert text.startswith("tree\n") and "end of trees" in text
+    b2 = GBDTBooster.from_native_model(text)
+    np.testing.assert_allclose(b2.raw_predict(x), b.raw_predict(x),
+                               rtol=1e-5, atol=1e-5)
+    # imported boosters run the device predict path too
+    np.testing.assert_allclose(
+        np.asarray(b2.raw_predict(x, backend="device"), np.float64),
+        b.raw_predict(x), rtol=1e-5, atol=1e-5)
+
+
+def test_import_handwritten_lightgbm_text():
+    """A hand-written model in real LightGBM dump style (extra per-tree
+    fields, scientific notation, CRLF) must import and predict exactly."""
+    text = "\r\n".join([
+        "tree",
+        "version=v3",
+        "num_class=1",
+        "num_tree_per_iteration=1",
+        "label_index=0",
+        "max_feature_idx=1",
+        "objective=binary sigmoid:1",
+        "feature_names=f0 f1",
+        "feature_infos=[-5:5] [-5:5]",
+        "",
+        "Tree=0",
+        "num_leaves=3",
+        "num_cat=0",
+        "split_feature=0 1",
+        "split_gain=10 5",
+        "threshold=1.5 -2.0000000000000001e-01",
+        "decision_type=8 8",
+        "left_child=1 -1",
+        "right_child=-3 -2",
+        "leaf_value=-0.5 2.5e-01 0.75",
+        "leaf_weight=10 12 8",
+        "leaf_count=10 12 8",
+        "internal_value=0 0.1",
+        "internal_weight=30 22",
+        "internal_count=30 22",
+        "is_linear=0",
+        "shrinkage=0.1",
+        "",
+        "end of trees",
+        "",
+        "feature_importances:",
+        "f0=10",
+        "",
+        "parameters:",
+        "[boosting: gbdt]",
+        "end of parameters",
+    ])
+    b = GBDTBooster.from_native_model(text)
+    # tree: f0 <= 1.5 ? (f1 <= -0.2 ? leaf0(-0.5) : leaf1(0.25)) : leaf2(0.75)
+    x = np.array([[0.0, -1.0],   # left, left  -> -0.5
+                  [0.0, 0.0],    # left, right ->  0.25
+                  [2.0, 9.9],    # right       ->  0.75
+                  [1.5, -0.2],   # boundary: <= goes left/left -> -0.5
+                  [np.nan, 0.0]])  # missing -> right -> 0.75
+    np.testing.assert_allclose(b.raw_predict(x),
+                               [-0.5, 0.25, 0.75, -0.5, 0.75], atol=1e-7)
+    assert b.feature_names == ["f0", "f1"]
+
+
+def test_native_model_unsupported_cases(data):
+    x, y, _ = data
+    xc = x.copy()
+    xc[:, 1] = np.random.default_rng(0).integers(0, 4, len(x))
+    b_cat = train({"objective": "binary", "num_iterations": 3,
+                   "categorical_feature": [1], "max_bin": 15}, xc, y)
+    with pytest.raises(NotImplementedError, match="categorical"):
+        b_cat.save_native_model()
+    bad = "tree\nnum_class=1\nmax_feature_idx=0\n\nTree=0\nnum_leaves=2\n" \
+          "num_cat=0\nsplit_feature=0\nthreshold=0\ndecision_type=3\n" \
+          "left_child=-1\nright_child=-2\nleaf_value=0 1\n\nend of trees\n"
+    with pytest.raises(NotImplementedError, match="categorical"):
+        GBDTBooster.from_native_model(bad)
+    with pytest.raises(ValueError, match="text model"):
+        GBDTBooster.from_native_model("{json}")
+
+
+def test_model_stage_native_save_load(data, tmp_path):
+    from synapseml_tpu import Table
+    from synapseml_tpu.gbdt import LightGBMClassifier
+    from synapseml_tpu.gbdt.estimators import LightGBMClassificationModel
+
+    x, y, _ = data
+    m = LightGBMClassifier(num_iterations=8, max_bin=63).fit(
+        Table({"features": x, "label": y}))
+    p = str(tmp_path / "model.txt")
+    m.save_native_model(p)
+    assert open(p).read().startswith("tree\n")
+    m2 = LightGBMClassificationModel.load_native_model(p)
+    t = Table({"features": x})
+    np.testing.assert_allclose(np.asarray(m2.transform(t)["probability"]),
+                               np.asarray(m.transform(t)["probability"]),
+                               rtol=1e-5, atol=1e-5)
