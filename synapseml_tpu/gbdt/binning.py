@@ -36,6 +36,11 @@ class BinMapper:
                  max_bin_by_feature: Optional[List[int]] = None):
         if max_bin < 2:
             raise ValueError(f"max_bin must be >= 2, got {max_bin}")
+        if sample_cnt < 1:
+            # an empty sample fits [inf]-only edges for every feature and the
+            # model silently degenerates (LightGBM rejects
+            # bin_construct_sample_cnt <= 0 the same way)
+            raise ValueError(f"sample_cnt must be >= 1, got {sample_cnt}")
         self.max_bin = int(max_bin)
         self.sample_cnt = int(sample_cnt)
         self.seed = seed

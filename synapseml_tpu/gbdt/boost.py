@@ -743,6 +743,20 @@ class GBDTBooster:
         })
 
     @staticmethod
+    def from_model_string(s: str) -> "GBDTBooster":
+        """Load a model string in either supported format, sniffing which.
+
+        Accepts this engine's JSON model string or LightGBM's text format
+        (``tree\\nversion=v3...``) — mirroring the reference's
+        ``setModelString`` (``TrainUtils.scala:30-32``), which accepts
+        whatever ``saveNativeModel`` produced without the caller declaring
+        the format."""
+        head = s.lstrip()[:1]
+        if head == "{":
+            return GBDTBooster.from_json(s)
+        return GBDTBooster.from_native_model(s)
+
+    @staticmethod
     def from_json(s: str) -> "GBDTBooster":
         d = json.loads(s)
         if d.get("format") != "synapseml_tpu.gbdt.v1":

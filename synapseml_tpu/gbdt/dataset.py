@@ -41,7 +41,9 @@ class GBDTDataset:
 
     def __init__(self, x, *, label=None, max_bin: int = 255, seed: int = 0,
                  categorical_features: Optional[Sequence[int]] = None,
-                 feature_names: Optional[List[str]] = None):
+                 feature_names: Optional[List[str]] = None,
+                 bin_sample_count: int = 200_000,
+                 max_bin_by_feature: Optional[List[int]] = None):
         try:
             import jax
             is_device = isinstance(x, jax.Array)
@@ -75,7 +77,9 @@ class GBDTDataset:
             # fit edges on a bounded host-side sample — the SAME rows
             # BinMapper.fit would subsample (sample_indices is the single
             # source of truth); the full matrix never leaves the device
-            self.mapper = BinMapper(max_bin=self.max_bin, seed=int(seed))
+            self.mapper = BinMapper(max_bin=self.max_bin, seed=int(seed),
+                                    sample_cnt=int(bin_sample_count),
+                                    max_bin_by_feature=max_bin_by_feature)
             idx = self.mapper.sample_indices(n)
             if idx is not None:
                 sample = np.asarray(jnp.take(x, jnp.asarray(np.sort(idx)),
@@ -94,7 +98,9 @@ class GBDTDataset:
         if self.x.ndim != 2:
             raise ValueError(f"x must be (n, d), got shape {self.x.shape}")
         self.mapper = BinMapper(
-            max_bin=self.max_bin, seed=int(seed), categorical_features=cats
+            max_bin=self.max_bin, seed=int(seed), categorical_features=cats,
+            sample_cnt=int(bin_sample_count),
+            max_bin_by_feature=max_bin_by_feature,
         ).fit(self.x)
         self.binned_np = self.mapper.transform(self.x)
         self.bin_dtype = bin_dtype(self.mapper.n_bins)

@@ -287,13 +287,14 @@ class _LightGBMModelBase(Model):
 
     @classmethod
     def load_native_model(cls, path: str, **params):
-        """Build a model stage from a LightGBM text-model file (reference
-        ``setModelString`` ingestion path)."""
+        """Build a model stage from a saved model file — LightGBM text or
+        this engine's JSON, sniffed (reference ``setModelString`` ingestion
+        path accepts whatever ``saveNativeModel`` wrote)."""
         from .boost import GBDTBooster
 
         with open(path) as f:
             text = f.read()
-        return cls(booster=GBDTBooster.from_native_model(text), **params)
+        return cls(booster=GBDTBooster.from_model_string(text), **params)
 
     def get_feature_importances(self, importance_type: str = "split") -> np.ndarray:
         return self.booster.feature_importance(importance_type)

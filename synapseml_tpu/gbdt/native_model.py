@@ -278,8 +278,6 @@ def booster_from_native(model_str: str):
         np.concatenate([np.sort(np.array(sorted(s), np.float64)), [np.inf]])
         for s in thr_by_feat]
     mapper.n_features = d
-    # missing bin must exceed every real bin id: max_bin covers edges count
-    mapper.max_bin = max(len(e) for e in mapper.upper_edges)
 
     T = len(trees) // per_iter
     C = per_iter

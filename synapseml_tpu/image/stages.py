@@ -203,6 +203,15 @@ class UnrollBinaryImage(Transformer):
     def _transform(self, table: Table) -> Table:
         from ..io.binary import decode_image
 
+        if (self.width is None) != (self.height is None):
+            raise ValueError(
+                f"UnrollBinaryImage({self.uid}): width and height must be "
+                "set together to resize (got width="
+                f"{self.width}, height={self.height})")
+        if self.width is not None and (self.width <= 0 or self.height <= 0):
+            raise ValueError(
+                f"UnrollBinaryImage({self.uid}): width/height must be "
+                f"positive (got {self.width}x{self.height})")
         self._validate_input(table, self.input_col)
         col = table[self.input_col]
         n = table.num_rows
@@ -217,7 +226,7 @@ class UnrollBinaryImage(Transformer):
             except Exception:
                 decoded.append(None)
                 continue
-            if self.width and self.height:
+            if self.width is not None:
                 img = np.asarray(iops.resize(
                     np.asarray(img, np.float32)[None], self.height,
                     self.width))[0]

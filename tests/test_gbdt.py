@@ -447,12 +447,14 @@ def test_native_model_string(data, tmp_path):
     x, y, _, _ = data
     t = Table({"features": x[:500], "label": y[:500]})
     m = LightGBMClassifier(num_iterations=5, num_leaves=7).fit(t)
-    path = str(tmp_path / "model.txt")
-    m.save_native_model(path)
-    b = GBDTBooster.from_json(open(path).read())
-    np.testing.assert_allclose(b.predict(x[:50]),
-                               np.asarray(m.transform(Table({"features": x[:50]}))
-                                          ["probability"])[:, 1], rtol=1e-5)
+    for fmt in ("lightgbm", "json"):
+        path = str(tmp_path / f"model.{fmt}")
+        m.save_native_model(path, fmt=fmt)
+        # from_model_string sniffs the format — both files load transparently
+        b = GBDTBooster.from_model_string(open(path).read())
+        np.testing.assert_allclose(b.predict(x[:50]),
+                                   np.asarray(m.transform(Table({"features": x[:50]}))
+                                              ["probability"])[:, 1], rtol=1e-5)
 
 
 def test_sample_weights_not_squared():
