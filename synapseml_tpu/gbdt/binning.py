@@ -133,6 +133,25 @@ class BinMapper:
         self.n_features = d
         return self
 
+    def transform_column(self, j: int, col: np.ndarray) -> np.ndarray:
+        """Bin one feature's raw values (NaN/unseen-category -> missing bin)."""
+        if j in self.cat_values:
+            vals = self.cat_values[j]
+            idx = np.searchsorted(vals, col)
+            idx = np.clip(idx, 0, max(len(vals) - 1, 0))
+            known = np.isfinite(col) & (len(vals) > 0)
+            if len(vals):
+                known &= vals[idx] == col
+            return np.where(known, idx, self.missing_bin).astype(np.int32)
+        out = np.searchsorted(self.upper_edges[j], col,
+                              side="left").astype(np.int32)
+        # +inf searches past the last edge; clamp, then stamp NaN into its bin
+        np.clip(out, 0, len(self.upper_edges[j]) - 1, out=out)
+        miss = ~np.isfinite(col)
+        if miss.any():
+            out[miss] = self.missing_bin
+        return out
+
     def transform(self, x: np.ndarray) -> np.ndarray:
         """Float matrix -> int32 bin matrix (NaN -> missing bin)."""
         if self.upper_edges is None:
@@ -143,26 +162,148 @@ class BinMapper:
             raise ValueError(f"expected {self.n_features} features, got {d}")
         out = np.empty((n, d), dtype=np.int32)
         for j in range(d):
-            col = x[:, j]
-            if j in self.cat_values:
-                vals = self.cat_values[j]
-                idx = np.searchsorted(vals, col)
-                idx = np.clip(idx, 0, max(len(vals) - 1, 0))
-                known = np.isfinite(col) & (len(vals) > 0)
-                if len(vals):
-                    known &= vals[idx] == col
-                out[:, j] = np.where(known, idx, self.missing_bin)
-                continue
-            out[:, j] = np.searchsorted(self.upper_edges[j], col, side="left")
-            miss = ~np.isfinite(col)
-            # +inf searches past the last edge; clamp, then stamp NaN into its bin
-            np.clip(out[:, j], 0, len(self.upper_edges[j]) - 1, out=out[:, j])
-            if miss.any():
-                out[miss, j] = self.missing_bin
+            out[:, j] = self.transform_column(j, x[:, j])
         return out
 
     def fit_transform(self, x: np.ndarray) -> np.ndarray:
         return self.fit(x).transform(x)
+
+    # -- sparse (CSR) ----------------------------------------------------------
+    #
+    # Reference: SynapseML's sparse dataset path builds the native LightGBM
+    # Dataset from CSR chunks (``DatasetAggregator.scala:84,143-148``); the
+    # implicit zeros participate in bin-edge estimation there exactly as they
+    # do here (LightGBM samples values per feature *including* zero counts).
+
+    @property
+    def realized_n_bins(self) -> int:
+        """Compact bin count: max realized edges over features + the missing
+        bin. Sparse training histograms size their bin axis by this instead
+        of ``max_bin + 1`` — hashed count/tf-idf features typically realize a
+        handful of distinct values, so the (d, B, 3) transient stays small
+        even at d = 2^18."""
+        if self.upper_edges is None:
+            raise RuntimeError("realized_n_bins before fit")
+        mx = max((len(e) for e in self.upper_edges), default=1)
+        if self.cat_values:
+            mx = max(mx, max(len(v) for v in self.cat_values.values()))
+        return max(mx, 2) + 1
+
+    def zero_bins(self, compact: bool = False) -> np.ndarray:
+        """(d,) bin id of value 0.0 per feature — the implicit-entry bin for
+        sparse data. ``compact=True`` caps the missing bin at
+        ``realized_n_bins - 1`` (sparse training's compact bin space)."""
+        if self.upper_edges is None:
+            raise RuntimeError("zero_bins before fit")
+        out = np.empty(self.n_features, dtype=np.int32)
+        for j, e in enumerate(self.upper_edges):
+            if j in self.cat_values:
+                vals = self.cat_values[j]
+                pos = int(np.searchsorted(vals, 0.0))
+                if pos < len(vals) and vals[pos] == 0.0:
+                    out[j] = pos
+                else:
+                    out[j] = (self.realized_n_bins - 1 if compact
+                              else self.missing_bin)
+                continue
+            out[j] = min(int(np.searchsorted(e, 0.0, side="left")), len(e) - 1)
+        return out
+
+    def fit_csr(self, csr) -> "BinMapper":
+        """Fit edges from a CSR matrix without densifying.
+
+        Per feature the value distribution is its stored entries plus
+        ``rows - nnz_j`` implicit zeros; quantile edges are computed as
+        weighted quantiles with the zero mass folded in as one weighted
+        point. Distinct-value features (the common case for hashed
+        counts) get the exact per-value bins of the dense path."""
+        if self.categorical_features:
+            raise NotImplementedError(
+                "categorical features are not supported for sparse input "
+                "(hash them through the featurizer instead)")
+        n, d = csr.shape
+        if self.max_bin_by_feature and len(self.max_bin_by_feature) != d:
+            raise ValueError(
+                f"max_bin_by_feature has {len(self.max_bin_by_feature)} "
+                f"entries for {d} features")
+        idx = self.sample_indices(n)
+        s = csr if idx is None else csr.take_rows(np.sort(idx))
+        s_n = s.shape[0]
+        order = s.tocsc_order()
+        cols_sorted = s.indices[order]
+        vals_sorted = s.values[order]
+        # per-feature slices of the CSC-ordered value array
+        starts = np.searchsorted(cols_sorted, np.arange(d + 1))
+        edges: List[np.ndarray] = [None] * d
+        self.cat_values = {}
+        zero_edge = np.array([np.inf])
+        for j in range(d):
+            lo, hi = starts[j], starts[j + 1]
+            col = vals_sorted[lo:hi]
+            col = col[np.isfinite(col)]
+            n_zero_implicit = s_n - (hi - lo)
+            if col.size == 0:
+                edges[j] = zero_edge  # all-zero feature: single bin
+                continue
+            fmb = self._feature_max_bin(j)
+            uniq = np.unique(col)
+            if n_zero_implicit > 0 and not (
+                    uniq.size and np.searchsorted(uniq, 0.0) < uniq.size
+                    and uniq[np.searchsorted(uniq, 0.0)] == 0.0):
+                uniq = np.sort(np.append(uniq, 0.0))
+            if len(uniq) <= fmb:
+                ue = np.empty(len(uniq))
+                ue[:-1] = (uniq[:-1] + uniq[1:]) / 2
+                ue[-1] = np.inf
+                edges[j] = ue
+            else:
+                # weighted quantiles: sorted nnz values, zero mass folded in
+                sv = np.sort(col)
+                w = np.ones(len(sv))
+                if n_zero_implicit > 0:
+                    pos = np.searchsorted(sv, 0.0)
+                    sv = np.insert(sv, pos, 0.0)
+                    w = np.insert(w, pos, n_zero_implicit)
+                cw = np.cumsum(w)
+                targets = np.linspace(0, 1, fmb + 1)[1:-1] * cw[-1]
+                take = np.searchsorted(cw, targets, side="left")
+                qs = sv[np.clip(take, 0, len(sv) - 1)]
+                edges[j] = np.concatenate([np.unique(qs), [np.inf]])
+        self.upper_edges = edges
+        self.n_features = d
+        return self
+
+    def transform_csr(self, csr) -> np.ndarray:
+        """(nnz,) int32 bin id per stored entry (NaN -> missing bin).
+
+        Column-grouped searchsorted over the CSC ordering; only columns that
+        actually carry entries pay anything."""
+        if self.upper_edges is None:
+            raise RuntimeError("BinMapper.transform_csr called before fit")
+        n, d = csr.shape
+        if d != self.n_features:
+            raise ValueError(f"expected {self.n_features} features, got {d}")
+        order = csr.tocsc_order()
+        cols_sorted = csr.indices[order]
+        vals_sorted = csr.values[order]
+        out_sorted = np.empty(len(order), dtype=np.int32)
+        # boundaries of each present column's run
+        cuts = np.flatnonzero(np.diff(cols_sorted)) + 1
+        run_starts = np.concatenate([[0], cuts])
+        run_ends = np.concatenate([cuts, [len(cols_sorted)]])
+        for lo, hi in zip(run_starts, run_ends):
+            if hi == lo:
+                continue
+            j = int(cols_sorted[lo])
+            e = self.upper_edges[j]
+            seg = vals_sorted[lo:hi]
+            b = np.searchsorted(e, seg, side="left")
+            np.clip(b, 0, len(e) - 1, out=b)
+            b[~np.isfinite(seg)] = self.missing_bin
+            out_sorted[lo:hi] = b
+        out = np.empty(len(order), dtype=np.int32)
+        out[order] = out_sorted
+        return out
 
     def bin_upper_value(self, feature: int, b: np.ndarray) -> np.ndarray:
         """Raw-value threshold for split 'bin <= b' (used by tree predict on raw x).

@@ -425,9 +425,38 @@ class GBDTBooster:
         branch, matching the float-threshold semantics)."""
         return self.mapper.transform(np.asarray(x, dtype=np.float64))
 
-    def _leaf_of_binned(self, binned: np.ndarray, t: int, c: int) -> np.ndarray:
+    def _csr_used_binned(self, csr, T: int):
+        """Bin ONLY the features the first ``T`` trees reference — the CSR
+        predict path (reference ``predictForCSR``,
+        ``LightGBMBooster.scala:510``). At hashed-text width the full (n, d)
+        bin matrix is unbuildable, but trees touch at most T*(L-1) distinct
+        features: densify that submatrix (implicit entries are true zeros)
+        and remap tree feature ids into it. Returns ``(binned, feats)``."""
+        n, d = csr.shape
+        if d != self.mapper.n_features:
+            raise ValueError(f"expected {self.mapper.n_features} features, "
+                             f"got {d}")
+        F = np.unique(self.feature[:T]) if T else np.zeros(1, np.int64)
+        order = csr.tocsc_order()
+        cols_sorted = csr.indices[order]
+        rows_sorted = csr.row_ids()[order]
+        vals_sorted = csr.values[order]
+        sub = np.zeros((n, len(F)), np.float64)
+        lo = np.searchsorted(cols_sorted, F, side="left")
+        hi = np.searchsorted(cols_sorted, F, side="right")
+        for k in range(len(F)):
+            sub[rows_sorted[lo[k]:hi[k]], k] = vals_sorted[lo[k]:hi[k]]
+        binned = np.empty((n, len(F)), dtype=np.int32)
+        for k, j in enumerate(F):
+            binned[:, k] = self.mapper.transform_column(int(j), sub[:, k])
+        feats = np.searchsorted(F, self.feature[:T]).astype(np.int32)
+        return binned, feats
+
+    def _leaf_of_binned(self, binned: np.ndarray, t: int, c: int,
+                        feature: Optional[np.ndarray] = None) -> np.ndarray:
         node = np.zeros(binned.shape[0], dtype=np.int32)
-        par, feat, bins = self.parent[t, c], self.feature[t, c], self.bin[t, c]
+        par, bins = self.parent[t, c], self.bin[t, c]
+        feat = self.feature[t, c] if feature is None else feature[t, c]
         cat = self.cat_set[t, c] if self.cat_set is not None else None
         for s in range(par.shape[0]):
             p = par[s]
@@ -454,10 +483,19 @@ class GBDTBooster:
         ``LightGBMBooster.scala:510,529``), 'host' uses the numpy loop, 'auto'
         picks by batch size.
         """
-        x = np.asarray(x, dtype=np.float64)
+        from .sparse import as_csr, is_sparse_input
+
         T = self._used_trees(num_iteration)
-        n = x.shape[0]
-        binned = self._binned(x)
+        if is_sparse_input(x):
+            # reference predictForCSR: score sparse vectors directly
+            csr = as_csr(x)
+            n = csr.shape[0]
+            binned, feats = self._csr_used_binned(csr, T)
+        else:
+            x = np.asarray(x, dtype=np.float64)
+            n = x.shape[0]
+            binned = self._binned(x)
+            feats = None
         base = np.tile(self.base_score, (n, 1)).astype(np.float64)
         if T == 0:
             out = base
@@ -465,7 +503,8 @@ class GBDTBooster:
             from .device_predict import device_raw_scores
 
             scores = device_raw_scores(
-                binned, self.parent[:T], self.feature[:T], self.bin[:T],
+                binned, self.parent[:T],
+                self.feature[:T] if feats is None else feats, self.bin[:T],
                 self.leaf_value[:T], self.tree_scale[:T],
                 self.cat_set[:T] if self.cat_set is not None else None)
             out = base + np.asarray(scores, np.float64)
@@ -474,7 +513,7 @@ class GBDTBooster:
             for t in range(T):
                 sc = self.tree_scale[t]
                 for c in range(self.num_class):
-                    leaf = self._leaf_of_binned(binned, t, c)
+                    leaf = self._leaf_of_binned(binned, t, c, feature=feats)
                     out[:, c] += self.leaf_value[t, c][leaf] * sc
         if self.boosting == "rf" and T > 0:
             out = np.tile(self.base_score, (n, 1)) + (out - base) / T
@@ -485,7 +524,11 @@ class GBDTBooster:
 
         Reference: ``LightGBMBooster.score`` (``LightGBMBooster.scala:327``).
         """
-        raw = self.raw_predict(x, num_iteration)
+        return self.activate(self.raw_predict(x, num_iteration))
+
+    def activate(self, raw: np.ndarray) -> np.ndarray:
+        """Objective link function over a raw margin (callers that already
+        hold ``raw_predict`` output skip a full second scoring pass)."""
         if self.objective == "binary":
             return np.where(raw >= 0, 1 / (1 + np.exp(-np.abs(raw))),
                             np.exp(-np.abs(raw)) / (1 + np.exp(-np.abs(raw))))
@@ -550,15 +593,24 @@ class GBDTBooster:
     def predict_leaf(self, x: np.ndarray, num_iteration: Optional[int] = None,
                      backend: str = "auto") -> np.ndarray:
         """Leaf index per (row, tree*class) — reference ``predictLeaf``."""
-        x = np.asarray(x, dtype=np.float64)
+        from .sparse import as_csr, is_sparse_input
+
         T = self._used_trees(num_iteration)
-        n = x.shape[0]
-        binned = self._binned(x)
+        if is_sparse_input(x):
+            csr = as_csr(x)
+            n = csr.shape[0]
+            binned, feats = self._csr_used_binned(csr, T)
+        else:
+            x = np.asarray(x, dtype=np.float64)
+            n = x.shape[0]
+            binned = self._binned(x)
+            feats = None
         if T and (backend == "device" or (backend == "auto" and n * T >= 2048)):
             from .device_predict import device_leaf_indices
 
             leaves = device_leaf_indices(
-                binned, self.parent[:T], self.feature[:T], self.bin[:T],
+                binned, self.parent[:T],
+                self.feature[:T] if feats is None else feats, self.bin[:T],
                 self.cat_set[:T] if self.cat_set is not None else None)  # (T,C,n)
             return np.ascontiguousarray(
                 np.transpose(leaves, (2, 0, 1)).reshape(n, T * self.num_class))
@@ -566,7 +618,7 @@ class GBDTBooster:
         k = 0
         for t in range(T):
             for c in range(self.num_class):
-                out[:, k] = self._leaf_of_binned(binned, t, c)
+                out[:, k] = self._leaf_of_binned(binned, t, c, feature=feats)
                 k += 1
         return out
 
@@ -579,6 +631,13 @@ class GBDTBooster:
         ``LightGBMBooster.scala:510,529``); ``approximate=True`` selects the
         cheaper Saabas path attribution.
         """
+        from .sparse import is_sparse_input
+
+        if is_sparse_input(x):
+            raise NotImplementedError(
+                "per-feature contributions over sparse input would "
+                "materialize a dense (n, d+1) panel at hashed-feature width; "
+                "densify a column subset first")
         if not approximate:
             return self._predict_contrib_shap(x, num_iteration)
         if self.cat_set is not None:
@@ -827,7 +886,7 @@ def _resolve_objective(params):
 
 def _build_step(grad_fn=None, fobj=None, *, cfg, C, lr, boosting, d, cat_idx,
                 ff, bf, bfreq, use_goss, top_rate, other_rate, mesh, axis,
-                pos_bf=1.0, neg_bf=1.0,
+                pos_bf=1.0, neg_bf=1.0, sparse_meta=None,
                 scan_iters=None, eval_metric=None, n_eval=0):
     """Build the jitted per-iteration training step.
 
@@ -983,7 +1042,20 @@ def _build_step(grad_fn=None, fobj=None, *, cfg, C, lr, boosting, d, cat_idx,
 
         data_spec = Pspec(axis)
         rep = Pspec()
-        in_specs = (data_spec, data_spec, data_spec, data_spec, rep, rep)
+        if sparse_meta is not None:
+            # SparseBinned pytree: the per-shard entry/cell-table arrays
+            # shard on axis 0 (row blocks), the per-feature zero_bin
+            # replicates; aux must match the arg's for the pytrees to line up
+            from .sparse import SparseBinned
+
+            d_s, B_s, n_local, max_run = sparse_meta
+            binned_spec = SparseBinned(
+                rows=data_spec, bins=data_spec, ends=data_spec,
+                starts=data_spec, zero_bin=rep,
+                d=d_s, n_bins=B_s, n=n_local, max_run=max_run)
+        else:
+            binned_spec = data_spec
+        in_specs = (binned_spec, data_spec, data_spec, data_spec, rep, rep)
         out_specs = (rep, data_spec)
         if scan_iters is not None:
             return jax.jit(shard_map(scan_loop, mesh=mesh, in_specs=in_specs,
@@ -1010,7 +1082,7 @@ def _build_step(grad_fn=None, fobj=None, *, cfg, C, lr, boosting, d, cat_idx,
 @lru_cache(maxsize=64)
 def _cached_step(obj_key, *, cfg, C, lr, boosting, d, cat_idx, ff, bf, bfreq,
                  use_goss, top_rate, other_rate, mesh, axis,
-                 pos_bf=1.0, neg_bf=1.0, scan_iters=None,
+                 pos_bf=1.0, neg_bf=1.0, sparse_meta=None, scan_iters=None,
                  eval_metric=None, n_eval=0):
     """Compiled-step cache for built-in objectives (custom fobj / lambdarank
     close over data and stay uncached). Keyed on every static that shapes the
@@ -1023,7 +1095,7 @@ def _cached_step(obj_key, *, cfg, C, lr, boosting, d, cat_idx, ff, bf, bfreq,
                        d=d, cat_idx=cat_idx, ff=ff, bf=bf, bfreq=bfreq,
                        use_goss=use_goss, top_rate=top_rate,
                        other_rate=other_rate, mesh=mesh, axis=axis,
-                       pos_bf=pos_bf, neg_bf=neg_bf,
+                       pos_bf=pos_bf, neg_bf=neg_bf, sparse_meta=sparse_meta,
                        scan_iters=scan_iters, eval_metric=eval_metric,
                        n_eval=n_eval)
 
@@ -1054,12 +1126,19 @@ def train(params: Dict[str, Any], x: np.ndarray, y: Optional[np.ndarray] = None,
     C = int(p["num_class"]) if obj_name in ("multiclass", "softmax") else 1
     from .dataset import GBDTDataset
 
+    from .sparse import as_csr, is_sparse_input
+
     dataset = x if isinstance(x, GBDTDataset) else None
     if dataset is not None:
         x = dataset.x
         if feature_names is None:
             feature_names = dataset.feature_names
     dev_data = dataset is not None and dataset.is_device
+    # sparse (CSR) features — reference treats these as first-class
+    # (``DatasetAggregator.scala:84,143-148`` builds CSR native datasets;
+    # ``LightGBMBooster.predictForCSR``): route through the sparse grower
+    sparse_in = is_sparse_input(x)
+    csr = as_csr(x) if sparse_in else None
     y_dev_in = y if isinstance(y, jnp.ndarray) else None
     if y is None:
         if dataset is None or dataset.label_np is None:
@@ -1085,6 +1164,9 @@ def train(params: Dict[str, Any], x: np.ndarray, y: Optional[np.ndarray] = None,
                              "on host")
         x_f32_in, x32, x = True, None, None
         n, d = dataset.x.shape
+    elif sparse_in:
+        x_f32_in, x32, x = False, None, None
+        n, d = csr.shape
     else:
         x_f32_in = np.asarray(x).dtype == np.float32
         x32 = np.asarray(x) if x_f32_in else None  # skips a f64->f32 roundtrip
@@ -1158,26 +1240,39 @@ def train(params: Dict[str, Any], x: np.ndarray, y: Optional[np.ndarray] = None,
             mapper = BinMapper(max_bin=int(p["max_bin"]), seed=int(p["seed"]),
                                sample_cnt=int(p["bin_sample_count"]),
                                max_bin_by_feature=p["max_bin_by_feature"],
-                               categorical_features=cat_features).fit(x)
+                               categorical_features=cat_features)
+            mapper = mapper.fit_csr(csr) if sparse_in else mapper.fit(x)
     has_cat = bool(mapper.categorical_features)
+    if sparse_in:
+        if has_cat or cat_features:
+            raise NotImplementedError(
+                "categorical features are not supported for sparse input "
+                "(hash them through the featurizer instead)")
+        if p["boosting"] == "dart":
+            raise NotImplementedError(
+                "boosting='dart' needs host-side tree replay over the full "
+                "matrix; use gbdt/goss/rf for sparse input")
     reuse_dataset = dataset is not None and mapper is dataset.mapper
     # Bin on DEVICE when exact: numeric features whose raw values are all
     # f32-representable bin identically via device_bin's floored-f32 edges
     # (see pack_edges), and the vectorized XLA binning replaces the host
     # searchsorted pass — the single largest fixed cost at multi-million-row
     # scale. f64-only values or categorical features keep the host path.
-    use_device_bin = (not reuse_dataset and mesh is None
+    use_device_bin = (not sparse_in
+                      and not reuse_dataset and mesh is None
                       and not mapper.cat_values
                       and (x_f32_in
                            or bool(np.all(x == x.astype(np.float32)))))
     if reuse_dataset:
         binned_np = dataset.binned_np
+    elif sparse_in:
+        binned_np = None
     else:
         binned_np = None if use_device_bin else mapper.transform(x)
 
     if init_booster is not None:
         base = init_booster.base_score.copy()
-        raw0 = init_booster.raw_predict(x)
+        raw0 = init_booster.raw_predict(csr if sparse_in else x)
         raw0 = raw0.reshape(n, C)
     else:
         base = np.atleast_1d(np.asarray(init_fn(y, w_np), dtype=np.float64))
@@ -1220,7 +1315,11 @@ def train(params: Dict[str, Any], x: np.ndarray, y: Optional[np.ndarray] = None,
         raise ValueError(f"parallelism must be data_parallel|voting_parallel, "
                          f"got {parallelism!r}")
     cfg = TreeConfig(
-        n_bins=mapper.n_bins, num_leaves=int(p["num_leaves"]),
+        # sparse trains in the COMPACT bin space (realized bins only): the
+        # transient (d, B, 3) histograms at hashed-text width are sized by
+        # what the data actually realizes, not by max_bin
+        n_bins=mapper.realized_n_bins if sparse_in else mapper.n_bins,
+        num_leaves=int(p["num_leaves"]),
         lambda_l1=float(p["lambda_l1"]), lambda_l2=float(p["lambda_l2"]),
         min_data_in_leaf=float(p["min_data_in_leaf"]),
         min_sum_hessian=float(p["min_sum_hessian_in_leaf"]),
@@ -1234,7 +1333,8 @@ def train(params: Dict[str, Any], x: np.ndarray, y: Optional[np.ndarray] = None,
         top_k=int(p["top_k"]),
         # multiclass vmaps grow_tree: a vmapped lax.switch runs every buffer
         # branch (~2n/step), so leaf-local only pays off single-class
-        leaf_local=bool(p["leaf_local"]) and C == 1,
+        # (sparse growth is already leaf-transient by construction)
+        leaf_local=bool(p["leaf_local"]) and C == 1 and not sparse_in,
     )
     cat_mask_np = None
     if has_cat:
@@ -1250,12 +1350,23 @@ def train(params: Dict[str, Any], x: np.ndarray, y: Optional[np.ndarray] = None,
     # -- the jitted per-iteration step --------------------------------------------
     cat_idx = (tuple(sorted(mapper.categorical_features))
                if has_cat else None)
+    sparse_meta = None
+    sb_host = None
+    if sparse_in and mesh is not None:
+        # pack the mesh layout now: the in_specs pytree in _build_step must
+        # carry the SAME static aux (incl. max_run) as the actual arrays
+        from .sparse import shard_sparse_binned
+
+        _ns = mesh.shape[axis]
+        sb_host, _local = shard_sparse_binned(csr, mapper, _ns, (-n) % _ns)
+        sparse_meta = (d, cfg.n_bins, _local, sb_host.max_run)
     step_args = dict(cfg=cfg, C=C, lr=lr, boosting=boosting, d=d,
                      cat_idx=cat_idx, ff=ff, bf=bf, bfreq=bfreq,
                      use_goss=use_goss, top_rate=top_rate,
                      other_rate=other_rate, mesh=mesh, axis=axis,
                      pos_bf=float(p['pos_bagging_fraction']),
-                     neg_bf=float(p['neg_bagging_fraction']))
+                     neg_bf=float(p['neg_bagging_fraction']),
+                     sparse_meta=sparse_meta)
     obj_key = (obj_name, C, float(p["alpha"]),
                float(p["tweedie_variance_power"]), float(p["sigmoid"]))
     step_cacheable = fobj is None and obj_name != "lambdarank"
@@ -1307,6 +1418,27 @@ def train(params: Dict[str, Any], x: np.ndarray, y: Optional[np.ndarray] = None,
                 fill_first=False), data_spec)
             raw_d = dev_put(dpad(jnp.zeros((n, C), jnp.float32)
                                  + jnp.asarray(base, jnp.float32)), data_spec)
+        elif sparse_in:
+            # equal row blocks, per-block entries packed and padded
+            # (sparse.py layout, hoisted to sb_host above); padding rows wrap
+            # to the front with zero weight, matching the dense convention
+            from .sparse import SparseBinned
+
+            sb = sb_host
+            binned_d = SparseBinned(
+                rows=dev_put(sb.rows, data_spec),
+                bins=dev_put(sb.bins, data_spec),
+                ends=dev_put(sb.ends, data_spec),
+                starts=dev_put(sb.starts, data_spec),
+                zero_bin=dev_put(sb.zero_bin, Pspec()),
+                d=sb.d, n_bins=sb.n_bins, n=sb.n, max_run=sb.max_run)
+            if pad:
+                y = np.concatenate([y, y[:pad]])
+                w_np = np.concatenate([w_np, np.zeros(pad)])
+                raw0 = np.concatenate([raw0, raw0[:pad]], axis=0)
+            y_d = dev_put(y.astype(np.float32), data_spec)
+            w_d = dev_put(w_np.astype(np.float32), data_spec)
+            raw_d = dev_put(raw0.astype(np.float32), data_spec)
         else:
             if pad:
                 binned_np = np.concatenate([binned_np, binned_np[:pad]], axis=0)
@@ -1318,7 +1450,12 @@ def train(params: Dict[str, Any], x: np.ndarray, y: Optional[np.ndarray] = None,
             w_d = dev_put(w_np.astype(np.float32), data_spec)
             raw_d = dev_put(raw0.astype(np.float32), data_spec)
     else:
-        if reuse_dataset:
+        if sparse_in:
+            from .sparse import build_sparse_binned
+
+            binned_d = (dataset.device_binned() if reuse_dataset
+                        else build_sparse_binned(csr, mapper))
+        elif reuse_dataset:
             binned_d = dataset.device_binned()  # uploaded once, reused
         elif use_device_bin:
             from .device_predict import device_bin, pack_edges
@@ -1362,6 +1499,24 @@ def train(params: Dict[str, Any], x: np.ndarray, y: Optional[np.ndarray] = None,
         for ex, ey in eval_set:
             if isinstance(ex, GBDTDataset):
                 ex = ex.x  # symmetric with the x handling above
+            if is_sparse_input(ex):
+                from .sparse import build_sparse_binned
+
+                if not sparse_in:
+                    # compact eval bins against dense-space tree thresholds
+                    # would misroute missing values
+                    raise ValueError("sparse eval_set requires sparse "
+                                     "training features")
+                ecsr = as_csr(ex)
+                e_n = ecsr.shape[0]
+                if init_booster is not None:
+                    eraw0 = init_booster.raw_predict(ecsr).reshape(
+                        e_n, C).astype(np.float64)
+                else:
+                    eraw0 = np.tile(base, (e_n, 1))
+                eval_binned.append((build_sparse_binned(ecsr, mapper),
+                                    np.asarray(ey, dtype=np.float64), eraw0))
+                continue
             ex = np.asarray(ex, dtype=np.float64)
             if init_booster is not None:  # continued training: seed with prior trees
                 eraw0 = init_booster.raw_predict(ex).reshape(len(ex), C).astype(np.float64)
@@ -1437,8 +1592,14 @@ def train(params: Dict[str, Any], x: np.ndarray, y: Optional[np.ndarray] = None,
                        and not callbacks and mesh is None
                        and metric_fn is not None
                        and _dev_metric(metric_name) is not None)
+    if sparse_in and eval_binned and not use_device_eval:
+        # the host fallback loop replays trees over a host binned matrix,
+        # which sparse training deliberately never materializes
+        raise NotImplementedError(
+            "sparse eval_set needs the on-device eval path: drop callbacks/"
+            f"mesh and use a device-supported metric (got {metric_name!r})")
     if use_device_eval and num_iter > 0:
-        eval_dev = [(jnp.asarray(eb.astype(bin_dtype)),
+        eval_dev = [(eb if sparse_in else jnp.asarray(eb.astype(bin_dtype)),
                      jnp.asarray(ey, jnp.float32),
                      jnp.ones(len(ey), jnp.float32),
                      jnp.asarray(eraw0, jnp.float32))

@@ -94,6 +94,25 @@ class GBDTDataset:
                 self.mapper.missing_bin).astype(self.bin_dtype)
             self.binned_np = None  # materialized lazily (host_binned pulls)
             return
+        from .sparse import as_csr, is_sparse_input
+
+        if is_sparse_input(x):
+            # CSR dataset (reference sparse native datasets,
+            # ``DatasetAggregator.scala:84,143-148``): bin once from CSR, the
+            # SparseBinned device triple is cached like the dense buffer
+            if cats:
+                raise NotImplementedError(
+                    "categorical features are not supported for sparse input")
+            self.x = as_csr(x)
+            self.mapper = BinMapper(
+                max_bin=self.max_bin, seed=int(seed),
+                sample_cnt=int(bin_sample_count),
+                max_bin_by_feature=max_bin_by_feature,
+            ).fit_csr(self.x)
+            self.binned_np = None
+            self.bin_dtype = bin_dtype(self.mapper.realized_n_bins)
+            self._device = None
+            return
         self.x = np.asarray(x, dtype=np.float64)
         if self.x.ndim != 2:
             raise ValueError(f"x must be (n, d), got shape {self.x.shape}")
@@ -129,12 +148,24 @@ class GBDTDataset:
     def num_features(self) -> int:
         return self.x.shape[1]
 
+    @property
+    def is_sparse(self) -> bool:
+        from .sparse import CSRMatrix
+
+        return isinstance(self.x, CSRMatrix)
+
     def device_binned(self):
-        """The binned matrix as a device array, uploaded once and cached."""
+        """The binned matrix as a device array (dense int matrix or
+        :class:`SparseBinned`), uploaded once and cached."""
         if self._device is None:
             import jax.numpy as jnp
 
-            self._device = jnp.asarray(self.binned_np.astype(self.bin_dtype))
+            if self.is_sparse:
+                from .sparse import build_sparse_binned
+
+                self._device = build_sparse_binned(self.x, self.mapper)
+            else:
+                self._device = jnp.asarray(self.binned_np.astype(self.bin_dtype))
         return self._device
 
     def __repr__(self) -> str:

@@ -35,10 +35,25 @@ __all__ = [
 ]
 
 
-def _features_matrix(table: Table, col: str) -> np.ndarray:
+def _features_matrix(table: Table, col: str, num_bits: int = 18):
+    """Dense (n, d) matrix — or a :class:`CSRMatrix` when the column is a
+    sparse (indices, values) column (the VW featurizer's output, marked with
+    ``vw_sparse`` meta). The reference's ``matrixType=auto`` plays the same
+    role: sparse vectors stay sparse into the native dataset
+    (``DatasetAggregator.scala:84``)."""
     from ..core.table import features_matrix
 
-    return features_matrix(table.column(col))
+    arr = table.column(col)
+    if arr.dtype == object:
+        meta = table.meta.get(col, {})
+        first = next((v for v in arr if v is not None), None)
+        if meta.get("type") == "vw_sparse" or (
+                isinstance(first, tuple) and len(first) == 2
+                and isinstance(first[0], np.ndarray)):
+            from .sparse import CSRMatrix
+
+            return CSRMatrix.from_pairs(arr, num_bits=num_bits)
+    return features_matrix(arr)
 
 
 class _LightGBMBase(Estimator):
@@ -58,6 +73,9 @@ class _LightGBMBase(Estimator):
     leaf_prediction_col = Param("optional leaf-index output column", str, default=None)
     features_shap_col = Param("optional per-feature contribution output column",
                               str, default=None)
+    sparse_num_bits = Param("hash-mask bits for sparse (indices, values) "
+                            "feature columns (the VW featurizer's output): "
+                            "d = 2^b", int, default=18)
 
     boosting_type = Param("gbdt | rf | dart | goss", str, default="gbdt",
                           validator=ParamValidators.in_list(["gbdt", "rf", "dart", "goss"]))
@@ -192,7 +210,7 @@ class _LightGBMBase(Estimator):
                      group=None, eval_group_from=None) -> GBDTBooster:
         self._validate_input(table, self.features_col, self.label_col)
         tr, val = self._split_validation(table)
-        x = _features_matrix(tr, self.features_col)
+        x = _features_matrix(tr, self.features_col, self.sparse_num_bits)
         y = np.asarray(tr[self.label_col], dtype=np.float64)
         w = (np.asarray(tr[self.weight_col], dtype=np.float64)
              if self.weight_col else None)
@@ -201,7 +219,7 @@ class _LightGBMBase(Estimator):
         eval_set = eval_groups = None
         if val is not None and val.num_rows:
             eval_set = [(
-                _features_matrix(val, self.features_col),
+                _features_matrix(val, self.features_col, self.sparse_num_bits),
                 np.asarray(val[self.label_col], dtype=np.float64),
             )]
             if eval_group_from is not None:
@@ -261,6 +279,8 @@ class _LightGBMModelBase(Model):
     prediction_col = Param("prediction output column", str, default="prediction")
     leaf_prediction_col = Param("optional leaf-index output column", str, default=None)
     features_shap_col = Param("optional contribution output column", str, default=None)
+    sparse_num_bits = Param("hash-mask bits for sparse feature columns",
+                            int, default=18)
     booster = ComplexParam("trained GBDTBooster", object, default=None)
 
     def _extra_outputs(self, out: Table, x: np.ndarray) -> Table:
@@ -347,6 +367,7 @@ class LightGBMClassifier(_LightGBMBase):
             raw_prediction_col=self.raw_prediction_col,
             leaf_prediction_col=self.leaf_prediction_col,
             features_shap_col=self.features_shap_col,
+            sparse_num_bits=self.sparse_num_bits,
         )
 
 
@@ -357,10 +378,10 @@ class LightGBMClassificationModel(_LightGBMModelBase):
 
     def _transform(self, table: Table) -> Table:
         self._validate_input(table, self.features_col)
-        x = _features_matrix(table, self.features_col)
+        x = _features_matrix(table, self.features_col, self.sparse_num_bits)
         b: GBDTBooster = self.booster
         raw = b.raw_predict(x)
-        prob = b.predict(x)
+        prob = b.activate(raw)  # one scoring pass feeds both output columns
         if b.num_class == 1:  # binary: emit 2-class vectors like the reference
             raw2 = np.stack([-raw, raw], axis=1)
             prob2 = np.stack([1 - prob, prob], axis=1)
@@ -395,13 +416,14 @@ class LightGBMRegressor(_LightGBMBase):
             prediction_col=self.prediction_col,
             leaf_prediction_col=self.leaf_prediction_col,
             features_shap_col=self.features_shap_col,
+            sparse_num_bits=self.sparse_num_bits,
         )
 
 
 class LightGBMRegressionModel(_LightGBMModelBase):
     def _transform(self, table: Table) -> Table:
         self._validate_input(table, self.features_col)
-        x = _features_matrix(table, self.features_col)
+        x = _features_matrix(table, self.features_col, self.sparse_num_bits)
         out = table.with_column(self.prediction_col,
                                 self.booster.predict(x).astype(np.float64))
         return self._extra_outputs(out, x)
@@ -441,13 +463,14 @@ class LightGBMRanker(_LightGBMBase):
             prediction_col=self.prediction_col,
             leaf_prediction_col=self.leaf_prediction_col,
             features_shap_col=self.features_shap_col,
+            sparse_num_bits=self.sparse_num_bits,
         )
 
 
 class LightGBMRankerModel(_LightGBMModelBase):
     def _transform(self, table: Table) -> Table:
         self._validate_input(table, self.features_col)
-        x = _features_matrix(table, self.features_col)
+        x = _features_matrix(table, self.features_col, self.sparse_num_bits)
         out = table.with_column(self.prediction_col,
                                 self.booster.predict(x).astype(np.float64))
         return self._extra_outputs(out, x)

@@ -91,13 +91,24 @@ def grow_tree(binned, grad, hess, row_weight, feature_mask, cfg: TreeConfig,
               axis_name: Optional[str] = None, cat_mask=None):
     """Grow one tree. Returns (GrownTree of device arrays, node_of_row (n,) int32).
 
-    ``binned`` (n, d) int32; ``grad``/``hess``/``row_weight`` (n,) f32;
+    ``binned`` (n, d) int32 — or a :class:`~.sparse.SparseBinned`, which
+    routes to the summary-based sparse grower (wide hashed features);
+    ``grad``/``hess``/``row_weight`` (n,) f32;
     ``feature_mask`` (d,) f32 in {0,1} (feature_fraction sampling);
     ``cat_mask`` (d,) f32 in {0,1} — categorical features (None = all numeric).
     """
     import jax
     import jax.numpy as jnp
     from jax import lax
+
+    from .sparse import SparseBinned
+
+    if isinstance(binned, SparseBinned):
+        if cat_mask is not None:
+            raise NotImplementedError(
+                "categorical features are not supported for sparse input")
+        return _grow_tree_sparse(binned, grad, hess, row_weight,
+                                 feature_mask, cfg, axis_name)
 
     n, d = binned.shape
     L, B = cfg.num_leaves, cfg.n_bins
@@ -362,16 +373,188 @@ def grow_tree(binned, grad, hess, row_weight, feature_mask, cfg: TreeConfig,
     return GrownTree(parent, feat, bin_, gains, leaf_value, H_leaf, cat_sets), node
 
 
+def _grow_tree_sparse(sb, grad, hess, row_weight, feature_mask,
+                      cfg: TreeConfig, axis_name: Optional[str]):
+    """Summary-based leaf-wise growth over a :class:`SparseBinned` matrix.
+
+    The dense grower keeps every leaf's full (d, B, 3) histogram resident so
+    each step can re-evaluate all leaves — impossible at hashed-text width
+    (L * d * B * 3 floats at d = 2^18 is gigabytes). This variant keeps only
+    per-leaf best-split SUMMARIES (gain, feature, bin) plus G/H totals, and
+    rebuilds the two child histograms of the split leaf transiently each step
+    with one O(nnz) scatter (``sparse_histogram_split``) — the same economy
+    as LightGBM's bounded histogram pool + per-leaf ``SplitInfo`` cache
+    (``serial_tree_learner``'s ``best_split_per_leaf_``). Numeric splits
+    only; parallelism 'data' psums the transient child histograms, 'voting'
+    (PV-tree) exchanges per-child votes + the elected candidates.
+    """
+    import jax.numpy as jnp
+    from jax import lax
+
+    from .sparse import sparse_column, sparse_histogram_split
+
+    n = grad.shape[0]
+    d, B = sb.d, sb.n_bins
+    L = cfg.num_leaves
+    l1, l2 = cfg.lambda_l1, cfg.lambda_l2
+    voting = cfg.parallelism == "voting" and axis_name is not None
+    if voting:
+        k_local = min(cfg.top_k, d)
+        k_global = min(2 * cfg.top_k, d)
+
+    pos = jnp.arange(B)
+
+    def gain_term(G, H):
+        return _thresh_l1(G, l1) ** 2 / (H + l2)
+
+    def numeric_gain(h, fmask_sel):
+        """(..., d_sel, B, 3) hists -> (..., d_sel, B) threshold-split gains."""
+        G, H, C = h[..., 0], h[..., 1], h[..., 2]
+        GT = G.sum(-1, keepdims=True)
+        HT = H.sum(-1, keepdims=True)
+        CT = C.sum(-1, keepdims=True)
+        GL = jnp.cumsum(G, -1)
+        HL = jnp.cumsum(H, -1)
+        CL = jnp.cumsum(C, -1)
+        GR, HR, CR = GT - GL, HT - HL, CT - CL
+        g = gain_term(GL, HL) + gain_term(GR, HR) - gain_term(GT, HT)
+        valid = (
+            (pos < B - 1)
+            & (CL >= cfg.min_data_in_leaf)
+            & (CR >= cfg.min_data_in_leaf)
+            & (HL >= cfg.min_sum_hessian)
+            & (HR >= cfg.min_sum_hessian)
+            & (fmask_sel[..., None] > 0)
+        )
+        return jnp.where(valid, g, -jnp.inf)
+
+    def best_of_children(h2):
+        """(2, d, B, 3) child hists -> per-child (gain, feat, bin).
+
+        'data' mode: ``h2`` arrives fully psum'd, evaluate directly.
+        'voting' mode: ``h2`` is local — vote top-k features per child, psum
+        votes, reduce only the elected 2k candidates (PV-tree)."""
+        if not voting:
+            gain = numeric_gain(h2, feature_mask)          # (2, d, B)
+            flat = gain.reshape(2, d * B)
+            idx = jnp.argmax(flat, axis=-1)
+            bg = jnp.take_along_axis(flat, idx[:, None], axis=-1)[:, 0]
+            return bg, (idx // B).astype(jnp.int32), (idx % B).astype(jnp.int32)
+        local_gain = numeric_gain(h2, feature_mask)        # (2, d, B)
+        per_feat = local_gain.max(-1)                      # (2, d)
+        topk_idx = lax.top_k(per_feat, k_local)[1]         # (2, k)
+        votes = jnp.zeros((2, d)).at[jnp.arange(2)[:, None], topk_idx].add(1.0)
+        votes = lax.psum(votes, axis_name)
+        sel = lax.top_k(votes, k_global)[1]                # (2, 2k)
+        cand = jnp.take_along_axis(h2, sel[:, :, None, None], axis=1)
+        cand = lax.psum(cand, axis_name)                   # (2, 2k, B, 3)
+        fmask_sel = jnp.take(feature_mask, sel)            # (2, 2k)
+        gain = numeric_gain(cand, fmask_sel)               # (2, 2k, B)
+        flat = gain.reshape(2, k_global * B)
+        idx = jnp.argmax(flat, axis=-1)
+        bg = jnp.take_along_axis(flat, idx[:, None], axis=-1)[:, 0]
+        feat = jnp.take_along_axis(sel, (idx // B)[:, None], axis=1)[:, 0]
+        return bg, feat.astype(jnp.int32), (idx % B).astype(jnp.int32)
+
+    def split_and_summarize(side):
+        """side (n,) {0 left, 1 right, 2 inactive} -> child summaries+totals."""
+        ghc = jnp.stack([grad * row_weight, hess * row_weight, row_weight],
+                        axis=-1)
+        h2, totals = sparse_histogram_split(sb, ghc, side)
+        if axis_name is not None:
+            totals = lax.psum(totals, axis_name)
+            if not voting:
+                h2 = lax.psum(h2, axis_name)
+        bg, bf, bb = best_of_children(h2)
+        return bg, bf, bb, totals
+
+    def step(s, state):
+        (node, best_gain, best_feat, best_bin, G_leaf, H_leaf,
+         parent, feat, bin_, gains, depth) = state
+        leaf_gain = best_gain
+        if cfg.max_depth > 0:
+            leaf_gain = jnp.where(depth < cfg.max_depth, leaf_gain, -jnp.inf)
+        l = jnp.argmax(leaf_gain)
+        g_best = leaf_gain[l]
+        ok = g_best > jnp.maximum(cfg.min_gain_to_split, 0.0)
+        f_sel = best_feat[l]
+        b_sel = best_bin[l]
+        col = sparse_column(sb, f_sel, n)
+        go_left = col <= b_sel
+        member = node == l
+        went_right = member & ~go_left & ok
+        node = jnp.where(went_right, s + 1, node)
+        side = jnp.where(member & ok,
+                         jnp.where(go_left, 0, 1), 2).astype(jnp.int32)
+        (c_gain, c_feat, c_bin), totals = (lambda r: (r[:3], r[3]))(
+            split_and_summarize(side))
+        upd = lambda a, v0, v1: a.at[l].set(v0).at[s + 1].set(v1)
+        best_gain = jnp.where(ok, upd(best_gain, c_gain[0], c_gain[1]),
+                              best_gain)
+        best_feat = jnp.where(ok, upd(best_feat, c_feat[0], c_feat[1]),
+                              best_feat)
+        best_bin = jnp.where(ok, upd(best_bin, c_bin[0], c_bin[1]), best_bin)
+        G_leaf = jnp.where(ok, upd(G_leaf, totals[0, 0], totals[1, 0]), G_leaf)
+        H_leaf = jnp.where(ok, upd(H_leaf, totals[0, 1], totals[1, 1]), H_leaf)
+        parent = parent.at[s].set(jnp.where(ok, l, -1).astype(jnp.int32))
+        feat = feat.at[s].set(f_sel.astype(jnp.int32))
+        bin_ = bin_.at[s].set(b_sel.astype(jnp.int32))
+        gains = gains.at[s].set(jnp.where(ok, g_best, 0.0).astype(jnp.float32))
+        child_depth = jnp.where(ok, depth[l] + 1, depth[l]).astype(jnp.int32)
+        depth = jnp.where(ok, depth.at[s + 1].set(child_depth)
+                          .at[l].set(child_depth), depth)
+        return (node, best_gain, best_feat, best_bin, G_leaf, H_leaf,
+                parent, feat, bin_, gains, depth)
+
+    # root: everything on side 0
+    root_side = jnp.zeros(n, jnp.int32)
+    r_gain, r_feat, r_bin, r_tot = split_and_summarize(root_side)
+    neg = jnp.full(L, -jnp.inf, jnp.float32)
+    state0 = (
+        jnp.zeros(n, dtype=jnp.int32),
+        neg.at[0].set(r_gain[0].astype(jnp.float32)),
+        jnp.zeros(L, jnp.int32).at[0].set(r_feat[0]),
+        jnp.zeros(L, jnp.int32).at[0].set(r_bin[0]),
+        jnp.zeros(L, jnp.float32).at[0].set(r_tot[0, 0]),
+        jnp.zeros(L, jnp.float32).at[0].set(r_tot[0, 1]),
+        jnp.full(L - 1, -1, dtype=jnp.int32),
+        jnp.zeros(L - 1, dtype=jnp.int32),
+        jnp.zeros(L - 1, dtype=jnp.int32),
+        jnp.zeros(L - 1, dtype=jnp.float32),
+        jnp.zeros(L, dtype=jnp.int32),
+    )
+    (node, _bg, _bf, _bb, G_leaf, H_leaf,
+     parent, feat, bin_, gains, _depth) = lax.fori_loop(0, L - 1, step, state0)
+
+    leaf_value = -_thresh_l1(G_leaf, l1) / (H_leaf + l2)
+    leaf_value = jnp.where(H_leaf > 0, leaf_value, 0.0)
+    if cfg.max_delta_step > 0:
+        leaf_value = jnp.clip(leaf_value, -cfg.max_delta_step,
+                              cfg.max_delta_step)
+    cat_sets = jnp.zeros((L - 1, B), dtype=jnp.int8)
+    return (GrownTree(parent, feat, bin_, gains, leaf_value, H_leaf,
+                      cat_sets), node)
+
+
 def predict_binned(tree: GrownTree, binned):
-    """Replay splits over a binned matrix -> leaf index per row (device or host)."""
+    """Replay splits over a binned matrix -> leaf index per row (device or host).
+
+    ``binned``: (n, d) int matrix or a :class:`SparseBinned` (column gathers
+    go through the sparse scatter path)."""
     import jax.numpy as jnp
 
-    n = binned.shape[0]
+    from .sparse import SparseBinned, sparse_column
+
+    sparse = isinstance(binned, SparseBinned)
+    n = binned.n if sparse else binned.shape[0]
     node = jnp.zeros(n, dtype=jnp.int32)
     L1 = tree.parent.shape[0]
     for s in range(L1):
         p = tree.parent[s]
-        col = jnp.take(binned, tree.feature[s], axis=1).astype(jnp.int32)
+        if sparse:
+            col = sparse_column(binned, tree.feature[s], n)
+        else:
+            col = jnp.take(binned, tree.feature[s], axis=1).astype(jnp.int32)
         is_cat = tree.bin[s] < 0
         go_left_cat = jnp.take(tree.cat_set[s], col) > 0
         go_left = jnp.where(is_cat, go_left_cat, col <= tree.bin[s])

@@ -74,10 +74,56 @@ def test_sequence_length_must_divide(mesh):
         sequence_sharded_attention(q, k, v, mesh)
 
 
-def test_ulysses_heads_must_divide(mesh):
-    q, k, v = _qkv(h=6)
-    with pytest.raises(ValueError, match="heads"):
-        sequence_sharded_attention(q, k, v, mesh, strategy="ulysses")
+def test_ulysses_non_divisible_heads(mesh):
+    """Heads that don't divide the axis are zero-padded through the
+    all-to-all and sliced off — real checkpoints hit this immediately."""
+    q, k, v = _qkv(h=6)  # 6 heads over an 8-shard axis
+    out = np.asarray(sequence_sharded_attention(
+        q, k, v, mesh, strategy="ulysses"))
+    ref = _dense_reference(q, k, v)
+    np.testing.assert_allclose(out, ref, rtol=2e-4, atol=2e-4)
+
+
+@pytest.mark.parametrize("strategy", ["ring", "ulysses"])
+def test_gqa_grouped_kv_heads(mesh, strategy):
+    """GQA: 8 query heads over 2 K/V heads — grouped blocks ride the
+    collectives and expand locally (Llama/Mistral-style checkpoints)."""
+    rng = np.random.default_rng(7)
+    q = rng.normal(size=(2, 64, 8, 16)).astype(np.float32)
+    k = rng.normal(size=(2, 64, 2, 16)).astype(np.float32)
+    v = rng.normal(size=(2, 64, 2, 16)).astype(np.float32)
+    out = np.asarray(sequence_sharded_attention(
+        q, k, v, mesh, strategy=strategy, causal=True))
+    kx = np.repeat(k, 4, axis=2)
+    vx = np.repeat(v, 4, axis=2)
+    ref = _dense_reference(q, kx, vx, causal=True)
+    np.testing.assert_allclose(out, ref, rtol=2e-4, atol=2e-4)
+
+
+def test_gqa_bad_group_raises(mesh):
+    q, k, v = _qkv()
+    with pytest.raises(ValueError, match="multiple of kv heads"):
+        sequence_sharded_attention(q, k[:, :, :3], v[:, :, :3], mesh)
+
+
+def test_ulysses_flash_block_override(mesh):
+    """block_q/block_k plumb through to the flash kernel (gathered lengths
+    rarely divide the 512 default)."""
+    q, k, v = _qkv(s=96)  # gathered S=96: 512 default would fail
+    out = np.asarray(sequence_sharded_attention(
+        q, k, v, mesh, strategy="ulysses", local="flash", interpret=True,
+        block_q=32, block_k=32))
+    ref = _dense_reference(q, k, v)
+    np.testing.assert_allclose(out, ref, rtol=2e-3, atol=2e-3)
+
+
+def test_ulysses_flash_auto_block(mesh):
+    """With no override the flash block auto-picks a divisor of S."""
+    q, k, v = _qkv(s=96)
+    out = np.asarray(sequence_sharded_attention(
+        q, k, v, mesh, strategy="ulysses", local="flash", interpret=True))
+    ref = _dense_reference(q, k, v)
+    np.testing.assert_allclose(out, ref, rtol=2e-3, atol=2e-3)
 
 
 def test_unknown_strategy(mesh):
