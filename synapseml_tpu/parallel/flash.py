@@ -122,14 +122,18 @@ def _flash_bh_impl(q, k, v, causal, block_q, block_k, interpret):
     # standard flash-kernel precision tradeoff); f32 inputs stay exact
     in_dt = q.dtype
 
-    def kernel(q_ref, k_ref, v_ref, o_ref, m_s, l_s, acc_s):
+    def kernel(q_ref, k_ref, v_ref, o_ref, ml_s, acc_s):
+        # one (block_q, 128) scratch holds BOTH online-softmax carries (m in
+        # lane 0, l in lane 1): each needs a single lane, and the saved
+        # block_q x 128 f32 buffer is what lets 2k-wide blocks fit scoped
+        # VMEM
         iq = pl.program_id(1)
         jk = pl.program_id(2)
 
         @pl.when(jk == 0)
         def _():
-            m_s[:] = jnp.full_like(m_s, _NEG)
-            l_s[:] = jnp.zeros_like(l_s)
+            ml_s[:, 0:1] = jnp.full((block_q, 1), _NEG, jnp.float32)
+            ml_s[:, 1:2] = jnp.zeros((block_q, 1), jnp.float32)
             acc_s[:] = jnp.zeros_like(acc_s)
 
         def compute():
@@ -145,15 +149,15 @@ def _flash_bh_impl(q, k, v, causal, block_q, block_k, interpret):
                 kpos = jk * block_k + jax.lax.broadcasted_iota(
                     jnp.int32, (block_q, block_k), 1)
                 s = jnp.where(qpos >= kpos, s, _NEG)
-            m = m_s[:, 0:1]
+            m = ml_s[:, 0:1]
             m_new = jnp.maximum(m, s.max(-1, keepdims=True))
             p = jnp.exp(s - m_new)
             corr = jnp.exp(m - m_new)
-            l_s[:, 0:1] = l_s[:, 0:1] * corr + p.sum(-1, keepdims=True)
+            ml_s[:, 1:2] = ml_s[:, 1:2] * corr + p.sum(-1, keepdims=True)
             acc_s[:] = acc_s[:] * corr + jax.lax.dot_general(
                 p.astype(in_dt), vb, (((1,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32)
-            m_s[:, 0:1] = m_new
+            ml_s[:, 0:1] = m_new
 
         if causal:
             # key blocks strictly above the diagonal contribute nothing
@@ -165,7 +169,7 @@ def _flash_bh_impl(q, k, v, causal, block_q, block_k, interpret):
 
         @pl.when(jk == pl.num_programs(2) - 1)
         def _():
-            o_ref[0] = (acc_s[:] / jnp.maximum(l_s[:, 0:1], 1e-30)
+            o_ref[0] = (acc_s[:] / jnp.maximum(ml_s[:, 1:2], 1e-30)
                         ).astype(o_ref.dtype)
 
     grid = (bh, s_q // block_q, nk)
@@ -181,8 +185,8 @@ def _flash_bh_impl(q, k, v, causal, block_q, block_k, interpret):
                                lambda bhi, i, j: (bhi, i, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, s_q, d), jnp.float32),
         scratch_shapes=[
-            pltpu.VMEM((block_q, 128), jnp.float32),  # running max
-            pltpu.VMEM((block_q, 128), jnp.float32),  # running denominator
+            # running max (lane 0) + denominator (lane 1)
+            pltpu.VMEM((block_q, 128), jnp.float32),
             pltpu.VMEM((block_q, d), jnp.float32),    # running numerator
         ],
         compiler_params=None if interpret else pltpu.CompilerParams(

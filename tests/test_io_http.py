@@ -239,16 +239,19 @@ def test_serving_dropped_rows_get_204(mode):
     assert all(codes[i] == 204 for i in (1, 3, 5)), codes
 
 
-def test_continuous_latency_beats_microbatch():
-    """The push-mode continuous engine must beat the micro-batch tick on p50
-    (reference sub-millisecond continuous-mode claim,
-    ``website/docs/features/spark_serving/about.md:18``); measured via the
-    same driver bench.py records in BENCH extra."""
+def test_serving_latency_sub_tick():
+    """Both engines answer in well under a tick interval (reference
+    sub-millisecond continuous-mode claim,
+    ``website/docs/features/spark_serving/about.md:18``). The micro-batch
+    engine's adaptive drain (r4) removed the sleep-out-the-tick tax, so its
+    p99 must no longer be bounded below by the 10 ms interval; measured via
+    the same driver bench.py records in BENCH extra."""
     import bench
 
     r = bench.bench_serving("cpu")
-    assert r["continuous_p50_ms"] < r["microbatch_p50_ms"], r
     assert r["continuous_p50_ms"] < 5.0, r  # generous CI headroom; ~0.3ms idle
+    assert r["microbatch_p50_ms"] < 5.0, r
+    assert r["microbatch_p99_ms"] < 10.0, r  # the old loop's p99 was ~11 ms
 
 
 class _BoomReply(Transformer):
