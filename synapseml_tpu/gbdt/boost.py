@@ -884,9 +884,45 @@ def _resolve_objective(params):
     return OBJECTIVES[name]()
 
 
+def _renewed_leaf_values(node, yv, raw_col, weight, alpha: float, L: int):
+    """Leaf outputs as the weighted ``alpha``-percentile of leaf residuals.
+
+    LightGBM's ``RenewTreeOutput`` (``regression_objective.hpp`` — quantile
+    and L1 objectives replace the gradient-ratio leaf value with the exact
+    residual percentile; without it pinball/L1 loss converges far slower
+    than reference engines — r4 crosscheck measured ~2x worse pinball
+    against sklearn's quantile GBR). Jit-friendly: two argsorts group rows
+    by (leaf, residual), then per-leaf weighted-percentile positions come
+    from L vectorized ``searchsorted`` lookups — no data-dependent shapes.
+    """
+    import jax.numpy as jnp
+
+    r = yv - raw_col
+    order1 = jnp.argsort(r)
+    leaf_o = jnp.take(node, order1)
+    order2 = jnp.argsort(leaf_o, stable=True)
+    perm = jnp.take(order1, order2)          # leaf-major, residual ascending
+    node_s = jnp.take(node, perm)
+    r_s = jnp.take(r, perm)
+    w_s = jnp.take(weight, perm)
+    cw = jnp.cumsum(w_s)
+    leaves = jnp.arange(L)
+    starts = jnp.searchsorted(node_s, leaves, side="left")
+    ends = jnp.searchsorted(node_s, leaves, side="right")
+    offset = jnp.where(starts > 0, jnp.take(cw, jnp.maximum(starts - 1, 0)),
+                       0.0)
+    total = jnp.where(ends > 0, jnp.take(cw, jnp.maximum(ends - 1, 0)),
+                      0.0) - offset
+    target = offset + alpha * total
+    pos = jnp.searchsorted(cw, target, side="left")
+    pos = jnp.clip(pos, starts, jnp.maximum(ends - 1, starts))
+    vals = jnp.take(r_s, jnp.clip(pos, 0, r_s.shape[0] - 1))
+    return jnp.where(total > 0, vals, 0.0).astype(jnp.float32)
+
+
 def _build_step(grad_fn=None, fobj=None, *, cfg, C, lr, boosting, d, cat_idx,
                 ff, bf, bfreq, use_goss, top_rate, other_rate, mesh, axis,
-                pos_bf=1.0, neg_bf=1.0, sparse_meta=None,
+                pos_bf=1.0, neg_bf=1.0, sparse_meta=None, renew_alpha=None,
                 scan_iters=None, eval_metric=None, n_eval=0):
     """Build the jitted per-iteration training step.
 
@@ -961,6 +997,12 @@ def _build_step(grad_fn=None, fobj=None, *, cfg, C, lr, boosting, d, cat_idx,
 
         if C == 1:
             tree, node = grow_c(g[:, 0], h[:, 0])
+            if renew_alpha is not None:
+                # LightGBM RenewTreeOutput: percentile leaf outputs for
+                # quantile/L1 (weighted by sample weight x bagging mask)
+                tree = tree._replace(leaf_value=_renewed_leaf_values(
+                    node, yv, raw[:, 0], wv * bw, renew_alpha,
+                    cfg.num_leaves))
             trees = jax.tree.map(lambda a: a[None], tree)  # add class dim
             delta = tree.leaf_value[node][:, None]
         else:
@@ -1082,8 +1124,8 @@ def _build_step(grad_fn=None, fobj=None, *, cfg, C, lr, boosting, d, cat_idx,
 @lru_cache(maxsize=64)
 def _cached_step(obj_key, *, cfg, C, lr, boosting, d, cat_idx, ff, bf, bfreq,
                  use_goss, top_rate, other_rate, mesh, axis,
-                 pos_bf=1.0, neg_bf=1.0, sparse_meta=None, scan_iters=None,
-                 eval_metric=None, n_eval=0):
+                 pos_bf=1.0, neg_bf=1.0, sparse_meta=None, renew_alpha=None,
+                 scan_iters=None, eval_metric=None, n_eval=0):
     """Compiled-step cache for built-in objectives (custom fobj / lambdarank
     close over data and stay uncached). Keyed on every static that shapes the
     traced program; jax's own jit cache then dedupes by input shape/dtype."""
@@ -1096,6 +1138,7 @@ def _cached_step(obj_key, *, cfg, C, lr, boosting, d, cat_idx, ff, bf, bfreq,
                        use_goss=use_goss, top_rate=top_rate,
                        other_rate=other_rate, mesh=mesh, axis=axis,
                        pos_bf=pos_bf, neg_bf=neg_bf, sparse_meta=sparse_meta,
+                       renew_alpha=renew_alpha,
                        scan_iters=scan_iters, eval_metric=eval_metric,
                        n_eval=n_eval)
 
@@ -1360,13 +1403,22 @@ def train(params: Dict[str, Any], x: np.ndarray, y: Optional[np.ndarray] = None,
         _ns = mesh.shape[axis]
         sb_host, _local = shard_sparse_binned(csr, mapper, _ns, (-n) % _ns)
         sparse_meta = (d, cfg.n_bins, _local, sb_host.max_run)
+    # percentile leaf renewal (LightGBM RenewTreeOutput): quantile targets
+    # its alpha, L1 the median. Under a mesh the percentile would need a
+    # global sort across shards; distributed fits keep gradient-ratio
+    # leaves (documented behavior difference, matching the engine's
+    # single-machine/parallel split)
+    renew_alpha = None
+    if mesh is None and C == 1 and fobj is None:
+        renew_alpha = {"quantile": float(p["alpha"]),
+                       "l1": 0.5, "mae": 0.5}.get(obj_name)
     step_args = dict(cfg=cfg, C=C, lr=lr, boosting=boosting, d=d,
                      cat_idx=cat_idx, ff=ff, bf=bf, bfreq=bfreq,
                      use_goss=use_goss, top_rate=top_rate,
                      other_rate=other_rate, mesh=mesh, axis=axis,
                      pos_bf=float(p['pos_bagging_fraction']),
                      neg_bf=float(p['neg_bagging_fraction']),
-                     sparse_meta=sparse_meta)
+                     sparse_meta=sparse_meta, renew_alpha=renew_alpha)
     obj_key = (obj_name, C, float(p["alpha"]),
                float(p["tweedie_variance_power"]), float(p["sigmoid"]))
     step_cacheable = fobj is None and obj_name != "lambdarank"
