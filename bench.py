@@ -20,7 +20,6 @@ itself, not by a human diffing two JSON files.
 from __future__ import annotations
 
 import json
-import math
 import sys
 import time
 
@@ -327,9 +326,11 @@ def bench_flash_attention(platform, peak):
         if score_bytes <= 10e9:
             try:
                 def xstep(eps):
+                    # bf16 P@V: the performant-XLA baseline (same precision
+                    # tradeoff the flash kernel makes)
                     return dense_attention(
-                        q + eps.astype(jnp.bfloat16), k, v,
-                        causal=True).astype(jnp.float32).sum()
+                        q + eps.astype(jnp.bfloat16), k, v, causal=True,
+                        pv_dtype=jnp.bfloat16).astype(jnp.float32).sum()
 
                 xdt, _ = _timed_device_loop(xstep,
                                             5 if platform != "cpu" else 1)

@@ -543,31 +543,32 @@ class GBDTBooster:
     def raw_predict_device(self, x, num_iteration: Optional[int] = None):
         """Fully on-device raw margin for a device-resident float feature array.
 
-        Chains device binning (``device_predict.device_bin``) into the jitted
-        tree scan with NO host transfer — the path that keeps multi-stage
-        pipelines (e.g. ViT featurizer -> GBDT, BASELINE config #5) resident on
-        the chip. Numeric features only (categorical needs the host value->code
-        map). Returns a jax array (n, C).
+        Chains device binning (``device_predict.device_bin_cat``) into the
+        jitted tree scan with NO host transfer — the path that keeps
+        multi-stage pipelines (e.g. ViT featurizer -> GBDT, BASELINE config
+        #5) resident on the chip. Categorical features bin on device too
+        (exact-match category lookup). Returns a jax array (n, C).
         """
         import jax.numpy as jnp
 
-        from .device_predict import _score_kernel, device_bin, pack_edges
+        from .device_predict import (_score_kernel, device_bin_cat,
+                                     pack_feature_table)
 
-        if self.mapper.cat_values:
-            raise ValueError("raw_predict_device supports numeric features only; "
-                             "use raw_predict for categorical models")
         T = self._used_trees(num_iteration)
-        edges, lens = pack_edges(self.mapper)
-        binned = device_bin(x, jnp.asarray(edges), jnp.asarray(lens),
-                            self.mapper.missing_bin)
+        table, lens, cat_flags = pack_feature_table(self.mapper)
+        binned = device_bin_cat(x, jnp.asarray(table), jnp.asarray(lens),
+                                jnp.asarray(cat_flags),
+                                self.mapper.missing_bin)
         if T == 0:
             return jnp.tile(jnp.asarray(self.base_score, jnp.float32),
                             (binned.shape[0], 1))
-        k = _score_kernel(T, self.num_class, self.parent.shape[2], False)
+        has_cat = self.cat_set is not None
+        k = _score_kernel(T, self.num_class, self.parent.shape[2], has_cat)
+        cs = (self.cat_set[:T].astype(np.int8) if has_cat else
+              np.zeros((T, self.num_class, self.parent.shape[2], 1), np.int8))
         scores = k(binned, self.parent[:T].astype(np.int32),
                    self.feature[:T].astype(np.int32),
-                   self.bin[:T].astype(np.int32),
-                   np.zeros((T, self.num_class, self.parent.shape[2], 1), np.int8),
+                   self.bin[:T].astype(np.int32), cs,
                    self.leaf_value[:T].astype(np.float32),
                    np.asarray(self.tree_scale[:T], np.float64))
         out = scores + jnp.asarray(self.base_score, jnp.float32)[None, :]
@@ -1296,14 +1297,14 @@ def train(params: Dict[str, Any], x: np.ndarray, y: Optional[np.ndarray] = None,
                 "boosting='dart' needs host-side tree replay over the full "
                 "matrix; use gbdt/goss/rf for sparse input")
     reuse_dataset = dataset is not None and mapper is dataset.mapper
-    # Bin on DEVICE when exact: numeric features whose raw values are all
-    # f32-representable bin identically via device_bin's floored-f32 edges
-    # (see pack_edges), and the vectorized XLA binning replaces the host
-    # searchsorted pass — the single largest fixed cost at multi-million-row
-    # scale. f64-only values or categorical features keep the host path.
+    # Bin on DEVICE when exact: features whose raw values are all
+    # f32-representable bin identically via device_bin_cat's floored-f32
+    # edges / exact category match (see pack_feature_table), and the
+    # vectorized XLA binning replaces the host searchsorted pass — the
+    # single largest fixed cost at multi-million-row scale. f64-only values
+    # keep the host path.
     use_device_bin = (not sparse_in
                       and not reuse_dataset and mesh is None
-                      and not mapper.cat_values
                       and (x_f32_in
                            or bool(np.all(x == x.astype(np.float32)))))
     if reuse_dataset:
@@ -1510,13 +1511,14 @@ def train(params: Dict[str, Any], x: np.ndarray, y: Optional[np.ndarray] = None,
         elif reuse_dataset:
             binned_d = dataset.device_binned()  # uploaded once, reused
         elif use_device_bin:
-            from .device_predict import device_bin, pack_edges
+            from .device_predict import device_bin_cat, pack_feature_table
 
-            edges, lens = pack_edges(mapper)
+            table, lens, cat_flags = pack_feature_table(mapper)
             xb = jnp.asarray(np.ascontiguousarray(
                 x32 if x32 is not None else x.astype(np.float32)))
-            binned_d = device_bin(xb, jnp.asarray(edges), jnp.asarray(lens),
-                                  mapper.missing_bin).astype(bin_dtype)
+            binned_d = device_bin_cat(
+                xb, jnp.asarray(table), jnp.asarray(lens),
+                jnp.asarray(cat_flags), mapper.missing_bin).astype(bin_dtype)
         else:
             binned_d = jnp.asarray(binned_np.astype(bin_dtype))
         # y that arrived as a device array stays put; unit weights and the

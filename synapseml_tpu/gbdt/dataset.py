@@ -61,25 +61,25 @@ class GBDTDataset:
         self.feature_names = list(feature_names) if feature_names else None
         cats = sorted(int(c) for c in (categorical_features or []))
         if is_device:
-            if cats:
-                raise NotImplementedError(
-                    "categorical_features need the host value->code map; "
-                    "pass a numpy matrix for categorical data")
             import jax.numpy as jnp
 
-            from .device_predict import device_bin, pack_edges
+            from .device_predict import device_bin_cat, pack_feature_table
 
             if x.ndim != 2:
                 raise ValueError(f"x must be (n, d), got shape {x.shape}")
             x = x.astype(jnp.float32)
             self.x = x
             n = x.shape[0]
-            # fit edges on a bounded host-side sample — the SAME rows
-            # BinMapper.fit would subsample (sample_indices is the single
-            # source of truth); the full matrix never leaves the device
+            # fit edges (and categorical value->code maps) on a bounded
+            # host-side sample — the SAME rows BinMapper.fit would subsample
+            # (sample_indices is the single source of truth); the full
+            # matrix never leaves the device. Categories outside the sample
+            # land in the missing bin, the same bounded-sample tradeoff the
+            # numeric edges already accept.
             self.mapper = BinMapper(max_bin=self.max_bin, seed=int(seed),
                                     sample_cnt=int(bin_sample_count),
-                                    max_bin_by_feature=max_bin_by_feature)
+                                    max_bin_by_feature=max_bin_by_feature,
+                                    categorical_features=cats)
             idx = self.mapper.sample_indices(n)
             if idx is not None:
                 sample = np.asarray(jnp.take(x, jnp.asarray(np.sort(idx)),
@@ -88,9 +88,10 @@ class GBDTDataset:
                 sample = np.asarray(x)
             self.mapper.fit(sample)
             self.bin_dtype = bin_dtype(self.mapper.n_bins)
-            edges, lens = pack_edges(self.mapper)
-            self._device = device_bin(
-                x, jnp.asarray(edges), jnp.asarray(lens),
+            table, lens, cat_flags = pack_feature_table(self.mapper)
+            self._device = device_bin_cat(
+                x, jnp.asarray(table), jnp.asarray(lens),
+                jnp.asarray(cat_flags),
                 self.mapper.missing_bin).astype(self.bin_dtype)
             self.binned_np = None  # materialized lazily (host_binned pulls)
             return

@@ -147,11 +147,12 @@ def device_raw_scores(binned: np.ndarray, parent: np.ndarray,
     return np.asarray(out)[:n]
 
 
-def pack_edges(mapper) -> Tuple[np.ndarray, np.ndarray]:
-    """Per-feature upper edges -> padded (d, Emax) f32 matrix + (d,) edge counts.
-
-    Padding is +inf, which never compares below a finite value, so the device
-    bin computation needs no per-feature masking.
+def pack_feature_table(mapper) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Per-feature bin tables -> padded (d, Emax) f32 matrix + (d,) lengths
+    + (d,) categorical flags. Numeric rows hold upper edges; categorical
+    rows hold the SORTED category values (exact-match lookup on device).
+    Padding is +inf, which never compares below a finite value, so the
+    device bin computation needs no per-feature masking.
 
     BinMapper's edges are float64; the device path compares in float32, so
     each edge is rounded DOWN to the nearest f32 (never up). The host bin is
@@ -161,50 +162,70 @@ def pack_edges(mapper) -> Tuple[np.ndarray, np.ndarray]:
     no greater than ``e64``, satisfies ``v ≤ floor_f32(e64)``. Rounding up
     would break the second case when the rounded edge lands exactly on a
     data value (e.g. midpoint edges between adjacent f32 values).
-    """
+
+    Category values must be exactly f32-representable (integer codes are);
+    a lossy value would break the device equality test, so it raises."""
     edges = mapper.upper_edges
-    emax = max(len(e) for e in edges)
+    sizes = [len(mapper.cat_values[j]) if j in mapper.cat_values else len(e)
+             for j, e in enumerate(edges)]
+    emax = max(max(sizes), 1)
     out = np.full((len(edges), emax), np.inf, dtype=np.float32)
     lens = np.empty(len(edges), dtype=np.int32)
+    cat_flags = np.zeros(len(edges), dtype=np.int8)
     for j, e in enumerate(edges):
+        if j in mapper.cat_values:
+            vals = np.asarray(mapper.cat_values[j], dtype=np.float64)
+            v32 = vals.astype(np.float32)
+            if not np.array_equal(v32.astype(np.float64), vals):
+                raise ValueError(
+                    f"categorical feature {j} has values not exactly "
+                    "f32-representable; device binning would mis-code them")
+            out[j, : len(vals)] = v32
+            lens[j] = len(vals)
+            cat_flags[j] = 1
+            continue
         e64 = np.asarray(e, dtype=np.float64)
         e32 = e64.astype(np.float32)
         floored = np.where(e32.astype(np.float64) > e64,
                            np.nextafter(e32, np.float32(-np.inf)), e32)
         out[j, : len(e)] = floored
         lens[j] = len(e)
-    return out, lens
+    return out, lens, cat_flags
 
 
-def device_bin(x, edges, lens, missing_bin: int):
+def device_bin_cat(x, table, lens, cat_flags, missing_bin: int):
     """(n, d) float features -> (n, d) int32 bins, entirely on device.
 
-    Matches ``BinMapper.transform`` for numeric features whose raw values are
-    f32-representable (the device case — see the rounding note on
-    ``pack_edges``): ``searchsorted(edges, v, 'left')`` == count of edges
-    strictly below ``v``, clamped to the feature's last bin; non-finite
-    values land in the missing bin. (Categorical features need the host
-    value->code map — callers fall back to the host path when the mapper has
-    any.)
-    """
+    Matches ``BinMapper.transform`` for f32-representable raw values (see
+    the rounding note on :func:`pack_feature_table`). Numeric features:
+    count of edges strictly below ``v``, clamped to the last bin.
+    Categorical: the code is the position of the EXACT match among the
+    sorted category values — ``count(vals < v) != count(vals <= v)``
+    detects membership without a gather — unseen values and NaN land in the
+    missing bin (and therefore follow the right branch, matching
+    ``BinMapper.transform_column``)."""
     import jax.numpy as jnp
 
-    return _device_bin_kernel(int(missing_bin))(
-        jnp.asarray(x), jnp.asarray(edges), jnp.asarray(lens))
+    return _device_bin_cat_kernel(int(missing_bin))(
+        jnp.asarray(x), jnp.asarray(table), jnp.asarray(lens),
+        jnp.asarray(cat_flags))
 
 
 @lru_cache(maxsize=16)
-def _device_bin_kernel(missing_bin: int):
-    # jitted: run eagerly, the (n, d, E) broadcast compare materializes in
+def _device_bin_cat_kernel(missing_bin: int):
+    # jitted: run eagerly, the (n, d, E) broadcast compares materialize in
     # HBM op-by-op (tens of GB and tens of seconds at multi-million rows);
-    # under jit XLA fuses it into the reduction
+    # under jit XLA fuses them into the reductions
     import jax
     import jax.numpy as jnp
 
     @jax.jit
-    def run(x, edges, lens):
-        below = (edges[None, :, :] < x[:, :, None]).sum(-1).astype(jnp.int32)
-        bins = jnp.minimum(below, lens[None, :] - 1)
+    def run(x, table, lens, cat_flags):
+        lt = (table[None, :, :] < x[:, :, None]).sum(-1).astype(jnp.int32)
+        le = (table[None, :, :] <= x[:, :, None]).sum(-1).astype(jnp.int32)
+        num_bins = jnp.minimum(lt, lens[None, :] - 1)
+        cat_bins = jnp.where(lt != le, lt, missing_bin)
+        bins = jnp.where(cat_flags[None, :] > 0, cat_bins, num_bins)
         return jnp.where(jnp.isfinite(x), bins, missing_bin).astype(jnp.int32)
 
     return run

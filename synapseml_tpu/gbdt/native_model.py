@@ -22,8 +22,12 @@ model's own thresholds — ``value <= t`` ⇔ ``bin(value) <= bin(t)`` holds
 exactly, so the binned replay path (device predict included) reproduces the
 pointer-tree decisions bit-for-bit.
 
-v1 scope: numerical splits. Categorical splits (LightGBM bitset thresholds)
-and ``default_left`` missing handling raise with a clear message.
+Categorical splits round-trip too (r4): export writes LightGBM's bitset
+encoding — ``decision_type`` bit 0 set, the split's ``threshold`` is an
+index into ``cat_boundaries``/``cat_threshold`` uint32 words whose bits are
+the LEFT-going category values — and import decodes it back into this
+engine's per-split ``cat_set`` membership rows. Only ``default_left``
+missing handling still raises (this engine routes missing right).
 """
 
 from __future__ import annotations
@@ -52,15 +56,24 @@ def _fmt(v: float) -> str:
 # ---------------------------------------------------------------------------------
 
 def _replay_to_pointer(parent, feature, threshold, gain, leaf_value,
-                       leaf_hess):
+                       leaf_hess, bins=None, cat_set=None, cat_values=None):
     """One replay-list tree -> LightGBM pointer arrays (leaves re-indexed
-    densely in slot order)."""
+    densely in slot order).
+
+    ``bins``/``cat_set``/``cat_values``: when the tree has categorical
+    splits (``bins[s] == -1``), each becomes a bitset threshold — the
+    split's ``threshold`` is its index into ``cat_boundaries`` and the
+    uint32 ``cat_threshold`` words carry the LEFT-going category VALUES
+    (``cat_set`` is over bin ids; ``cat_values[feature]`` maps them back to
+    raw categories, which must be non-negative integers as LightGBM
+    requires)."""
     steps = [s for s in range(parent.shape[0]) if parent[s] >= 0]
     if not steps:  # stump: single leaf
         return dict(num_leaves=1, split_feature=[], split_gain=[],
                     threshold=[], decision_type=[], left_child=[],
                     right_child=[], leaf_value=[float(leaf_value[0])],
-                    leaf_weight=[float(leaf_hess[0])])
+                    leaf_weight=[float(leaf_hess[0])],
+                    num_cat=0, cat_boundaries=[0], cat_threshold=[])
     # internal node ids = positions in `steps`; slots -> current tree attach
     # point: (internal id, 'l'|'r') whose child pointer tracks the slot
     internal_of_step = {s: i for i, s in enumerate(steps)}
@@ -86,24 +99,64 @@ def _replay_to_pointer(parent, feature, threshold, gain, leaf_value,
             left[j] = enc
         else:
             right[j] = enc
+    thresholds: List[float] = []
+    decision_types: List[int] = []
+    cat_boundaries = [0]
+    cat_threshold: List[int] = []
+    for s in steps:
+        if bins is not None and int(bins[s]) < 0:  # categorical split
+            f = int(feature[s])
+            vals = cat_values.get(f)
+            if vals is None:
+                raise ValueError(f"split on feature {f} is categorical but "
+                                 "the mapper has no category values for it")
+            vals = np.asarray(vals)
+            if not np.array_equal(vals, np.round(vals)) or vals.min() < 0:
+                raise ValueError(
+                    f"categorical feature {f} has non-integer or negative "
+                    "category values; LightGBM bitsets need codes >= 0 "
+                    "(use to_json for arbitrary categories)")
+            if cat_set[s][len(vals):].any():
+                # the grower's rank-prefix can park the (zero-mass) missing
+                # bin on the left side; LightGBM bitsets cannot express
+                # missing-goes-left — NaN/unseen will route right in the
+                # exported model (LightGBM's own not-in-bitset behavior)
+                import warnings
+
+                warnings.warn(
+                    f"categorical split on feature {f}: missing/unseen "
+                    "values routed left in training but LightGBM bitsets "
+                    "route them right; exported model differs on such rows",
+                    stacklevel=3)
+            left_vals = vals[np.flatnonzero(
+                cat_set[s][: len(vals)])].astype(np.int64)
+            n_words = (int(vals.max()) // 32) + 1 if len(vals) else 1
+            words = [0] * n_words
+            for v in left_vals:
+                words[v // 32] |= 1 << (v % 32)
+            thresholds.append(float(len(cat_boundaries) - 1))
+            decision_types.append(_DT_CATEGORICAL | _DT_MISSING_NAN)
+            cat_threshold.extend(words)
+            cat_boundaries.append(len(cat_threshold))
+        else:
+            thresholds.append(float(threshold[s]))
+            decision_types.append(_DT_MISSING_NAN)
     return dict(
         num_leaves=len(slots),
         split_feature=[int(feature[s]) for s in steps],
         split_gain=[float(gain[s]) for s in steps],
-        threshold=[float(threshold[s]) for s in steps],
-        decision_type=[_DT_MISSING_NAN] * len(steps),
+        threshold=thresholds,
+        decision_type=decision_types,
         left_child=left, right_child=right,
         leaf_value=[float(leaf_value[slot]) for slot in slots],
         leaf_weight=[float(leaf_hess[slot]) for slot in slots],
+        num_cat=len(cat_boundaries) - 1,
+        cat_boundaries=cat_boundaries, cat_threshold=cat_threshold,
     )
 
 
 def booster_to_native(booster) -> str:
     """Serialize a :class:`GBDTBooster` as a LightGBM text model."""
-    if booster.cat_set is not None:
-        raise NotImplementedError(
-            "native-model export of categorical splits (LightGBM bitset "
-            "thresholds) is not supported; use to_json")
     T, C = booster.parent.shape[:2]
     d = booster.mapper.n_features or (int(booster.feature.max()) + 1
                                       if booster.feature.size else 1)
@@ -134,7 +187,12 @@ def booster_to_native(booster) -> str:
             tree = _replay_to_pointer(
                 booster.parent[t, c], booster.feature[t, c],
                 booster.threshold[t, c], booster.gain[t, c],
-                booster.leaf_value[t, c], booster.leaf_hess[t, c])
+                booster.leaf_value[t, c], booster.leaf_hess[t, c],
+                bins=(booster.bin[t, c]
+                      if booster.cat_set is not None else None),
+                cat_set=(booster.cat_set[t, c]
+                         if booster.cat_set is not None else None),
+                cat_values=booster.mapper.cat_values)
             # fold shrinkage/dart scale into leaf values; fold base_score in
             # (first tree per class normally; EVERY tree under rf averaging)
             sc = float(booster.tree_scale[t])
@@ -143,7 +201,7 @@ def booster_to_native(booster) -> str:
             lines += [
                 f"Tree={t * C + c}",
                 f"num_leaves={tree['num_leaves']}",
-                "num_cat=0",
+                f"num_cat={tree['num_cat']}",
                 "split_feature=" + " ".join(map(str, tree["split_feature"])),
                 "split_gain=" + " ".join(map(_fmt, tree["split_gain"])),
                 "threshold=" + " ".join(map(_fmt, tree["threshold"])),
@@ -152,9 +210,15 @@ def booster_to_native(booster) -> str:
                 "right_child=" + " ".join(map(str, tree["right_child"])),
                 "leaf_value=" + " ".join(map(_fmt, vals)),
                 "leaf_weight=" + " ".join(map(_fmt, tree["leaf_weight"])),
-                "shrinkage=1",
-                "",
             ]
+            if tree["num_cat"]:
+                lines += [
+                    "cat_boundaries=" + " ".join(
+                        map(str, tree["cat_boundaries"])),
+                    "cat_threshold=" + " ".join(
+                        map(str, tree["cat_threshold"])),
+                ]
+            lines += ["shrinkage=1", ""]
     lines += ["end of trees", ""]
     return "\n".join(lines)
 
@@ -177,7 +241,11 @@ def _parse_kv(block: List[str]) -> Dict[str, str]:
 def _pointer_to_replay(num_leaves, split_feature, threshold, split_gain,
                        left_child, right_child, leaf_value, leaf_weight,
                        max_leaves):
-    """Pointer tree -> replay arrays sized to ``max_leaves`` slots."""
+    """Pointer tree -> replay arrays sized to ``max_leaves`` slots.
+
+    Also returns ``node_of_step`` (the pointer-tree internal node each
+    replay step came from) so callers can look up per-node side tables —
+    the categorical bitset decode needs it."""
     L1 = max_leaves - 1
     parent = np.full(L1, -1, np.int32)
     feat = np.zeros(L1, np.int32)
@@ -185,10 +253,11 @@ def _pointer_to_replay(num_leaves, split_feature, threshold, split_gain,
     gain = np.zeros(L1, np.float32)
     lv = np.zeros(max_leaves, np.float32)
     lh = np.zeros(max_leaves, np.float32)
+    node_of_step = np.full(L1, -1, np.int32)
     if num_leaves == 1:
         lv[0] = leaf_value[0]
         lh[0] = leaf_weight[0] if leaf_weight is not None else 0.0
-        return parent, feat, thr, gain, lv, lh
+        return parent, feat, thr, gain, lv, lh, node_of_step
     # replay order: walk internal nodes parent-first (BFS from root node 0);
     # slot bookkeeping inverts the export mapping
     slot_of_node = {0: 0}  # internal node -> slot it currently splits
@@ -203,6 +272,7 @@ def _pointer_to_replay(num_leaves, split_feature, threshold, split_gain,
         feat[s] = split_feature[nd]
         thr[s] = threshold[nd]
         gain[s] = split_gain[nd] if split_gain is not None else 0.0
+        node_of_step[s] = nd
         for child, child_slot in ((left_child[nd], p_slot),
                                   (right_child[nd], s + 1)):
             if child >= 0:
@@ -213,7 +283,7 @@ def _pointer_to_replay(num_leaves, split_feature, threshold, split_gain,
                 lv[child_slot] = leaf_value[leaf]
                 if leaf_weight is not None:
                     lh[child_slot] = leaf_weight[leaf]
-    return parent, feat, thr, gain, lv, lh
+    return parent, feat, thr, gain, lv, lh, node_of_step
 
 
 def booster_from_native(model_str: str):
@@ -240,15 +310,10 @@ def booster_from_native(model_str: str):
     for chunk in chunks[1:]:
         kv = _parse_kv(chunk.splitlines())
         nl = int(kv["num_leaves"])
-        if int(kv.get("num_cat", "0")):
-            raise NotImplementedError(
-                "categorical splits in native models are not supported yet")
         ints = lambda key: [int(x) for x in kv.get(key, "").split()]
         flts = lambda key: ([float(x) for x in kv.get(key, "").split()]
                             or None)
         dts = ints("decision_type")
-        if any(dt & _DT_CATEGORICAL for dt in dts):
-            raise NotImplementedError("categorical decision_type")
         if any(dt & _DT_DEFAULT_LEFT for dt in dts):
             raise NotImplementedError(
                 "default_left missing handling is not supported (this "
@@ -259,24 +324,59 @@ def booster_from_native(model_str: str):
             split_gain=flts("split_gain"),
             left_child=ints("left_child"), right_child=ints("right_child"),
             leaf_value=flts("leaf_value") or [0.0],
-            leaf_weight=flts("leaf_weight")))
+            leaf_weight=flts("leaf_weight"),
+            decision_type=dts,
+            cat_boundaries=ints("cat_boundaries") or [0],
+            cat_threshold=ints("cat_threshold")))
     if not trees:
         raise ValueError("model has no trees")
     if len(trees) % per_iter:
         raise ValueError(f"{len(trees)} trees not divisible by "
                          f"num_tree_per_iteration={per_iter}")
 
+    def _is_cat_split(tr, node: int) -> bool:
+        dts = tr["decision_type"]
+        return bool(dts and node < len(dts) and dts[node] & _DT_CATEGORICAL)
+
+    def _bitset_values(tr, cat_idx: int) -> List[int]:
+        lo = tr["cat_boundaries"][cat_idx]
+        hi = tr["cat_boundaries"][cat_idx + 1]
+        vals = []
+        for wi, w in enumerate(tr["cat_threshold"][lo:hi]):
+            b = 0
+            while w:
+                if w & 1:
+                    vals.append(wi * 32 + b)
+                w >>= 1
+                b += 1
+        return vals
+
     # synthetic BinMapper: per-feature edges = the model's own thresholds,
-    # so 'value <= t' == 'bin(value) <= bin(t)' exactly
+    # so 'value <= t' == 'bin(value) <= bin(t)' exactly; categorical
+    # features get their category codes from the union of the model's own
+    # bitsets (unseen values -> missing bin -> right branch, the LightGBM
+    # not-in-bitset behavior)
     thr_by_feat: List[set] = [set() for _ in range(d)]
+    cat_vals_by_feat: Dict[int, set] = {}
     for tr in trees:
-        for f, t in zip(tr["split_feature"], tr["threshold"]):
-            thr_by_feat[f].add(float(t))
-    mapper = BinMapper(max_bin=max(
-        2, max((len(s) + 1) for s in thr_by_feat)))
+        for node, (f, t) in enumerate(zip(tr["split_feature"],
+                                          tr["threshold"])):
+            if _is_cat_split(tr, node):
+                cat_vals_by_feat.setdefault(f, set()).update(
+                    _bitset_values(tr, int(t)))
+            else:
+                thr_by_feat[f].add(float(t))
+    max_cat = max((len(v) for v in cat_vals_by_feat.values()), default=0)
+    mapper = BinMapper(
+        max_bin=max(2, max((len(s) + 1) for s in thr_by_feat), max_cat),
+        categorical_features=sorted(cat_vals_by_feat))
     mapper.upper_edges = [
-        np.concatenate([np.sort(np.array(sorted(s), np.float64)), [np.inf]])
-        for s in thr_by_feat]
+        (np.array([np.inf]) if j in cat_vals_by_feat else
+         np.concatenate([np.sort(np.array(sorted(s), np.float64)), [np.inf]]))
+        for j, s in enumerate(thr_by_feat)]
+    mapper.cat_values = {
+        f: np.array(sorted(v), np.float64)
+        for f, v in cat_vals_by_feat.items()}
     mapper.n_features = d
 
     T = len(trees) // per_iter
@@ -291,21 +391,33 @@ def booster_from_native(model_str: str):
     gain = np.zeros(shape1, np.float32)
     leaf_value = np.zeros((T, C, max_leaves), np.float32)
     leaf_hess = np.zeros((T, C, max_leaves), np.float32)
+    B = mapper.n_bins
+    cat_set = (np.zeros(shape1 + (B,), np.int8) if cat_vals_by_feat
+               else None)
     for idx, tr in enumerate(trees):
         t, c = divmod(idx, C)
         (parent[t, c], feature[t, c], threshold[t, c], gain[t, c],
-         leaf_value[t, c], leaf_hess[t, c]) = _pointer_to_replay(
-            tr["num_leaves"], tr["split_feature"], tr["threshold"],
-            tr["split_gain"], tr["left_child"], tr["right_child"],
-            tr["leaf_value"], tr["leaf_weight"], max_leaves)
-    # bins for each split = position of its threshold in the feature's edges
-    for t in range(T):
-        for c in range(C):
-            for s in range(max_leaves - 1):
-                if parent[t, c, s] >= 0:
-                    f = feature[t, c, s]
-                    bin_[t, c, s] = int(np.searchsorted(
-                        mapper.upper_edges[f], threshold[t, c, s]))
+         leaf_value[t, c], leaf_hess[t, c], node_of_step) = \
+            _pointer_to_replay(
+                tr["num_leaves"], tr["split_feature"], tr["threshold"],
+                tr["split_gain"], tr["left_child"], tr["right_child"],
+                tr["leaf_value"], tr["leaf_weight"], max_leaves)
+        for s in range(max_leaves - 1):
+            nd = int(node_of_step[s])
+            if nd < 0:
+                continue
+            f = int(feature[t, c, s])
+            if _is_cat_split(tr, nd):
+                vals = mapper.cat_values[f]
+                left = _bitset_values(tr, int(tr["threshold"][nd]))
+                codes = np.searchsorted(vals, np.asarray(left, np.float64))
+                cat_set[t, c, s, codes] = 1
+                bin_[t, c, s] = -1
+                threshold[t, c, s] = np.nan
+            else:
+                # bin = position of the threshold in the feature's edges
+                bin_[t, c, s] = int(np.searchsorted(
+                    mapper.upper_edges[f], threshold[t, c, s]))
     return GBDTBooster(
         mapper=mapper, objective=objective, num_class=num_class,
         base_score=np.zeros(num_class),
@@ -314,4 +426,5 @@ def booster_from_native(model_str: str):
         tree_scale=np.ones(T, np.float64),
         boosting="rf" if average_output else "gbdt",
         feature_names=feature_names,
+        cat_set=cat_set,
     )

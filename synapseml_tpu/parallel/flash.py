@@ -29,8 +29,13 @@ __all__ = ["flash_attention", "dense_attention"]
 _NEG = -1e30
 
 
-def dense_attention(q, k, v, causal: bool = False):
-    """Reference dense attention, (B, S, H, D) layout, f32 accumulation."""
+def dense_attention(q, k, v, causal: bool = False, pv_dtype=None):
+    """Reference dense attention, (B, S, H, D) layout, f32 accumulation.
+
+    ``pv_dtype`` casts the probabilities for the P@V matmul (e.g. bf16 —
+    the performant-XLA baseline bench.py compares flash against; the flash
+    kernel makes the same cast). Default keeps everything f32 (the exact
+    parity reference the tests use)."""
     import jax.numpy as jnp
 
     scale = 1.0 / math.sqrt(q.shape[-1])
@@ -42,8 +47,12 @@ def dense_attention(q, k, v, causal: bool = False):
                 >= jnp.arange(S_k)[None, :])
         s = jnp.where(mask[None, :, None, :], s, _NEG)
     p = jnp.exp(s - s.max(-1, keepdims=True))
-    out = jnp.einsum("bqhk,bkhd->bqhd", p / p.sum(-1, keepdims=True),
-                     v.astype(jnp.float32))
+    p = p / p.sum(-1, keepdims=True)
+    if pv_dtype is not None:
+        p = p.astype(pv_dtype)
+        out = jnp.einsum("bqhk,bkhd->bqhd", p, v.astype(pv_dtype))
+    else:
+        out = jnp.einsum("bqhk,bkhd->bqhd", p, v.astype(jnp.float32))
     return out.astype(q.dtype)
 
 

@@ -99,20 +99,53 @@ def test_import_handwritten_lightgbm_text():
 
 
 def test_native_model_unsupported_cases(data):
-    x, y, _ = data
-    xc = x.copy()
-    xc[:, 1] = np.random.default_rng(0).integers(0, 4, len(x))
-    b_cat = train({"objective": "binary", "num_iterations": 3,
-                   "categorical_feature": [1], "max_bin": 15}, xc, y)
-    with pytest.raises(NotImplementedError, match="categorical"):
-        b_cat.save_native_model()
     bad = "tree\nnum_class=1\nmax_feature_idx=0\n\nTree=0\nnum_leaves=2\n" \
-          "num_cat=0\nsplit_feature=0\nthreshold=0\ndecision_type=3\n" \
+          "num_cat=0\nsplit_feature=0\nthreshold=0\ndecision_type=2\n" \
           "left_child=-1\nright_child=-2\nleaf_value=0 1\n\nend of trees\n"
-    with pytest.raises(NotImplementedError, match="categorical"):
+    with pytest.raises(NotImplementedError, match="default_left"):
         GBDTBooster.from_native_model(bad)
     with pytest.raises(ValueError, match="text model"):
         GBDTBooster.from_native_model("{json}")
+
+
+def test_native_roundtrip_categorical(data):
+    """Categorical splits export as LightGBM bitsets and import back
+    (VERDICT r03 next #7: the decision_type bitset interop hole)."""
+    x, y, _ = data
+    rng = np.random.default_rng(0)
+    xc = x.copy()
+    xc[:, 1] = rng.integers(0, 6, len(x))
+    y2 = ((xc[:, 1] % 2 == 0) ^ (xc[:, 0] > 0)).astype(float)
+    b = train({"objective": "binary", "num_iterations": 8, "num_leaves": 15,
+               "min_data_in_leaf": 5, "categorical_feature": [1],
+               "max_bin": 31}, xc, y2)
+    assert b.cat_set is not None and (b.bin == -1).any()
+    text = b.save_native_model()
+    assert "cat_threshold=" in text and "cat_boundaries=" in text
+    b2 = GBDTBooster.from_native_model(text)
+    np.testing.assert_allclose(b2.predict(xc), b.predict(xc),
+                               rtol=1e-5, atol=1e-6)
+    # unseen category routes right in the reimport (LightGBM bitset rule)
+    x_unseen = xc[:5].copy()
+    x_unseen[:, 1] = 99.0
+    assert np.isfinite(b2.predict(x_unseen)).all()
+
+
+def test_import_handwritten_categorical_bitset():
+    """A hand-written LightGBM tree with a categorical bitset split:
+    categories {0, 2} (bits 0 and 2 -> word 5) go left."""
+    text = (
+        "tree\nnum_class=1\nnum_tree_per_iteration=1\nmax_feature_idx=0\n"
+        "objective=regression\n\n"
+        "Tree=0\nnum_leaves=2\nnum_cat=1\nsplit_feature=0\nthreshold=0\n"
+        "decision_type=1\nleft_child=-1\nright_child=-2\n"
+        "leaf_value=1.0 -1.0\nleaf_weight=1 1\n"
+        "cat_boundaries=0 1\ncat_threshold=5\n\nend of trees\n"
+    )
+    b = GBDTBooster.from_native_model(text)
+    x = np.array([[0.0], [1.0], [2.0], [3.0], [np.nan], [7.0]])
+    np.testing.assert_allclose(
+        b.raw_predict(x), [1.0, -1.0, 1.0, -1.0, -1.0, -1.0], atol=1e-7)
 
 
 def test_model_stage_native_save_load(data, tmp_path):
