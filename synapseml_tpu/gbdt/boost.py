@@ -1302,9 +1302,13 @@ def train(params: Dict[str, Any], x: np.ndarray, y: Optional[np.ndarray] = None,
     # edges / exact category match (see pack_feature_table), and the
     # vectorized XLA binning replaces the host searchsorted pass — the
     # single largest fixed cost at multi-million-row scale. f64-only values
-    # keep the host path.
+    # (incl. a PRE-FITTED mapper's non-f32 category values) keep the host
+    # path.
+    from .device_predict import cats_f32_representable
+
     use_device_bin = (not sparse_in
                       and not reuse_dataset and mesh is None
+                      and cats_f32_representable(mapper)
                       and (x_f32_in
                            or bool(np.all(x == x.astype(np.float32)))))
     if reuse_dataset:

@@ -147,6 +147,16 @@ def device_raw_scores(binned: np.ndarray, parent: np.ndarray,
     return np.asarray(out)[:n]
 
 
+def cats_f32_representable(mapper) -> bool:
+    """True when every category value survives an f32 round-trip — the
+    precondition for device categorical binning (host fallback otherwise)."""
+    for vals in mapper.cat_values.values():
+        v64 = np.asarray(vals, dtype=np.float64)
+        if not np.array_equal(v64.astype(np.float32).astype(np.float64), v64):
+            return False
+    return True
+
+
 def pack_feature_table(mapper) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
     """Per-feature bin tables -> padded (d, Emax) f32 matrix + (d,) lengths
     + (d,) categorical flags. Numeric rows hold upper edges; categorical
@@ -164,7 +174,9 @@ def pack_feature_table(mapper) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
     data value (e.g. midpoint edges between adjacent f32 values).
 
     Category values must be exactly f32-representable (integer codes are);
-    a lossy value would break the device equality test, so it raises."""
+    a lossy value would break the device equality test, so it raises —
+    callers that can fall back to host binning should gate on
+    :func:`cats_f32_representable` first."""
     edges = mapper.upper_edges
     sizes = [len(mapper.cat_values[j]) if j in mapper.cat_values else len(e)
              for j, e in enumerate(edges)]
@@ -203,16 +215,23 @@ def device_bin_cat(x, table, lens, cat_flags, missing_bin: int):
     sorted category values — ``count(vals < v) != count(vals <= v)``
     detects membership without a gather — unseen values and NaN land in the
     missing bin (and therefore follow the right branch, matching
-    ``BinMapper.transform_column``)."""
+    ``BinMapper.transform_column``). The kernel specializes on whether any
+    categorical feature exists: the ``<=`` reduction is a second full pass
+    over (n, d, E) and must not tax purely-numeric multi-million-row
+    ingest."""
     import jax.numpy as jnp
 
-    return _device_bin_cat_kernel(int(missing_bin))(
-        jnp.asarray(x), jnp.asarray(table), jnp.asarray(lens),
-        jnp.asarray(cat_flags))
+    cat_flags_np = np.asarray(cat_flags)
+    has_cat = bool(cat_flags_np.any())
+    kern = _device_bin_cat_kernel(int(missing_bin), has_cat)
+    if has_cat:
+        return kern(jnp.asarray(x), jnp.asarray(table), jnp.asarray(lens),
+                    jnp.asarray(cat_flags_np))
+    return kern(jnp.asarray(x), jnp.asarray(table), jnp.asarray(lens))
 
 
 @lru_cache(maxsize=16)
-def _device_bin_cat_kernel(missing_bin: int):
+def _device_bin_cat_kernel(missing_bin: int, has_cat: bool):
     # jitted: run eagerly, the (n, d, E) broadcast compares materialize in
     # HBM op-by-op (tens of GB and tens of seconds at multi-million rows);
     # under jit XLA fuses them into the reductions
@@ -220,7 +239,7 @@ def _device_bin_cat_kernel(missing_bin: int):
     import jax.numpy as jnp
 
     @jax.jit
-    def run(x, table, lens, cat_flags):
+    def run_cat(x, table, lens, cat_flags):
         lt = (table[None, :, :] < x[:, :, None]).sum(-1).astype(jnp.int32)
         le = (table[None, :, :] <= x[:, :, None]).sum(-1).astype(jnp.int32)
         num_bins = jnp.minimum(lt, lens[None, :] - 1)
@@ -228,4 +247,10 @@ def _device_bin_cat_kernel(missing_bin: int):
         bins = jnp.where(cat_flags[None, :] > 0, cat_bins, num_bins)
         return jnp.where(jnp.isfinite(x), bins, missing_bin).astype(jnp.int32)
 
-    return run
+    @jax.jit
+    def run_num(x, table, lens):
+        lt = (table[None, :, :] < x[:, :, None]).sum(-1).astype(jnp.int32)
+        bins = jnp.minimum(lt, lens[None, :] - 1)
+        return jnp.where(jnp.isfinite(x), bins, missing_bin).astype(jnp.int32)
+
+    return run_cat if has_cat else run_num

@@ -276,7 +276,12 @@ def grow_tree(binned, grad, hess, row_weight, feature_mask, cfg: TreeConfig,
             is_cat = jnp.take(cat_mask, f_sel) > 0
             ratio = row[:, 0] / (row[:, 1] + cfg.cat_smooth)
             rank = jnp.argsort(jnp.argsort(-ratio))
-            in_set_cat = rank <= b_sel
+            # zero-mass bins (no rows in this leaf; incl. the missing bin on
+            # NaN-free data) stay OUT of the left set: their placement is
+            # gain-neutral for training but decides where unseen categories
+            # route at predict time — LightGBM sends not-in-bitset right,
+            # and native-model export can only express that
+            in_set_cat = (rank <= b_sel) & (row[:, 2] > 0)
             in_set_num = jnp.arange(B) <= b_sel
             return jnp.where(is_cat, in_set_cat, in_set_num), is_cat
         return jnp.arange(B) <= b_sel, jnp.zeros((), jnp.bool_)

@@ -116,11 +116,13 @@ def _replay_to_pointer(parent, feature, threshold, gain, leaf_value,
                     f"categorical feature {f} has non-integer or negative "
                     "category values; LightGBM bitsets need codes >= 0 "
                     "(use to_json for arbitrary categories)")
-            if cat_set[s][len(vals):].any():
-                # the grower's rank-prefix can park the (zero-mass) missing
-                # bin on the left side; LightGBM bitsets cannot express
-                # missing-goes-left — NaN/unseen will route right in the
-                # exported model (LightGBM's own not-in-bitset behavior)
+            if cat_set[s][-1]:
+                # only the MISSING bin (last) is observable at predict time
+                # among the beyond-code bins — the grower's rank-prefix can
+                # park it on the left side, which LightGBM bitsets cannot
+                # express: NaN/unseen will route right in the exported model
+                # (LightGBM's own not-in-bitset behavior). Zero-mass bins in
+                # (len(vals), missing) are unreachable and need no warning.
                 import warnings
 
                 warnings.warn(
