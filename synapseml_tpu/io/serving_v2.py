@@ -39,8 +39,8 @@ from .http_schema import HTTPResponseData
 from .serving import MicroBatchServingEngine, ServingServer, respond_batch
 
 __all__ = ["ContinuousServingEngine", "DistributedServingEngine",
-           "ServiceRegistry", "RoutingServer", "serve_continuous",
-           "serve_distributed"]
+           "ProcessServingFleet", "ServiceRegistry", "RoutingServer",
+           "serve_continuous", "serve_distributed"]
 
 _logger = get_logger("io.serving_v2")
 
@@ -146,43 +146,81 @@ class RoutingServer:
         self.service = service
         self.timeout = timeout
         self.requests_routed = 0
+        self.workers_evicted = 0
         self._rr = count()
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
             def _forward(self, method: str):
+                import socket as _socket
+
                 targets = outer.registry.lookup(outer.service)
                 if not targets:
                     self.send_error(503, "no workers registered")
                     return
-                target = targets[next(outer._rr) % len(targets)]
                 length = int(self.headers.get("Content-Length") or 0)
                 body = self.rfile.read(length) if length else None
-                fwd = urllib.request.Request(
-                    target + self.path, data=body, method=method,
-                    headers={k: v for k, v in self.headers.items()
-                             if k.lower() not in ("host", "content-length")})
+                start = next(outer._rr)
+                # FAILOVER: a DEAD worker (connection refused/reset) is
+                # dropped from the routing table and the request retries the
+                # next one — a worker death mid-stream must not surface to
+                # clients (the reference's serving tier survives exactly
+                # this, ``HTTPv2Suite.scala:328``). A TIMEOUT merely fails
+                # over without eviction: a cold-compiling or briefly slow
+                # worker is alive, and one slow burst must not permanently
+                # drain the routing table.
+                reply = None  # (status, content_type, entity)
+                for k in range(len(targets)):
+                    target = targets[(start + k) % len(targets)]
+                    fwd = urllib.request.Request(
+                        target + self.path, data=body, method=method,
+                        headers={k: v for k, v in self.headers.items()
+                                 if k.lower() not in ("host",
+                                                      "content-length")})
+                    try:
+                        with urllib.request.urlopen(
+                                fwd, timeout=outer.timeout) as r:
+                            reply = (r.status,
+                                     r.headers.get("Content-Type"), r.read())
+                        break
+                    except urllib.error.HTTPError as e:
+                        # the worker ANSWERED (an application error): relay
+                        # it, this is not a routing fault
+                        reply = (e.code, None, e.read())
+                        break
+                    except (TimeoutError, _socket.timeout):
+                        continue  # alive but slow: fail over, keep it
+                    except urllib.error.URLError as e:
+                        if isinstance(e.reason, (TimeoutError,
+                                                 _socket.timeout)):
+                            continue
+                        outer.registry.unregister(outer.service, target)
+                        outer.workers_evicted += 1
+                        _logger.warning("evicted unreachable worker %s",
+                                        target)
+                        continue
+                    except OSError:
+                        outer.registry.unregister(outer.service, target)
+                        outer.workers_evicted += 1
+                        _logger.warning("evicted unreachable worker %s",
+                                        target)
+                        continue
+                # client write OUTSIDE the failover loop: a client that
+                # hung up must not evict a healthy worker or re-send the
+                # request (duplicate side effects)
                 try:
-                    with urllib.request.urlopen(fwd, timeout=outer.timeout) as r:
-                        ent = r.read()
-                        self.send_response(r.status)
-                        ct = r.headers.get("Content-Type")
+                    if reply is None:
+                        self.send_error(502, "no reachable workers")
+                    else:
+                        status, ct, ent = reply
+                        self.send_response(status)
                         if ct:
                             self.send_header("Content-Type", ct)
                         self.send_header("Content-Length", str(len(ent)))
                         self.end_headers()
                         self.wfile.write(ent)
-                except urllib.error.HTTPError as e:
-                    ent = e.read()
-                    self.send_response(e.code)
-                    self.send_header("Content-Length", str(len(ent)))
-                    self.end_headers()
-                    self.wfile.write(ent)
-                except (OSError, urllib.error.URLError):
-                    try:
-                        self.send_error(502, "worker unreachable")
-                    except OSError:
-                        pass
+                except OSError:
+                    pass  # client went away; the reply is simply dropped
                 outer.requests_routed += 1
 
             def do_GET(self):
@@ -251,6 +289,137 @@ class DistributedServingEngine:
         self.router.close()
         for w in self.workers:
             w.stop()
+
+
+class ProcessServingFleet:
+    """Worker fleet as REAL OS processes behind the routing front door.
+
+    The reference's distributed serving runs per-executor ``WorkerServer``s
+    in separate JVMs; ``DistributedServingEngine`` simulates that with
+    threads (fine for routing logic), but the fault contract — kill a
+    worker mid-stream, the service keeps answering — only means something
+    across process boundaries. Each worker is
+    ``python -m synapseml_tpu.io.serving_worker`` serving a SAVED copy of
+    the pipeline; the router's failover evicts dead workers from the
+    routing table on first contact failure.
+    """
+
+    def __init__(self, pipeline: Transformer, n_workers: int = 2,
+                 service: str = "default", host: str = "127.0.0.1",
+                 mode: str = "continuous", reply_timeout: float = 30.0,
+                 startup_timeout: float = 60.0,
+                 import_modules: Optional[List[str]] = None):
+        import os
+        import subprocess
+        import sys
+        import tempfile
+
+        from ..core.serialization import save_stage
+
+        self._tmp = tempfile.mkdtemp(prefix="serving_fleet_")
+        stage_path = os.path.join(self._tmp, "pipeline")
+        save_stage(pipeline, stage_path)
+        self.registry = ServiceRegistry()
+        self.service = service
+        self.procs = []
+        self.addresses = []
+        repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        env = dict(os.environ)
+        env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+        cmd = [sys.executable, "-m", "synapseml_tpu.io.serving_worker",
+               stage_path, "--host", host, "--mode", mode]
+        for mod in (import_modules or []):
+            cmd += ["--import-module", mod]
+        import select
+        import shutil
+        import time
+
+        try:
+            for _ in range(n_workers):
+                p = subprocess.Popen(cmd, stdout=subprocess.PIPE,
+                                     stderr=subprocess.DEVNULL, text=True,
+                                     env=env)
+                self.procs.append(p)
+            deadline = time.monotonic() + startup_timeout
+            for p in self.procs:
+                line = ""
+                while True:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        raise TimeoutError(
+                            "serving worker did not announce its address "
+                            f"within {startup_timeout}s")
+                    # select enforces the deadline even when the worker
+                    # prints NOTHING (a bare readline would block forever)
+                    ready, _, _ = select.select([p.stdout], [], [],
+                                                min(remaining, 0.5))
+                    if not ready:
+                        if p.poll() is not None:
+                            raise RuntimeError(
+                                "serving worker died during startup")
+                        continue
+                    line = p.stdout.readline()
+                    if line.startswith("ADDRESS "):
+                        break
+                    if not line and p.poll() is not None:
+                        raise RuntimeError(
+                            "serving worker died during startup")
+                addr = line.split(None, 1)[1].strip()
+                self.addresses.append(addr)
+                self.registry.register(service, addr)
+                # drain further worker stdout forever: a pipeline stage that
+                # print()s would otherwise fill the 64KB pipe and wedge the
+                # worker mid-request
+                threading.Thread(target=self._drain, args=(p.stdout,),
+                                 daemon=True).start()
+            self.router = RoutingServer(self.registry, service, host, 0,
+                                        timeout=reply_timeout)
+        except BaseException:
+            # failed startup must not orphan already-spawned workers or
+            # leak the saved-pipeline tempdir (stop() is unreachable when
+            # __init__ raises)
+            for p in self.procs:
+                if p.poll() is None:
+                    p.kill()
+            shutil.rmtree(self._tmp, ignore_errors=True)
+            raise
+
+    @staticmethod
+    def _drain(pipe):
+        try:
+            for _ in pipe:
+                pass
+        except Exception:
+            pass
+
+    @property
+    def address(self) -> str:
+        return self.router.address
+
+    def routing_table(self):
+        return self.registry.routing_table()
+
+    def kill_worker(self, i: int) -> str:
+        """SIGKILL worker ``i`` (the fault-injection hook); returns its
+        address. The router evicts it on the next failed forward."""
+        self.procs[i].kill()
+        self.procs[i].wait()
+        return self.addresses[i]
+
+    def stop(self) -> None:
+        import shutil
+
+        self.router.close()
+        for p in self.procs:
+            if p.poll() is None:
+                p.terminate()
+        for p in self.procs:
+            try:
+                p.wait(timeout=10)
+            except Exception:
+                p.kill()
+        shutil.rmtree(self._tmp, ignore_errors=True)
 
 
 def serve_continuous(pipeline: Transformer, host: str = "127.0.0.1",

@@ -1,0 +1,65 @@
+"""Standalone serving worker process.
+
+``python -m synapseml_tpu.io.serving_worker <stage_path> [--host H]
+[--port P] [--mode continuous|microbatch]`` loads a saved pipeline stage,
+starts a serving engine on its own HTTP server, prints
+``ADDRESS http://host:port`` on stdout (the parent's registration
+handshake), and serves until the process is terminated.
+
+This is the real-process analogue of the reference's per-executor
+``WorkerServer`` (``continuous/HTTPSourceV2.scala:476``): the unit tier can
+simulate executors with threads, but the fault story — a worker DYING while
+the service keeps answering — only means something across process
+boundaries. ``ProcessServingFleet`` spawns these and the RoutingServer's
+failover evicts any that stop answering.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import threading
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("stage_path")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=0)
+    ap.add_argument("--mode", default="continuous",
+                    choices=["continuous", "microbatch"])
+    ap.add_argument("--reply-col", default="reply")
+    ap.add_argument("--import-module", action="append", default=[],
+                    help="module(s) to import before loading the stage "
+                         "(registers user-defined stage classes)")
+    args = ap.parse_args(argv)
+
+    import importlib
+
+    for mod in args.import_module:
+        importlib.import_module(mod)
+
+    from ..core.serialization import load_stage
+    from .serving import MicroBatchServingEngine, ServingServer
+    from .serving_v2 import ContinuousServingEngine
+
+    pipeline = load_stage(args.stage_path)
+    server = ServingServer(args.host, args.port)
+    if args.mode == "continuous":
+        engine = ContinuousServingEngine(server, pipeline,
+                                         reply_col=args.reply_col).start()
+    else:
+        engine = MicroBatchServingEngine(server, pipeline,
+                                         reply_col=args.reply_col).start()
+    print(f"ADDRESS {server.address}", flush=True)
+    try:
+        threading.Event().wait()  # serve until killed
+    except KeyboardInterrupt:
+        pass
+    finally:
+        engine.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

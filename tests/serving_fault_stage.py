@@ -1,0 +1,28 @@
+"""Importable reply stage for the cross-process serving fault test.
+
+Worker subprocesses resolve saved stages through the stage registry, so the
+class must live in an importable module (a test-function-local class
+wouldn't exist in the worker's interpreter). The reply carries the worker's
+PID so the test can SEE requests moving to a different process after the
+kill."""
+
+import os
+
+import numpy as np
+
+from synapseml_tpu.core import Table, Transformer
+from synapseml_tpu.io.http_schema import HTTPResponseData
+
+
+class PidEchoReply(Transformer):
+    """Replies 200 with this process's PID — the fault test's tracer dye."""
+
+    reply_col = "reply"
+
+    def _transform(self, table: Table) -> Table:
+        n = table.num_rows
+        replies = np.empty(n, dtype=object)
+        body = str(os.getpid()).encode()
+        replies[:] = [HTTPResponseData(200, "OK", entity=body)
+                      for _ in range(n)]
+        return table.with_column("reply", replies)
