@@ -1,12 +1,21 @@
-"""CLI: ``python -m synapseml_tpu.codegen <out_dir>`` writes stubs + docs
+"""CLI: ``python -m synapseml_tpu.codegen <out_dir>`` writes stubs + docs;
+``--sklearn`` regenerates the committed sklearn wrapper surface
 (reference: the sbt ``codegen`` task driving ``CodeGen.scala``)."""
 
+import os
 import sys
 
 from .generate import generate_api_docs, generate_stubs
+from .sklearn_gen import write_sklearn_module
 
 
 def main(argv) -> int:
+    if "--sklearn" in argv:
+        target = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "sklearn_api.py")
+        write_sklearn_module(target)
+        print(f"regenerated {target}")
+        return 0
     out = argv[1] if len(argv) > 1 else "generated"
     stubs = generate_stubs(f"{out}/stubs")  # stubs/<full module path>.pyi
     docs = generate_api_docs(f"{out}/docs")

@@ -1,0 +1,496 @@
+"""sklearn-compatible estimator surface — GENERATED, do not edit.
+
+Regenerate with ``python -m synapseml_tpu.codegen --sklearn``. Every
+registered Estimator is wrapped in the sklearn protocol:
+
+    from synapseml_tpu.sklearn_api import SkLightGBMClassifier
+    clf = SkLightGBMClassifier(num_iterations=50).fit(X, y)
+    proba = clf.predict_proba(X_test)
+
+``fit(X, y=None, **columns)`` builds the native Table (``X`` -> the
+estimator's features column, ``y`` -> its label column, extra arrays by
+column name — e.g. ``group=`` for the ranker); ``predict`` returns the
+model's prediction column, ``predict_proba`` the probability column where
+one exists. ``get_params``/``set_params`` follow the sklearn clone
+protocol, so these wrappers drop into sklearn model selection utilities.
+"""
+
+# fmt: off
+# flake8: noqa
+
+import numpy as np
+
+try:  # BaseEstimator supplies __sklearn_tags__ etc. for sklearn >= 1.6
+    from sklearn.base import BaseEstimator as _SkParent
+except ImportError:  # sklearn absent: the protocol still works standalone
+    class _SkParent:  # type: ignore[no-redef]
+        pass
+
+
+class _SkBase(_SkParent):
+    """Shared sklearn-protocol plumbing over a native estimator class."""
+
+    _native_module = None
+    _native_class = None
+    _features_col = None
+    _label_col = None
+    _prediction_col = None
+    _probability_col = None
+
+    def __init__(self, **params):
+        self._validate(params)
+        for name in self._param_names:
+            if name in params:
+                # user values stored UNMODIFIED: sklearn clone() checks
+                # identity of constructor params
+                value = params[name]
+            else:
+                value = self._param_defaults[name]
+                if isinstance(value, (list, dict, set)):
+                    # never alias the shared class-level mutable default
+                    value = value.copy()
+            setattr(self, name, value)
+        self.model_ = None
+
+    def _validate(self, params):
+        unknown = set(params) - set(self._param_names)
+        if unknown:
+            raise TypeError(
+                f"{type(self).__name__}: unknown params {sorted(unknown)}")
+        for k, v in params.items():
+            if v is None and self._param_defaults[k] is not None:
+                # silently mapping None back to the default would make
+                # get_params() disagree with the fitted native estimator
+                raise TypeError(
+                    f"{type(self).__name__}: {k}=None is not valid "
+                    f"(omit it for the default {self._param_defaults[k]!r})")
+
+    # -- sklearn clone protocol ------------------------------------------------
+
+    def get_params(self, deep: bool = True):
+        return {n: getattr(self, n) for n in self._param_names}
+
+    def set_params(self, **params):
+        self._validate(params)
+        for k, v in params.items():
+            setattr(self, k, v)  # as-is: sklearn set_params/clone semantics
+        return self
+
+    def __sklearn_tags__(self):
+        tags = super().__sklearn_tags__()  # needs sklearn >= 1.6
+        est_type = getattr(self, "_estimator_type", None)
+        if est_type is not None:
+            tags.estimator_type = est_type
+        return tags
+
+    def score(self, X, y, **columns):
+        """Accuracy for classifiers, R^2 for regressors (the sklearn
+        default-scoring contract model selection relies on)."""
+        pred = self.predict(X, **columns)
+        y = np.asarray(y)
+        if getattr(self, "_estimator_type", None) == "classifier":
+            return float((pred == y).mean())
+        ss_res = float(((y - pred) ** 2).sum())
+        ss_tot = float(((y - y.mean()) ** 2).sum())
+        return 1.0 - ss_res / ss_tot if ss_tot else 0.0
+
+    # -- native bridge ---------------------------------------------------------
+
+    def _native(self):
+        import importlib
+
+        cls = getattr(importlib.import_module(self._native_module),
+                      self._native_class)
+        # None only ever means "the native default" here (_validate rejects
+        # explicit None for non-None defaults), so omit those args
+        kw = {n: getattr(self, n) for n in self._param_names
+              if getattr(self, n) is not None}
+        return cls(**kw)
+
+    def _table(self, X, y=None, **columns):
+        from synapseml_tpu.core import Table
+
+        cols = {}
+        if X is not None:
+            cols[getattr(self, self._features_col)
+                 if self._features_col else "features"] = np.asarray(X)
+        if y is not None:
+            cols[getattr(self, self._label_col)
+                 if self._label_col else "label"] = np.asarray(y)
+        for name, arr in columns.items():
+            cols[name] = np.asarray(arr)
+        return Table(cols)
+
+    def fit(self, X, y=None, **columns):
+        self.model_ = self._native().fit(self._table(X, y, **columns))
+        if y is not None and                 getattr(self, "_estimator_type", None) == "classifier":
+            # sklearn scorers resolve predict_proba columns via classes_
+            self.classes_ = np.unique(np.asarray(y))
+        return self
+
+    def _check_fitted(self):
+        if self.model_ is None:
+            raise RuntimeError(
+                f"{type(self).__name__} is not fitted; call fit first")
+
+    def transform(self, X, **columns):
+        """The fitted model's full output Table (every output column)."""
+        self._check_fitted()
+        return self.model_.transform(self._table(X, **columns))
+
+    def predict(self, X, **columns):
+        self._check_fitted()
+        out = self.transform(X, **columns)
+        col = (getattr(self, self._prediction_col)
+               if self._prediction_col else "prediction")
+        return np.asarray(out[col])
+
+    def predict_proba(self, X, **columns):
+        if self._probability_col is None:
+            raise AttributeError(
+                f"{type(self).__name__} has no probability output")
+        self._check_fitted()
+        out = self.transform(X, **columns)
+        return np.asarray(out[getattr(self, self._probability_col)])
+
+    def __repr__(self):
+        def differs(v, d):
+            try:
+                return bool(v != d)
+            except Exception:  # e.g. numpy array vs list comparison
+                return True
+
+        changed = {n: v for n, v in self.get_params().items()
+                   if differs(v, self._param_defaults[n])}
+        args = ", ".join(f"{k}={v!r}" for k, v in sorted(changed.items()))
+        return f"{type(self).__name__}({args})"
+
+
+class SkAccessAnomaly(_SkBase):
+    """Reference ``AccessAnomaly:472``; param names snake_cased from the"""
+
+    _native_module = 'synapseml_tpu.cyber.anomaly'
+    _native_class = 'AccessAnomaly'
+    _param_names = ('alpha_param', 'apply_implicit_cf', 'complementset_factor', 'high_value', 'likelihood_col', 'low_value', 'max_iter', 'neg_score', 'output_col', 'rank_param', 'reg_param', 'res_col', 'seed', 'tenant_col', 'user_col')
+    _param_defaults = {'alpha_param': 1.0, 'apply_implicit_cf': True, 'complementset_factor': 2, 'high_value': 10.0, 'likelihood_col': 'likelihood', 'low_value': 5.0, 'max_iter': 25, 'neg_score': 1.0, 'output_col': 'anomaly_score', 'rank_param': 10, 'reg_param': 1.0, 'res_col': 'res', 'seed': 0, 'tenant_col': 'tenant', 'user_col': 'user'}
+
+
+class SkClassBalancer(_SkBase):
+    """Compute inverse-frequency class weights (``ClassBalancer.scala``):"""
+
+    _native_module = 'synapseml_tpu.stages.grouping'
+    _native_class = 'ClassBalancer'
+    _param_names = ('input_col', 'output_col')
+    _param_defaults = {'input_col': 'label', 'output_col': 'weight'}
+
+
+class SkCleanMissingData(_SkBase):
+    """Impute NaN/None in numeric columns (reference ``CleanMissingData.scala``;"""
+
+    _native_module = 'synapseml_tpu.featurize.stages'
+    _native_class = 'CleanMissingData'
+    _param_names = ('cleaning_mode', 'custom_value', 'input_cols', 'output_cols')
+    _param_defaults = {'cleaning_mode': 'Mean', 'custom_value': 0.0, 'input_cols': [], 'output_cols': []}
+
+
+class SkConditionalKNN(_SkBase):
+    """Reference ``ConditionalKNN.scala:31``: like KNN but each query carries"""
+
+    _native_module = 'synapseml_tpu.nn.knn'
+    _native_class = 'ConditionalKNN'
+    _features_col = 'features_col'
+    _label_col = 'label_col'
+    _param_names = ('conditioner_col', 'features_col', 'k', 'label_col', 'leaf_size', 'output_col', 'values_col')
+    _param_defaults = {'conditioner_col': 'conditioner', 'features_col': 'features', 'k': 5, 'label_col': 'labels', 'leaf_size': 50, 'output_col': 'output', 'values_col': 'values'}
+
+
+class SkCountSelector(_SkBase):
+    """Drop all-zero / constant vector slots (reference ``CountSelector.scala``"""
+
+    _native_module = 'synapseml_tpu.featurize.stages'
+    _native_class = 'CountSelector'
+    _param_names = ('input_col', 'output_col')
+    _param_defaults = {'input_col': 'features', 'output_col': 'features'}
+
+
+class SkFeaturize(_SkBase):
+    """Auto-featurize arbitrary columns into one numeric vector"""
+
+    _native_module = 'synapseml_tpu.featurize.stages'
+    _native_class = 'Featurize'
+    _param_names = ('input_cols', 'max_one_hot', 'num_features', 'one_hot_encode_categoricals', 'output_col')
+    _param_defaults = {'input_cols': [], 'max_one_hot': 64, 'num_features': 262144, 'one_hot_encode_categoricals': True, 'output_col': 'features'}
+
+
+class SkFindBestModel(_SkBase):
+    """Pick the best of several FITTED models on an evaluation table"""
+
+    _native_module = 'synapseml_tpu.automl.stages'
+    _native_class = 'FindBestModel'
+    _label_col = 'label_col'
+    _param_names = ('evaluation_metric', 'label_col')
+    _param_defaults = {'evaluation_metric': 'auc', 'label_col': 'label'}
+
+
+class SkFitMultivariateAnomaly(_SkBase):
+    """Reference ``FitMultivariateAnomaly`` (``MultivariateAnomalyDetection.scala:304``):"""
+
+    _native_module = 'synapseml_tpu.cognitive.extended'
+    _native_class = 'FitMultivariateAnomaly'
+    _param_names = ('align_mode', 'backoffs', 'concurrency', 'display_name', 'end_time', 'error_col', 'fill_na_method', 'location', 'max_polling_retries', 'output_col', 'padding_value', 'polling_delay', 'sliding_window', 'source', 'start_time', 'subscription_key', 'subscription_key_col', 'timeout', 'url')
+    _param_defaults = {'align_mode': 'Outer', 'backoffs': [100, 500, 1000], 'concurrency': 4, 'display_name': '', 'end_time': '', 'error_col': 'errors', 'fill_na_method': 'Linear', 'location': '', 'max_polling_retries': 100, 'output_col': 'output', 'padding_value': 0.0, 'polling_delay': 0.3, 'sliding_window': 300, 'source': '', 'start_time': '', 'subscription_key': None, 'subscription_key_col': None, 'timeout': 60.0, 'url': ''}
+
+
+class SkFormOntologyLearner(_SkBase):
+    """Reference ``FormOntologyLearner`` (``FormOntologyLearner.scala:42``):"""
+
+    _native_module = 'synapseml_tpu.cognitive.extended'
+    _native_class = 'FormOntologyLearner'
+    _param_names = ('input_col', 'output_col')
+    _param_defaults = {'input_col': 'form', 'output_col': 'out'}
+
+
+class SkIdIndexer(_SkBase):
+    """IdIndexer"""
+
+    _native_module = 'synapseml_tpu.cyber.indexers'
+    _native_class = 'IdIndexer'
+    _param_names = ('input_col', 'output_col', 'partition_key', 'reset_per_partition')
+    _param_defaults = {'input_col': 'input', 'output_col': 'output', 'partition_key': 'tenant', 'reset_per_partition': False}
+
+
+class SkIsolationForest(_SkBase):
+    """Reference param surface (LinkedIn ``IsolationForestParams`` via"""
+
+    _native_module = 'synapseml_tpu.isolationforest.forest'
+    _native_class = 'IsolationForest'
+    _features_col = 'features_col'
+    _prediction_col = 'prediction_col'
+    _param_names = ('bootstrap', 'contamination', 'features_col', 'max_features', 'max_samples', 'num_estimators', 'prediction_col', 'random_seed', 'score_col')
+    _param_defaults = {'bootstrap': False, 'contamination': 0.0, 'features_col': 'features', 'max_features': 1.0, 'max_samples': 256, 'num_estimators': 100, 'prediction_col': 'predictedLabel', 'random_seed': 1, 'score_col': 'outlierScore'}
+
+
+class SkKNN(_SkBase):
+    """Reference ``KNN.scala:48``: indexes (features, values); queries return"""
+
+    _native_module = 'synapseml_tpu.nn.knn'
+    _native_class = 'KNN'
+    _features_col = 'features_col'
+    _param_names = ('features_col', 'k', 'leaf_size', 'output_col', 'values_col')
+    _param_defaults = {'features_col': 'features', 'k': 5, 'leaf_size': 50, 'output_col': 'output', 'values_col': 'values'}
+
+
+class SkLightGBMClassifier(_SkBase):
+    """Reference: ``LightGBMClassifier.scala:26``. Auto-selects binary vs multiclass"""
+
+    _native_module = 'synapseml_tpu.gbdt.estimators'
+    _native_class = 'LightGBMClassifier'
+    _features_col = 'features_col'
+    _label_col = 'label_col'
+    _prediction_col = 'prediction_col'
+    _probability_col = 'probability_col'
+    _estimator_type = 'classifier'
+    _param_names = ('bagging_fraction', 'bagging_freq', 'bagging_seed', 'bin_sample_count', 'boost_from_average', 'boosting_type', 'cat_smooth', 'categorical_slot_indexes', 'categorical_slot_names', 'drop_rate', 'early_stopping_round', 'feature_fraction', 'features_col', 'features_shap_col', 'improvement_tolerance', 'init_score_col', 'is_unbalance', 'label_col', 'lambda_l1', 'lambda_l2', 'leaf_prediction_col', 'learning_rate', 'max_bin', 'max_bin_by_feature', 'max_cat_threshold', 'max_delta_step', 'max_depth', 'max_drop', 'metric', 'min_data_in_leaf', 'min_gain_to_split', 'min_sum_hessian_in_leaf', 'neg_bagging_fraction', 'num_batches', 'num_iterations', 'num_leaves', 'objective', 'other_rate', 'parallelism', 'pos_bagging_fraction', 'prediction_col', 'probability_col', 'raw_prediction_col', 'seed', 'skip_drop', 'sparse_num_bits', 'top_k', 'top_rate', 'uniform_drop', 'use_barrier_execution_mode', 'validation_indicator_col', 'verbosity', 'weight_col', 'xgboost_dart_mode')
+    _param_defaults = {'bagging_fraction': 1.0, 'bagging_freq': 0, 'bagging_seed': 3, 'bin_sample_count': 200000, 'boost_from_average': True, 'boosting_type': 'gbdt', 'cat_smooth': 10.0, 'categorical_slot_indexes': [], 'categorical_slot_names': [], 'drop_rate': 0.1, 'early_stopping_round': 0, 'feature_fraction': 1.0, 'features_col': 'features', 'features_shap_col': None, 'improvement_tolerance': 0.0, 'init_score_col': None, 'is_unbalance': False, 'label_col': 'label', 'lambda_l1': 0.0, 'lambda_l2': 0.0, 'leaf_prediction_col': None, 'learning_rate': 0.1, 'max_bin': 255, 'max_bin_by_feature': [], 'max_cat_threshold': 32, 'max_delta_step': 0.0, 'max_depth': -1, 'max_drop': 50, 'metric': '', 'min_data_in_leaf': 20, 'min_gain_to_split': 0.0, 'min_sum_hessian_in_leaf': 0.001, 'neg_bagging_fraction': 1.0, 'num_batches': 0, 'num_iterations': 100, 'num_leaves': 31, 'objective': '', 'other_rate': 0.1, 'parallelism': 'data_parallel', 'pos_bagging_fraction': 1.0, 'prediction_col': 'prediction', 'probability_col': 'probability', 'raw_prediction_col': 'rawPrediction', 'seed': 0, 'skip_drop': 0.5, 'sparse_num_bits': 18, 'top_k': 20, 'top_rate': 0.2, 'uniform_drop': False, 'use_barrier_execution_mode': False, 'validation_indicator_col': None, 'verbosity': -1, 'weight_col': None, 'xgboost_dart_mode': False}
+
+
+class SkLightGBMRanker(_SkBase):
+    """Reference: ``LightGBMRanker.scala:25`` — lambdarank over ``group_col``."""
+
+    _native_module = 'synapseml_tpu.gbdt.estimators'
+    _native_class = 'LightGBMRanker'
+    _features_col = 'features_col'
+    _label_col = 'label_col'
+    _prediction_col = 'prediction_col'
+    _estimator_type = 'regressor'
+    _param_names = ('bagging_fraction', 'bagging_freq', 'bagging_seed', 'bin_sample_count', 'boost_from_average', 'boosting_type', 'cat_smooth', 'categorical_slot_indexes', 'categorical_slot_names', 'drop_rate', 'early_stopping_round', 'feature_fraction', 'features_col', 'features_shap_col', 'group_col', 'improvement_tolerance', 'init_score_col', 'label_col', 'lambda_l1', 'lambda_l2', 'lambdarank_truncation_level', 'leaf_prediction_col', 'learning_rate', 'max_bin', 'max_bin_by_feature', 'max_cat_threshold', 'max_delta_step', 'max_depth', 'max_drop', 'max_position', 'metric', 'min_data_in_leaf', 'min_gain_to_split', 'min_sum_hessian_in_leaf', 'ndcg_at', 'neg_bagging_fraction', 'num_batches', 'num_iterations', 'num_leaves', 'objective', 'other_rate', 'parallelism', 'pos_bagging_fraction', 'prediction_col', 'seed', 'skip_drop', 'sparse_num_bits', 'top_k', 'top_rate', 'uniform_drop', 'use_barrier_execution_mode', 'validation_indicator_col', 'verbosity', 'weight_col', 'xgboost_dart_mode')
+    _param_defaults = {'bagging_fraction': 1.0, 'bagging_freq': 0, 'bagging_seed': 3, 'bin_sample_count': 200000, 'boost_from_average': True, 'boosting_type': 'gbdt', 'cat_smooth': 10.0, 'categorical_slot_indexes': [], 'categorical_slot_names': [], 'drop_rate': 0.1, 'early_stopping_round': 0, 'feature_fraction': 1.0, 'features_col': 'features', 'features_shap_col': None, 'group_col': 'group', 'improvement_tolerance': 0.0, 'init_score_col': None, 'label_col': 'label', 'lambda_l1': 0.0, 'lambda_l2': 0.0, 'lambdarank_truncation_level': 30, 'leaf_prediction_col': None, 'learning_rate': 0.1, 'max_bin': 255, 'max_bin_by_feature': [], 'max_cat_threshold': 32, 'max_delta_step': 0.0, 'max_depth': -1, 'max_drop': 50, 'max_position': 20, 'metric': '', 'min_data_in_leaf': 20, 'min_gain_to_split': 0.0, 'min_sum_hessian_in_leaf': 0.001, 'ndcg_at': 10, 'neg_bagging_fraction': 1.0, 'num_batches': 0, 'num_iterations': 100, 'num_leaves': 31, 'objective': 'lambdarank', 'other_rate': 0.1, 'parallelism': 'data_parallel', 'pos_bagging_fraction': 1.0, 'prediction_col': 'prediction', 'seed': 0, 'skip_drop': 0.5, 'sparse_num_bits': 18, 'top_k': 20, 'top_rate': 0.2, 'uniform_drop': False, 'use_barrier_execution_mode': False, 'validation_indicator_col': None, 'verbosity': -1, 'weight_col': None, 'xgboost_dart_mode': False}
+
+
+class SkLightGBMRegressor(_SkBase):
+    """Reference: ``LightGBMRegressor.scala:38`` (objectives regression/l1/huber/"""
+
+    _native_module = 'synapseml_tpu.gbdt.estimators'
+    _native_class = 'LightGBMRegressor'
+    _features_col = 'features_col'
+    _label_col = 'label_col'
+    _prediction_col = 'prediction_col'
+    _estimator_type = 'regressor'
+    _param_names = ('alpha', 'bagging_fraction', 'bagging_freq', 'bagging_seed', 'bin_sample_count', 'boost_from_average', 'boosting_type', 'cat_smooth', 'categorical_slot_indexes', 'categorical_slot_names', 'drop_rate', 'early_stopping_round', 'feature_fraction', 'features_col', 'features_shap_col', 'improvement_tolerance', 'init_score_col', 'label_col', 'lambda_l1', 'lambda_l2', 'leaf_prediction_col', 'learning_rate', 'max_bin', 'max_bin_by_feature', 'max_cat_threshold', 'max_delta_step', 'max_depth', 'max_drop', 'metric', 'min_data_in_leaf', 'min_gain_to_split', 'min_sum_hessian_in_leaf', 'neg_bagging_fraction', 'num_batches', 'num_iterations', 'num_leaves', 'objective', 'other_rate', 'parallelism', 'pos_bagging_fraction', 'prediction_col', 'seed', 'skip_drop', 'sparse_num_bits', 'top_k', 'top_rate', 'tweedie_variance_power', 'uniform_drop', 'use_barrier_execution_mode', 'validation_indicator_col', 'verbosity', 'weight_col', 'xgboost_dart_mode')
+    _param_defaults = {'alpha': 0.9, 'bagging_fraction': 1.0, 'bagging_freq': 0, 'bagging_seed': 3, 'bin_sample_count': 200000, 'boost_from_average': True, 'boosting_type': 'gbdt', 'cat_smooth': 10.0, 'categorical_slot_indexes': [], 'categorical_slot_names': [], 'drop_rate': 0.1, 'early_stopping_round': 0, 'feature_fraction': 1.0, 'features_col': 'features', 'features_shap_col': None, 'improvement_tolerance': 0.0, 'init_score_col': None, 'label_col': 'label', 'lambda_l1': 0.0, 'lambda_l2': 0.0, 'leaf_prediction_col': None, 'learning_rate': 0.1, 'max_bin': 255, 'max_bin_by_feature': [], 'max_cat_threshold': 32, 'max_delta_step': 0.0, 'max_depth': -1, 'max_drop': 50, 'metric': '', 'min_data_in_leaf': 20, 'min_gain_to_split': 0.0, 'min_sum_hessian_in_leaf': 0.001, 'neg_bagging_fraction': 1.0, 'num_batches': 0, 'num_iterations': 100, 'num_leaves': 31, 'objective': 'regression', 'other_rate': 0.1, 'parallelism': 'data_parallel', 'pos_bagging_fraction': 1.0, 'prediction_col': 'prediction', 'seed': 0, 'skip_drop': 0.5, 'sparse_num_bits': 18, 'top_k': 20, 'top_rate': 0.2, 'tweedie_variance_power': 1.5, 'uniform_drop': False, 'use_barrier_execution_mode': False, 'validation_indicator_col': None, 'verbosity': -1, 'weight_col': None, 'xgboost_dart_mode': False}
+
+
+class SkLinearScalarScaler(_SkBase):
+    """LinearScalarScaler"""
+
+    _native_module = 'synapseml_tpu.cyber.scalers'
+    _native_class = 'LinearScalarScaler'
+    _param_names = ('input_col', 'max_required_value', 'min_required_value', 'output_col', 'partition_key')
+    _param_defaults = {'input_col': 'input', 'max_required_value': 1.0, 'min_required_value': 0.0, 'output_col': 'output', 'partition_key': None}
+
+
+class SkMultiColumnAdapter(_SkBase):
+    """Apply a single-column stage to many columns (``MultiColumnAdapter.scala``):"""
+
+    _native_module = 'synapseml_tpu.stages.text'
+    _native_class = 'MultiColumnAdapter'
+    _param_names = ('input_cols', 'output_cols')
+    _param_defaults = {'input_cols': None, 'output_cols': None}
+
+
+class SkMultiIndexer(_SkBase):
+    """Fits several IdIndexers on one pass (reference ``MultiIndexer:130``)."""
+
+    _native_module = 'synapseml_tpu.cyber.indexers'
+    _native_class = 'MultiIndexer'
+    _param_names = ()
+    _param_defaults = {}
+
+
+class SkRankingAdapter(_SkBase):
+    """Wraps a recommender estimator so classic evaluators see"""
+
+    _native_module = 'synapseml_tpu.recommendation.ranking'
+    _native_class = 'RankingAdapter'
+    _label_col = 'label_col'
+    _param_names = ('k', 'label_col', 'min_ratings_per_item', 'min_ratings_per_user', 'mode')
+    _param_defaults = {'k': 10, 'label_col': 'label', 'min_ratings_per_item': 1, 'min_ratings_per_user': 1, 'mode': 'allUsers'}
+
+
+class SkRankingTrainValidationSplit(_SkBase):
+    """Per-user stratified train/validation split + param-map search over a"""
+
+    _native_module = 'synapseml_tpu.recommendation.ranking'
+    _native_class = 'RankingTrainValidationSplit'
+    _param_names = ('item_col', 'min_ratings_i', 'min_ratings_u', 'parallelism', 'rating_col', 'seed', 'train_ratio', 'user_col')
+    _param_defaults = {'item_col': 'item', 'min_ratings_i': 1, 'min_ratings_u': 1, 'parallelism': 1, 'rating_col': 'rating', 'seed': 0, 'train_ratio': 0.75, 'user_col': 'user'}
+
+
+class SkRecommendationIndexer(_SkBase):
+    """Raw user/item ids (strings or sparse ints) -> dense indices"""
+
+    _native_module = 'synapseml_tpu.recommendation.ranking'
+    _native_class = 'RecommendationIndexer'
+    _param_names = ('item_input_col', 'item_output_col', 'rating_col', 'user_input_col', 'user_output_col')
+    _param_defaults = {'item_input_col': 'item', 'item_output_col': 'item_idx', 'rating_col': 'rating', 'user_input_col': 'user', 'user_output_col': 'user_idx'}
+
+
+class SkSAR(_SkBase):
+    """Reference ``SAR.scala:36``. Ids must be non-negative integers (use"""
+
+    _native_module = 'synapseml_tpu.recommendation.sar'
+    _native_class = 'SAR'
+    _param_names = ('activity_time_format', 'item_col', 'rating_col', 'similarity_function', 'start_time', 'start_time_format', 'support_threshold', 'time_col', 'time_decay_coeff', 'user_col')
+    _param_defaults = {'activity_time_format': '%Y/%m/%dT%H:%M:%S', 'item_col': 'item', 'rating_col': 'rating', 'similarity_function': 'jaccard', 'start_time': None, 'start_time_format': '%a %b %d %H:%M:%S %z %Y', 'support_threshold': 4, 'time_col': 'time', 'time_decay_coeff': 30, 'user_col': 'user'}
+
+
+class SkStandardScalarScaler(_SkBase):
+    """StandardScalarScaler"""
+
+    _native_module = 'synapseml_tpu.cyber.scalers'
+    _native_class = 'StandardScalarScaler'
+    _param_names = ('coefficient_factor', 'input_col', 'output_col', 'partition_key')
+    _param_defaults = {'coefficient_factor': 1.0, 'input_col': 'input', 'output_col': 'output', 'partition_key': None}
+
+
+class SkTextFeaturizer(_SkBase):
+    """Tokenize -> n-grams -> hashing TF -> IDF vector"""
+
+    _native_module = 'synapseml_tpu.featurize.text'
+    _native_class = 'TextFeaturizer'
+    _param_names = ('binary', 'input_col', 'n_gram_length', 'num_features', 'output_col', 'to_lowercase', 'use_idf')
+    _param_defaults = {'binary': False, 'input_col': 'text', 'n_gram_length': 1, 'num_features': 4096, 'output_col': 'features', 'to_lowercase': True, 'use_idf': True}
+
+
+class SkTimer(_SkBase):
+    """Time fit/transform of a wrapped stage (``Timer.scala``)."""
+
+    _native_module = 'synapseml_tpu.stages.basic'
+    _native_class = 'Timer'
+    _param_names = ('log_to_logger',)
+    _param_defaults = {'log_to_logger': True}
+
+
+class SkTrainClassifier(_SkBase):
+    """Featurize + index labels + fit (reference ``TrainClassifier.scala:50``)."""
+
+    _native_module = 'synapseml_tpu.train.stages'
+    _native_class = 'TrainClassifier'
+    _features_col = 'features_col'
+    _label_col = 'label_col'
+    _param_names = ('features_col', 'input_cols', 'label_col', 'number_of_features')
+    _param_defaults = {'features_col': 'features', 'input_cols': [], 'label_col': 'label', 'number_of_features': 262144}
+
+
+class SkTrainRegressor(_SkBase):
+    """Reference ``TrainRegressor``. Default learner: LightGBMRegressor."""
+
+    _native_module = 'synapseml_tpu.train.stages'
+    _native_class = 'TrainRegressor'
+    _features_col = 'features_col'
+    _label_col = 'label_col'
+    _param_names = ('features_col', 'input_cols', 'label_col', 'number_of_features')
+    _param_defaults = {'features_col': 'features', 'input_cols': [], 'label_col': 'label', 'number_of_features': 262144}
+
+
+class SkTuneHyperparameters(_SkBase):
+    """Parallel random/grid search over estimator param spaces"""
+
+    _native_module = 'synapseml_tpu.automl.stages'
+    _native_class = 'TuneHyperparameters'
+    _label_col = 'label_col'
+    _param_names = ('evaluation_metric', 'label_col', 'number_of_runs', 'parallelism', 'search_mode', 'seed', 'train_ratio')
+    _param_defaults = {'evaluation_metric': 'auc', 'label_col': 'label', 'number_of_runs': 10, 'parallelism': 4, 'search_mode': 'random', 'seed': 0, 'train_ratio': 0.75}
+
+
+class SkValueIndexer(_SkBase):
+    """Categorical value -> dense index (reference ``ValueIndexer.scala``)."""
+
+    _native_module = 'synapseml_tpu.featurize.stages'
+    _native_class = 'ValueIndexer'
+    _param_names = ('input_col', 'output_col')
+    _param_defaults = {'input_col': 'input', 'output_col': 'output'}
+
+
+class SkVowpalWabbitClassifier(_SkBase):
+    """Binary classifier (reference ``VowpalWabbitClassifier``; VW logistic loss,"""
+
+    _native_module = 'synapseml_tpu.vw.estimators'
+    _native_class = 'VowpalWabbitClassifier'
+    _features_col = 'features_col'
+    _label_col = 'label_col'
+    _prediction_col = 'prediction_col'
+    _probability_col = 'probability_col'
+    _estimator_type = 'classifier'
+    _param_names = ('additional_features', 'batch_size', 'features_col', 'hash_seed', 'l1', 'l2', 'label_col', 'learning_rate', 'loss_function', 'num_bits', 'num_passes', 'pass_through_args', 'power_t', 'prediction_col', 'probability_col', 'raw_prediction_col', 'use_barrier_execution_mode', 'weight_col')
+    _param_defaults = {'additional_features': [], 'batch_size': 256, 'features_col': 'features', 'hash_seed': 0, 'l1': 0.0, 'l2': 0.0, 'label_col': 'label', 'learning_rate': 0.5, 'loss_function': 'logistic', 'num_bits': 18, 'num_passes': 1, 'pass_through_args': '', 'power_t': 0.5, 'prediction_col': 'prediction', 'probability_col': 'probability', 'raw_prediction_col': 'rawPrediction', 'use_barrier_execution_mode': False, 'weight_col': None}
+
+
+class SkVowpalWabbitContextualBandit(_SkBase):
+    """Contextual bandit with per-action features (reference"""
+
+    _native_module = 'synapseml_tpu.vw.estimators'
+    _native_class = 'VowpalWabbitContextualBandit'
+    _features_col = 'features_col'
+    _label_col = 'label_col'
+    _prediction_col = 'prediction_col'
+    _probability_col = 'probability_col'
+    _estimator_type = 'classifier'
+    _param_names = ('additional_features', 'batch_size', 'chosen_action_col', 'epsilon', 'features_col', 'hash_seed', 'l1', 'l2', 'label_col', 'learning_rate', 'num_bits', 'num_passes', 'pass_through_args', 'power_t', 'prediction_col', 'probability_col', 'shared_col', 'use_barrier_execution_mode', 'weight_col')
+    _param_defaults = {'additional_features': [], 'batch_size': 256, 'chosen_action_col': 'chosenAction', 'epsilon': 0.05, 'features_col': 'features', 'hash_seed': 0, 'l1': 0.0, 'l2': 0.0, 'label_col': 'label', 'learning_rate': 0.5, 'num_bits': 18, 'num_passes': 1, 'pass_through_args': '', 'power_t': 0.5, 'prediction_col': 'prediction', 'probability_col': 'probability', 'shared_col': 'shared', 'use_barrier_execution_mode': False, 'weight_col': None}
+
+
+class SkVowpalWabbitRegressor(_SkBase):
+    """Reference ``VowpalWabbitRegressor`` (squared / quantile loss)."""
+
+    _native_module = 'synapseml_tpu.vw.estimators'
+    _native_class = 'VowpalWabbitRegressor'
+    _features_col = 'features_col'
+    _label_col = 'label_col'
+    _prediction_col = 'prediction_col'
+    _estimator_type = 'regressor'
+    _param_names = ('additional_features', 'batch_size', 'features_col', 'hash_seed', 'l1', 'l2', 'label_col', 'learning_rate', 'loss_function', 'num_bits', 'num_passes', 'pass_through_args', 'power_t', 'prediction_col', 'quantile_tau', 'use_barrier_execution_mode', 'weight_col')
+    _param_defaults = {'additional_features': [], 'batch_size': 256, 'features_col': 'features', 'hash_seed': 0, 'l1': 0.0, 'l2': 0.0, 'label_col': 'label', 'learning_rate': 0.5, 'loss_function': 'squared', 'num_bits': 18, 'num_passes': 1, 'pass_through_args': '', 'power_t': 0.5, 'prediction_col': 'prediction', 'quantile_tau': 0.5, 'use_barrier_execution_mode': False, 'weight_col': None}
+
+
+__all__ = ["SkAccessAnomaly", "SkClassBalancer", "SkCleanMissingData", "SkConditionalKNN", "SkCountSelector", "SkFeaturize", "SkFindBestModel", "SkFitMultivariateAnomaly", "SkFormOntologyLearner", "SkIdIndexer", "SkIsolationForest", "SkKNN", "SkLightGBMClassifier", "SkLightGBMRanker", "SkLightGBMRegressor", "SkLinearScalarScaler", "SkMultiColumnAdapter", "SkMultiIndexer", "SkRankingAdapter", "SkRankingTrainValidationSplit", "SkRecommendationIndexer", "SkSAR", "SkStandardScalarScaler", "SkTextFeaturizer", "SkTimer", "SkTrainClassifier", "SkTrainRegressor", "SkTuneHyperparameters", "SkValueIndexer", "SkVowpalWabbitClassifier", "SkVowpalWabbitContextualBandit", "SkVowpalWabbitRegressor"]
