@@ -1,0 +1,61 @@
+# %% [markdown]
+# # Explaining image model decisions: ImageLIME + ImageSHAP
+#
+# Reference notebooks: `notebooks/features/responsible_ai/` (Image
+# Explainers) — superpixel the image, perturb superpixels on/off, and fit a
+# local surrogate to attribute the model's output to image regions.
+
+# %%
+import numpy as np
+
+from synapseml_tpu import Table, Transformer
+from synapseml_tpu.explainers import ImageLIME, ImageSHAP
+from synapseml_tpu.explainers.superpixel import slic_superpixels
+
+# %% a toy "classifier" whose decision comes from one image region:
+# score = mean brightness of the top-left quadrant. The explainers don't
+# know that; the attributions must rediscover it.
+H = W = 48
+
+
+class TopLeftBrightness(Transformer):
+    input_col = "image"
+
+    def _transform(self, table):
+        scores = np.array([
+            [float(np.mean(img[: H // 2, : W // 2]))]
+            for img in table["image"]])
+        return table.with_column("probability", scores)
+
+
+rng = np.random.default_rng(0)
+img = rng.uniform(0.4, 0.6, size=(H, W, 3))
+img[: H // 2, : W // 2] += 0.35  # the bright region that drives the model
+t = Table({"image": np.array([img], dtype=object)})
+model = TopLeftBrightness()
+
+# %% superpixels: the attribution units (SLIC, reference LIMEImageSampler)
+spd = slic_superpixels(img, cell_size=12.0, modifier=20.0)
+print("superpixels:", len(spd))
+
+# %% LIME attributions per superpixel
+lime = ImageLIME(model=model, input_col="image", output_col="weights",
+                 target_col="probability", target_classes=[0],
+                 cell_size=12.0, modifier=20.0, num_samples=150, seed=3)
+w_lime = np.asarray(lime.transform(t)["weights"][0], dtype=np.float64)[0]
+
+# %% SHAP attributions per superpixel
+shap = ImageSHAP(model=model, input_col="image", output_col="shap",
+                 target_col="probability", target_classes=[0],
+                 cell_size=12.0, modifier=20.0, num_samples=150, seed=3)
+w_shap = np.asarray(shap.transform(t)["shap"][0], dtype=np.float64)[0][1:]
+
+# %% both must put their mass on superpixels inside the bright quadrant
+centers = np.array([c.mean(axis=0) for c in spd.clusters])
+in_region = (centers[:, 0] < H / 2) & (centers[:, 1] < W / 2)
+for name, w in [("lime", w_lime), ("shap", w_shap)]:
+    top = np.argsort(-np.abs(w))[: int(in_region.sum())]
+    frac = in_region[top].mean()
+    print(f"{name}: top-attribution superpixels in the true region: "
+          f"{frac:.2f}")
+    assert frac >= 0.7, (name, frac)

@@ -1,0 +1,60 @@
+# %% [markdown]
+# # HyperparameterTuning: TuneHyperparameters + FindBestModel
+#
+# Reference notebook: `notebooks/features/other/HyperParameterTuning -
+# Fighting Breast Cancer` — build a search space over an estimator's
+# params, run parallel random search, and keep the winning model; then pick
+# among several FITTED models with FindBestModel.
+
+# %%
+import numpy as np
+
+from synapseml_tpu import Table
+from synapseml_tpu.automl import (DiscreteHyperParam, FindBestModel,
+                                  HyperparamBuilder, RangeHyperParam,
+                                  TuneHyperparameters)
+from synapseml_tpu.gbdt import LightGBMClassifier
+
+# %% a tabular diagnosis-style dataset (nonlinear decision surface)
+rng = np.random.default_rng(0)
+n = 3000
+x = rng.normal(size=(n, 8))
+y = ((x[:, 0] * x[:, 1] > 0.2) | (x[:, 2] ** 2 > 1.5)).astype(np.float64)
+tr = Table({"features": x[:2400], "label": y[:2400]})
+te = Table({"features": x[2400:], "label": y[2400:]})
+
+# %% the search space (reference HyperparamBuilder)
+space = (HyperparamBuilder()
+         .add_hyperparam("num_leaves", DiscreteHyperParam([7, 15, 31]))
+         .add_hyperparam("learning_rate", RangeHyperParam(0.05, 0.3))
+         .add_hyperparam("num_iterations", DiscreteHyperParam([20, 40]))
+         .build())
+
+# %% parallel random search, AUC on an internal validation split
+tuner = TuneHyperparameters(
+    models=LightGBMClassifier(min_data_in_leaf=5), hyperparams=space,
+    search_mode="random", number_of_runs=8, parallelism=4,
+    evaluation_metric="auc", seed=7)
+tuned = tuner.fit(tr)
+print("best params:", {k: round(v, 3) if isinstance(v, float) else v
+                       for k, v in tuned.best_params.items()})
+print("best validation AUC:", round(tuned.best_metric, 4))
+assert tuned.best_metric > 0.85
+assert len(tuned.history) == 8  # every evaluation recorded
+
+# %% the tuned model is a drop-in transformer
+pred = np.asarray(tuned.transform(te)["probability"])[:, 1]
+acc = float(((pred > 0.5) == y[2400:]).mean())
+print("held-out accuracy:", round(acc, 4))
+assert acc > 0.85
+
+# %% FindBestModel across separately-fitted candidates
+candidates = [
+    LightGBMClassifier(num_iterations=5, num_leaves=4).fit(tr),
+    LightGBMClassifier(num_iterations=40, num_leaves=15,
+                       min_data_in_leaf=5).fit(tr),
+]
+best = FindBestModel(models=candidates, evaluation_metric="auc").fit(te)
+print("winner metric:", round(best.best_metric, 4))
+# the stronger candidate must win
+assert best.best_model is candidates[1]

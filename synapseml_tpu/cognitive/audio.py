@@ -1,0 +1,120 @@
+"""Audio transcoding for the streaming speech stages.
+
+Reference: ``SpeechToTextSDK.scala:232-269,339`` spawns an **ffmpeg
+subprocess** with piped stdio to convert arbitrary input streams (mp3,
+ogg, flac, m4a, webm...) into the PCM the speech service wants, and feeds
+the converted stream through the chunked recognizer. Same design here:
+
+- :func:`transcode_to_wav` pipes the payload through ``ffmpeg -i pipe:0
+  ... -f wav pipe:1`` when an ffmpeg binary exists (any compressed format
+  ffmpeg understands);
+- WAV input falls back to a pure-numpy resample/downmix path (stdlib
+  ``wave`` + linear interpolation) so the canonical
+  resample-to-16k-mono-16bit case needs no external binary at all;
+- anything else without ffmpeg raises with an actionable message.
+
+The target profile is the speech service's canonical PCM: 16 kHz, mono,
+16-bit little-endian WAV.
+"""
+
+from __future__ import annotations
+
+import io
+import shutil
+import subprocess
+import wave
+from typing import Optional
+
+import numpy as np
+
+__all__ = ["transcode_to_wav", "ffmpeg_available", "wav_info"]
+
+_TARGET_RATE = 16000
+
+
+def ffmpeg_available() -> Optional[str]:
+    """Path of the ffmpeg binary, or None."""
+    return shutil.which("ffmpeg")
+
+
+def wav_info(data: bytes) -> dict:
+    """(rate, channels, sample width, frames) of a WAV payload."""
+    with wave.open(io.BytesIO(data)) as w:
+        return {"rate": w.getframerate(), "channels": w.getnchannels(),
+                "sample_width": w.getsampwidth(), "frames": w.getnframes()}
+
+
+def _ffmpeg_transcode(data: bytes, rate: int) -> bytes:
+    """Pipe the payload through ffmpeg (the reference's subprocess design:
+    stdin/stdout pipes, no temp files)."""
+    proc = subprocess.run(
+        [ffmpeg_available(), "-hide_banner", "-loglevel", "error",
+         "-i", "pipe:0", "-ac", "1", "-ar", str(rate),
+         "-acodec", "pcm_s16le", "-f", "wav", "pipe:1"],
+        input=data, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        timeout=300)
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"ffmpeg transcode failed: {proc.stderr.decode()[-500:]}")
+    return proc.stdout
+
+
+def _wav_transcode(data: bytes, rate: int) -> bytes:
+    """Pure-numpy WAV -> 16 kHz mono s16 WAV (no external binary)."""
+    with wave.open(io.BytesIO(data)) as w:
+        src_rate = w.getframerate()
+        channels = w.getnchannels()
+        width = w.getsampwidth()
+        raw = w.readframes(w.getnframes())
+    if width == 2:
+        x = np.frombuffer(raw, dtype="<i2").astype(np.float32) / 32768.0
+    elif width == 1:  # unsigned 8-bit
+        x = (np.frombuffer(raw, dtype=np.uint8).astype(np.float32)
+             - 128.0) / 128.0
+    elif width == 4:
+        x = np.frombuffer(raw, dtype="<i4").astype(np.float32) / 2147483648.0
+    else:
+        raise ValueError(f"unsupported WAV sample width {width}")
+    if channels > 1:
+        x = x.reshape(-1, channels).mean(axis=1)  # downmix
+    if src_rate != rate and len(x):
+        n_out = max(int(round(len(x) * rate / src_rate)), 1)
+        x = np.interp(np.linspace(0, len(x) - 1, n_out),
+                      np.arange(len(x)), x)
+    pcm = np.clip(np.round(x * 32767.0), -32768, 32767).astype("<i2")
+    buf = io.BytesIO()
+    with wave.open(buf, "wb") as w:
+        w.setnchannels(1)
+        w.setsampwidth(2)
+        w.setframerate(rate)
+        w.writeframes(pcm.tobytes())
+    return buf.getvalue()
+
+
+def transcode_to_wav(data: bytes, src_format: str = "auto",
+                     rate: int = _TARGET_RATE) -> bytes:
+    """Any audio payload -> 16 kHz mono 16-bit WAV bytes.
+
+    ``src_format='auto'`` sniffs WAV by its RIFF header; everything else
+    needs ffmpeg (the reference's subprocess path).
+    """
+    data = bytes(data)
+    is_wav = (src_format == "wav"
+              or (src_format == "auto" and data[:4] == b"RIFF"))
+    if is_wav:
+        try:
+            info = wav_info(data)
+            if (info["rate"] == rate and info["channels"] == 1
+                    and info["sample_width"] == 2):
+                return data  # already canonical: no copy, no subprocess
+            return _wav_transcode(data, rate)
+        except (wave.Error, ValueError):
+            # malformed header or a width the numpy path doesn't speak
+            # (e.g. 24-bit studio PCM): let ffmpeg try
+            pass
+    if ffmpeg_available():
+        return _ffmpeg_transcode(data, rate)
+    raise RuntimeError(
+        f"transcoding {src_format!r} audio needs an ffmpeg binary on PATH "
+        "(only 8/16/32-bit WAV has a built-in converter); install ffmpeg or "
+        "pre-convert to 16 kHz mono 16-bit WAV")

@@ -612,10 +612,14 @@ class SpeechToTextSDK(CognitiveServiceBase):
     """Chunked-streaming speech transcription.
 
     Reference ``SpeechToTextSDK.scala:232-339`` pulls fixed-size audio chunks
-    (``PullAudioInputStream``) through the native SDK and concatenates
-    per-utterance results. Here each audio column value streams to the REST
-    endpoint in ``chunk_size`` pieces (sequential requests sharing one
-    connection id) and the per-chunk DisplayText results merge in order."""
+    (``PullAudioInputStream``) through the native SDK — converting arbitrary
+    input streams with an ffmpeg subprocess first (``:232-269``) — and
+    concatenates per-utterance results. Here each audio column value is
+    transcoded to canonical 16 kHz mono PCM (``cognitive.audio``: ffmpeg
+    pipes for compressed formats, a built-in numpy path for WAV) and then
+    streams to the REST endpoint in ``chunk_size`` pieces (sequential
+    requests sharing one connection id); the per-chunk DisplayText results
+    merge in order."""
 
     audio_col = Param("audio bytes column", str, default="audio")
     language = Param("recognition language", str, default="en-US")
@@ -623,6 +627,13 @@ class SpeechToTextSDK(CognitiveServiceBase):
                    validator=ParamValidators.in_list(["simple", "detailed"]))
     chunk_size = Param("streaming chunk bytes", int, default=32768,
                        validator=ParamValidators.gt(0))
+    audio_format = Param("input audio format: auto (sniff WAV, ffmpeg for "
+                         "the rest) | wav | mp3 | ogg | flac | ... "
+                         "(reference fileType / ffmpeg path)", str,
+                         default="auto")
+    transcode = Param("convert input to 16 kHz mono 16-bit WAV before "
+                      "streaming (reference's ffmpeg conversion; off sends "
+                      "raw bytes)", bool, default=True)
 
     url_path = "/speech/recognition/conversation/cognitiveservices/v1"
     _service_domain = "stt.speech.microsoft.com"
@@ -647,6 +658,19 @@ class SpeechToTextSDK(CognitiveServiceBase):
                 out[i] = errors[i] = None
                 continue
             audio = bytes(audio)
+            if self.transcode:
+                from .audio import transcode_to_wav
+
+                try:
+                    audio = transcode_to_wav(audio,
+                                             src_format=self.audio_format)
+                except Exception as e:
+                    # a bad row lands in the errors column like a failed
+                    # HTTP chunk does — it must not abort the whole batch
+                    out[i] = None
+                    errors[i] = {"status_code": 0,
+                                 "reason": f"transcode failed: {e}"}
+                    continue
             chunks = [audio[o:o + self.chunk_size]
                       for o in range(0, len(audio), self.chunk_size)] or [b""]
             texts: List[str] = []

@@ -1,12 +1,14 @@
 """Deep-learning stages: ONNX-backed featurization + model repository
 (reference: ``deep-learning`` module)."""
 
-from .downloader import LocalRepository, ModelDownloader, ModelSchema, Repository, ZooRepository
+from .downloader import (LocalRepository, ModelDownloader, ModelSchema,
+                         RemoteRepository, Repository, ZooRepository)
 from .featurizer import ImageFeaturizer
 
 __all__ = [
     "ImageFeaturizer",
     "ModelDownloader",
+    "RemoteRepository",
     "ModelSchema",
     "Repository",
     "LocalRepository",

@@ -17,7 +17,8 @@ import json
 import os
 from typing import Dict, Iterator, List, Optional
 
-__all__ = ["ModelSchema", "Repository", "LocalRepository", "ZooRepository", "ModelDownloader"]
+__all__ = ["ModelSchema", "Repository", "LocalRepository", "ZooRepository",
+           "RemoteRepository", "ModelDownloader"]
 
 
 @dataclasses.dataclass
@@ -126,6 +127,56 @@ class ZooRepository(Repository):
         from ..models.zoo import build_model_bytes
 
         return build_model_bytes(schema.name)
+
+
+class RemoteRepository(Repository):
+    """HTTP(S) model repository with hash verification (reference
+    ``ModelDownloader.scala:26-263`` — the Azure-blob default repo's
+    contract over any static file host).
+
+    Layout: ``<base_url>/index.json`` is a JSON LIST of model schemas
+    (:class:`ModelSchema` dicts); each schema's ``path`` is resolved
+    relative to ``base_url``. ``read_bytes`` verifies the schema's sha256
+    against the fetched payload — the reference's corrupt-download guard.
+    Retries ride :func:`synapseml_tpu.io.clients.send_with_retries`, which
+    retries ONLY transient statuses (429/5xx/connection errors) — a 404
+    fails fast instead of backing off toward an outcome that cannot change.
+    """
+
+    def __init__(self, base_url: str, timeout: float = 60.0,
+                 backoffs_ms=(200, 400, 800)):
+        self.base_url = base_url.rstrip("/")
+        self.timeout = timeout
+        self.backoffs_ms = tuple(backoffs_ms)
+        self._index: Optional[List[ModelSchema]] = None
+
+    def _fetch(self, url: str) -> bytes:
+        from ..io.clients import send_with_retries
+        from ..io.http_schema import HTTPRequestData
+
+        resp = send_with_retries(HTTPRequestData(url=url, method="GET"),
+                                 timeout=self.timeout,
+                                 backoffs_ms=self.backoffs_ms)
+        if resp.status_code != 200:
+            raise IOError(f"GET {url} -> {resp.status_code} {resp.reason}")
+        return resp.entity or b""
+
+    def list_schemas(self) -> Iterator[ModelSchema]:
+        if self._index is None:
+            raw = json.loads(self._fetch(self.base_url + "/index.json"))
+            self._index = [ModelSchema(**d) for d in raw]
+        return iter(self._index)
+
+    def read_bytes(self, schema: ModelSchema) -> bytes:
+        url = (schema.path if schema.path.startswith(("http://", "https://"))
+               else f"{self.base_url}/{schema.path}")
+        data = self._fetch(url)
+        if schema.sha256 and _sha256(data) != schema.sha256:
+            raise IOError(
+                f"hash mismatch for model {schema.name} from {url}: expected "
+                f"{schema.sha256[:12]}..., got {_sha256(data)[:12]}... "
+                "(corrupt download?)")
+        return data
 
 
 class ModelDownloader:

@@ -35,7 +35,12 @@ def _topk_kernel(k: int, has_mask: bool):
 
     @jax.jit
     def run(q, kk, m):
-        scores = q @ kk.T  # (nq, N) on the MXU
+        # HIGHEST precision: the contract is EXACT inner products (the
+        # reference's BLAS brute force); TPU default matmul precision
+        # rounds f32 operands through bf16 passes, which shifts distances
+        # by ~1e-3 relative and can flip near-tie rankings
+        scores = jnp.matmul(q, kk.T,
+                            precision=jax.lax.Precision.HIGHEST)  # (nq, N)
         if has_mask:
             scores = jnp.where(m, scores, -jnp.inf)
         return jax.lax.top_k(scores, k)

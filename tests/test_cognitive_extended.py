@@ -210,7 +210,7 @@ def test_speech_to_text_sdk_streams_chunks(stub):
     audio[0] = b"x" * 2500  # 3 chunks of 1000
     t = Table({"audio": audio})
     stt = SpeechToTextSDK(url=stub + "/speech", subscription_key="K",
-                          chunk_size=1000)
+                          chunk_size=1000, transcode=False)
     out = stt.transform(t)
     assert out["errors"][0] is None
     assert out["output"][0]["DisplayText"] == "part0 part1 part2"
@@ -271,7 +271,7 @@ def test_conversation_transcription_streams(stub):
     audio = bytes(range(256)) * 8
     t = Table({"audio": np.array([audio], dtype=object)})
     ct = ConversationTranscription(url=stub + "/speech", subscription_key="K",
-                                   chunk_size=1024)
+                                   chunk_size=1024, transcode=False)
     out = ct.transform(t)
     assert out["errors"][0] is None
     # diarization rides the query string; chunks merged in order
@@ -279,3 +279,70 @@ def test_conversation_transcription_streams(stub):
     assert all("diarizationEnabled=true" in r["path"] for r in sp)
     assert len(sp) == 2  # 2048 bytes / 1024
     assert out["output"][0]["DisplayText"] == "part0 part1"
+
+
+def _make_wav(rate=44100, channels=2, seconds=0.2, width=2):
+    import io
+    import wave
+
+    n = int(rate * seconds)
+    t = np.arange(n) / rate
+    x = np.sin(2 * np.pi * 440 * t)
+    pcm = np.round(x * 30000).astype("<i2")
+    if channels == 2:
+        pcm = np.column_stack([pcm, pcm]).reshape(-1)
+    buf = io.BytesIO()
+    with wave.open(buf, "wb") as w:
+        w.setnchannels(channels)
+        w.setsampwidth(width)
+        w.setframerate(rate)
+        w.writeframes(pcm.tobytes())
+    return buf.getvalue()
+
+
+def test_transcode_to_wav_resamples_and_downmixes():
+    """The ffmpeg-subprocess analogue (reference SpeechToTextSDK.scala:
+    232-269): 44.1 kHz stereo in -> canonical 16 kHz mono 16-bit out, via
+    the built-in WAV path (no external binary needed)."""
+    from synapseml_tpu.cognitive.audio import transcode_to_wav, wav_info
+
+    src = _make_wav(rate=44100, channels=2)
+    out = transcode_to_wav(src)
+    info = wav_info(out)
+    assert info == {"rate": 16000, "channels": 1, "sample_width": 2,
+                    "frames": info["frames"]}
+    assert abs(info["frames"] - int(0.2 * 16000)) <= 2
+    # canonical input passes through byte-identical (no copy, no resample)
+    assert transcode_to_wav(out) == out
+
+
+def test_transcode_unsupported_without_ffmpeg():
+    from synapseml_tpu.cognitive.audio import ffmpeg_available, transcode_to_wav
+
+    if ffmpeg_available():
+        import pytest
+
+        pytest.skip("ffmpeg present: compressed formats are supported here")
+    import pytest
+
+    with pytest.raises(RuntimeError, match="ffmpeg"):
+        transcode_to_wav(b"\xff\xfb" + b"\x00" * 100, src_format="mp3")
+
+
+def test_speech_sdk_transcodes_before_streaming(stub):
+    """End-to-end: a 44.1 kHz stereo WAV streams as 16 kHz mono chunks."""
+    from synapseml_tpu.cognitive.audio import wav_info
+
+    src = _make_wav(rate=44100, channels=2, seconds=0.5)
+    audio = np.empty(1, dtype=object)
+    audio[0] = src
+    t = Table({"audio": audio})
+    stt = SpeechToTextSDK(url=stub + "/speech", subscription_key="K",
+                          chunk_size=1 << 20)  # one chunk: full payload
+    out = stt.transform(t)
+    assert out["errors"][0] is None
+    sent = [r for r in RECORDED if r["path"].startswith("/speech")][-1]
+    body = sent["body"] if isinstance(sent["body"], bytes) else \
+        sent["body"].encode("latin1")
+    info = wav_info(body)
+    assert info["rate"] == 16000 and info["channels"] == 1

