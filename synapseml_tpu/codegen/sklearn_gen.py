@@ -201,12 +201,16 @@ class _SkBase(_SkParent):
 
 
 def sklearn_estimator_names() -> List[str]:
-    """Registered estimators that get wrappers (sorted; Pipeline excluded —
-    its stage-list param is not a scalar sklearn param surface)."""
+    """Registered LIBRARY estimators that get wrappers (sorted; Pipeline
+    excluded — its stage-list param is not a scalar sklearn param surface).
+    Restricted to ``synapseml_tpu.*`` modules: the registry is global, so
+    user/test-defined estimators registered earlier in a process must not
+    leak into (or drift-fail) the committed generated surface."""
     import_all_stage_modules()
     return sorted(
         n for n, c in STAGE_REGISTRY.items()
-        if issubclass(c, Estimator) and n != "Pipeline")
+        if issubclass(c, Estimator) and n != "Pipeline"
+        and c.__module__.startswith("synapseml_tpu."))
 
 
 def _wrapper_source(name: str) -> str:
