@@ -415,7 +415,92 @@ def bench_serving(platform):
     }
 
 
-def _load_prev_round():
+def _balanced_json_at(s: str, start: int):
+    """Parse the balanced ``{...}`` object starting at ``s[start]`` (which
+    must be ``{``); None if unterminated or invalid."""
+    try:
+        obj, _ = json.JSONDecoder().raw_decode(s, start)
+        return obj
+    except Exception:
+        return None
+
+
+def _recover_extra_from_tail(tail: str) -> dict:
+    """Salvage per-config objects out of a TRUNCATED bench artifact tail.
+
+    The driver records only the last ~2KB of stdout; a huge embedded error
+    string (r4's TracerArrayConversionError) can push the front of the JSON
+    line out of the window, leaving ``parsed: null``. The per-config
+    sub-objects that survived in the window are still individually valid
+    JSON — pull each ``"<config>": {...}`` out by brace matching.
+    """
+    import re
+
+    out = {}
+    keys = list(_PRIMARY) + ["serving_latency", "vs_prev_round"]
+    for key in keys:
+        for m in re.finditer(r'"%s":\s*(\{)' % re.escape(key), tail):
+            obj = _balanced_json_at(tail, m.start(1))
+            if isinstance(obj, dict):
+                out[key] = obj  # last complete occurrence wins
+    return out
+
+
+def _load_round_file(path: str, rnd: int, allow_chain: bool = True):
+    """One BENCH_r{N}.json -> (round_no, headline, extra), surviving a
+    damaged artifact (``parsed: null`` / truncated tail).
+
+    Recovery ladder: (1) ``parsed`` when intact; (2) per-config objects
+    brace-matched out of ``tail``; (3) configs still missing after (2) are
+    reconstructed from the artifact's own ``vs_prev_round`` ratios times the
+    PREVIOUS round's absolute numbers (ratio r_N/r_{N-1} x value_{N-1} =
+    value_N) — so one damaged round cannot sever the ratchet chain."""
+    import os
+    import re
+
+    try:
+        with open(path) as f:
+            d = json.load(f)
+    except Exception:
+        return None
+    parsed = d.get("parsed")
+    if isinstance(parsed, dict):
+        return (rnd, parsed.get("value"), parsed.get("extra") or {})
+    extra = _recover_extra_from_tail(d.get("tail") or "")
+    if allow_chain:
+        vpr = extra.get("vs_prev_round") or {}
+        ratios = vpr.get("per_config") or {}
+        base_rnd = vpr.get("round")
+        if isinstance(base_rnd, int) and ratios:
+            base_path = os.path.join(os.path.dirname(os.path.abspath(path)),
+                                     f"BENCH_r{base_rnd:02d}.json")
+            if not os.path.exists(base_path):
+                base_path = re.sub(r"BENCH_r\d+\.json$",
+                                   f"BENCH_r{base_rnd}.json", path)
+            base = _load_round_file(base_path, base_rnd, allow_chain=False)
+            if base is not None:
+                _, _, base_extra = base
+                for key, metric in _PRIMARY.items():
+                    ratio = ratios.get(key)
+                    old = (base_extra.get(key) or {}).get(metric) \
+                        if isinstance(base_extra.get(key), dict) else None
+                    cur = extra.get(key)
+                    have = (isinstance(cur, dict)
+                            and isinstance(cur.get(metric), (int, float)))
+                    if (not have and isinstance(ratio, (int, float))
+                            and isinstance(old, (int, float))):
+                        extra[key] = {metric: round(old * ratio, 2),
+                                      "reconstructed_from_ratio": True}
+    headline = None
+    rn = extra.get("resnet50_onnx")
+    if isinstance(rn, dict):
+        headline = rn.get("images_per_sec_per_chip")
+    if not extra:
+        return None
+    return (rnd, headline, extra)
+
+
+def _load_prev_round(here=None):
     """Latest committed BENCH_r{N}.json -> (round_no, headline, extra).
 
     The driver writes ``BENCH_r{N}.json`` AFTER round N, so during a round
@@ -427,33 +512,29 @@ def _load_prev_round():
     import os
     import re
 
-    here = os.path.dirname(os.path.abspath(__file__))
+    if here is None:
+        here = os.path.dirname(os.path.abspath(__file__))
     pin = os.environ.get("BENCH_BASELINE_ROUND")
     try:
         pin = int(pin) if pin is not None else None
     except ValueError:
         pin = None  # bad pin must not break the one-JSON-line contract
-    best = None
+    rounds = []
     for path in glob.glob(os.path.join(here, "BENCH_r*.json")):
         m = re.search(r"BENCH_r(\d+)\.json$", path)
         if not m:
             continue
         rnd = int(m.group(1))
-        if pin is not None:
-            if rnd == pin:
-                best = (rnd, path)
+        if pin is not None and rnd != pin:
             continue
-        if best is None or rnd > best[0]:
-            best = (rnd, path)
-    if best is None:
-        return None
-    try:
-        with open(best[1]) as f:
-            d = json.load(f)
-        parsed = d.get("parsed") or {}
-        return (best[0], parsed.get("value"), parsed.get("extra") or {})
-    except Exception:
-        return None
+        rounds.append((rnd, path))
+    # newest first; if the latest artifact is damaged beyond recovery, fall
+    # back to the next-oldest intact one rather than severing the chain
+    for rnd, path in sorted(rounds, reverse=True):
+        got = _load_round_file(path, rnd)
+        if got is not None:
+            return got
+    return None
 
 
 # per-config primary metric (higher is better) used for round-over-round deltas
@@ -508,14 +589,17 @@ def main() -> None:
         try:
             extra[key] = fn()
         except Exception as first:
-            msg = f"{type(first).__name__}: {first}"
+            # cap the recorded message: a multi-KB traceback embedded in the
+            # one-line JSON pushed the line's FRONT out of the driver's 2KB
+            # tail window in r4, nulling `parsed` for the whole round
+            msg = f"{type(first).__name__}: {first}"[:300]
             if "remote_compile" in str(first) or "INTERNAL" in str(first):
                 # the tunneled backend throws transient remote-compile/read
                 # errors unrelated to the workload: one retry, recorded
                 try:
                     extra[key] = dict(fn(), retried_after=msg)
                 except Exception as e:
-                    extra[key] = {"error": f"{type(e).__name__}: {e}"}
+                    extra[key] = {"error": f"{type(e).__name__}: {e}"[:300]}
             else:
                 extra[key] = {"error": msg}
         if key == "resnet50_onnx" and "images_per_sec_per_chip" in extra[key]:

@@ -556,8 +556,10 @@ class GBDTBooster:
 
         T = self._used_trees(num_iteration)
         table, lens, cat_flags = pack_feature_table(self.mapper)
-        binned = device_bin_cat(x, jnp.asarray(table), jnp.asarray(lens),
-                                jnp.asarray(cat_flags),
+        # table/lens/cat_flags stay numpy: they are model constants, and
+        # host arrays keep this whole method traceable under an outer jit
+        # (a traced cat_flags broke BASELINE config #5 in r4)
+        binned = device_bin_cat(x, table, lens, cat_flags,
                                 self.mapper.missing_bin)
         if T == 0:
             return jnp.tile(jnp.asarray(self.base_score, jnp.float32),
@@ -1521,8 +1523,8 @@ def train(params: Dict[str, Any], x: np.ndarray, y: Optional[np.ndarray] = None,
             xb = jnp.asarray(np.ascontiguousarray(
                 x32 if x32 is not None else x.astype(np.float32)))
             binned_d = device_bin_cat(
-                xb, jnp.asarray(table), jnp.asarray(lens),
-                jnp.asarray(cat_flags), mapper.missing_bin).astype(bin_dtype)
+                xb, table, lens, cat_flags,
+                mapper.missing_bin).astype(bin_dtype)
         else:
             binned_d = jnp.asarray(binned_np.astype(bin_dtype))
         # y that arrived as a device array stays put; unit weights and the

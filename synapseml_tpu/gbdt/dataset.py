@@ -90,8 +90,7 @@ class GBDTDataset:
             self.bin_dtype = bin_dtype(self.mapper.n_bins)
             table, lens, cat_flags = pack_feature_table(self.mapper)
             self._device = device_bin_cat(
-                x, jnp.asarray(table), jnp.asarray(lens),
-                jnp.asarray(cat_flags),
+                x, table, lens, cat_flags,
                 self.mapper.missing_bin).astype(self.bin_dtype)
             self.binned_np = None  # materialized lazily (host_binned pulls)
             return

@@ -218,9 +218,21 @@ def device_bin_cat(x, table, lens, cat_flags, missing_bin: int):
     ``BinMapper.transform_column``). The kernel specializes on whether any
     categorical feature exists: the ``<=`` reduction is a second full pass
     over (n, d, E) and must not tax purely-numeric multi-million-row
-    ingest."""
+    ingest.
+
+    ``cat_flags`` is STATIC model metadata (it selects which kernel to
+    build) and must be a host array — never a traced value. Keeping it on
+    host is what lets the whole function run under an outer ``jax.jit``
+    (e.g. a fused featurizer->GBDT pipeline step): only ``x`` may be a
+    tracer."""
+    import jax
     import jax.numpy as jnp
 
+    if isinstance(cat_flags, jax.core.Tracer):
+        raise TypeError(
+            "device_bin_cat: cat_flags is static model metadata and must be "
+            "a host (numpy) array, not a traced jax value — pass the numpy "
+            "cat_flags from pack_feature_table directly")
     cat_flags_np = np.asarray(cat_flags)
     has_cat = bool(cat_flags_np.any())
     kern = _device_bin_cat_kernel(int(missing_bin), has_cat)

@@ -1,0 +1,137 @@
+"""Round-over-round bench ratchet recovery.
+
+The driver records only the tail of bench stdout; r4 proved a multi-KB
+embedded traceback can truncate the JSON line's front, leaving
+``parsed: null``. These tests pin the armored loader: per-config objects are
+brace-matched out of the damaged tail, and configs whose fragments fell
+outside the window are reconstructed from the artifact's own
+``vs_prev_round`` ratios against the previous round's intact numbers.
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import bench  # noqa: E402
+
+# a faithful miniature of the r4 failure: front of the JSON line truncated
+# away (mid-way through one config), later configs + vs_prev_round intact
+_DAMAGED_TAIL = (
+    '0444.0, "rows": 500000, "ingest_s": 14.48}, '
+    '"vit_to_gbdt_pipeline": {"error": "TracerArrayConversionError: '
+    'traced array with shape int8[768]"}, '
+    '"flash_attention_32k": {"seq_len": 32768, "ms_per_fwd": 30.34, '
+    '"tflops_nominal": 72.5, "mfu_vs_bf16_peak": 0.3679}, '
+    '"serving_latency": {"continuous_p50_ms": 0.303, '
+    '"microbatch_p99_ms": 1.193}, '
+    '"vs_prev_round": {"round": 3, "per_config": {"resnet50_onnx": 0.984, '
+    '"gbdt_adult_scale": 0.966, "bert_base_onnx": 1.001, '
+    '"gbdt_higgs_scale": 1.002, "flash_attention_32k": 1.608}}}}\n'
+)
+
+_R3_PARSED = {
+    "metric": "resnet50_onnx_images_per_sec_per_chip",
+    "value": 10273.0,
+    "extra": {
+        "resnet50_onnx": {"images_per_sec_per_chip": 10273.0, "mfu": 0.43},
+        "gbdt_adult_scale": {"train_rows_per_sec": 1137000.0},
+        "bert_base_onnx": {"sequences_per_sec_per_chip": 1650.0},
+        "gbdt_higgs_scale": {"train_rows_per_sec": 7900000.0},
+        "vit_to_gbdt_pipeline": {"images_per_sec_end_to_end": 1984.0},
+        "flash_attention_32k": {"tflops_nominal": 45.1},
+    },
+}
+
+
+def _write_rounds(tmp_path):
+    (tmp_path / "BENCH_r03.json").write_text(json.dumps(
+        {"n": 3, "rc": 0, "tail": "", "parsed": _R3_PARSED}))
+    (tmp_path / "BENCH_r04.json").write_text(json.dumps(
+        {"n": 4, "rc": 0, "tail": _DAMAGED_TAIL, "parsed": None}))
+
+
+def test_recover_extra_from_tail_brace_matching():
+    extra = bench._recover_extra_from_tail(_DAMAGED_TAIL)
+    # intact fragments recovered verbatim
+    assert extra["flash_attention_32k"]["tflops_nominal"] == 72.5
+    assert extra["serving_latency"]["continuous_p50_ms"] == 0.303
+    assert extra["vit_to_gbdt_pipeline"] == {
+        "error": "TracerArrayConversionError: traced array with shape int8[768]"}
+    assert extra["vs_prev_round"]["round"] == 3
+    # the front-truncated config is (correctly) absent, not mangled
+    assert "gbdt_sparse_hashed" not in extra
+
+
+def test_load_prev_round_survives_damaged_artifact(tmp_path):
+    _write_rounds(tmp_path)
+    got = bench._load_prev_round(here=str(tmp_path))
+    assert got is not None
+    rnd, headline, extra = got
+    assert rnd == 4
+    # resnet's fragment fell outside the tail window -> reconstructed from
+    # ratio x r3 absolute: 0.984 * 10273
+    assert abs(extra["resnet50_onnx"]["images_per_sec_per_chip"]
+               - 0.984 * 10273.0) < 0.5
+    assert extra["resnet50_onnx"]["reconstructed_from_ratio"] is True
+    assert headline == extra["resnet50_onnx"]["images_per_sec_per_chip"]
+    assert abs(extra["gbdt_adult_scale"]["train_rows_per_sec"]
+               - 0.966 * 1137000.0) < 1.0
+    # configs recovered directly from the tail are NOT overwritten by ratios
+    assert extra["flash_attention_32k"]["tflops_nominal"] == 72.5
+    assert "reconstructed_from_ratio" not in extra["flash_attention_32k"]
+    # downstream: _vs_prev computes real per-config deltas against this
+    cur = {"resnet50_onnx": {"images_per_sec_per_chip": 10300.0},
+           "vit_to_gbdt_pipeline": {"images_per_sec_end_to_end": 2100.0}}
+    deltas = bench._vs_prev(cur, got)
+    assert "resnet50_onnx" in deltas
+    # vit had no number in r4 (error) -> no ratio, correctly absent
+    assert "vit_to_gbdt_pipeline" not in deltas
+
+
+def test_load_prev_round_falls_back_past_unrecoverable_round(tmp_path):
+    """A round whose tail holds NO complete fragment must not sever the
+    chain — the loader walks back to the newest intact round."""
+    _write_rounds(tmp_path)
+    (tmp_path / "BENCH_r05.json").write_text(json.dumps(
+        {"n": 5, "rc": 1, "tail": "Traceback (most recent call last):\n ...",
+         "parsed": None}))
+    rnd, headline, extra = bench._load_prev_round(here=str(tmp_path))
+    assert rnd == 4  # r5 unrecoverable -> the recovered r4, not None
+    assert isinstance(headline, (int, float))
+
+
+def test_load_prev_round_intact_artifact_unchanged(tmp_path):
+    (tmp_path / "BENCH_r03.json").write_text(json.dumps(
+        {"n": 3, "rc": 0, "tail": "", "parsed": _R3_PARSED}))
+    rnd, headline, extra = bench._load_prev_round(here=str(tmp_path))
+    assert (rnd, headline) == (3, 10273.0)
+    assert extra["gbdt_adult_scale"]["train_rows_per_sec"] == 1137000.0
+
+
+def test_load_prev_round_real_r4_artifact():
+    """The actual committed damaged r4 artifact must yield usable numbers."""
+    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    path = os.path.join(here, "BENCH_r04.json")
+    if not os.path.exists(path):
+        return  # artifact rotated away in a later round
+    with open(path) as f:
+        d = json.load(f)
+    if d.get("parsed") is not None:
+        return  # repaired upstream; nothing to recover
+    got = bench._load_round_file(path, 4)
+    assert got is not None
+    _, headline, extra = got
+    assert isinstance(
+        extra["flash_attention_32k"].get("tflops_nominal"), (int, float))
+    # chained reconstruction through the committed r3 artifact
+    assert isinstance(headline, (int, float)) and headline > 0
+
+
+def test_error_strings_capped():
+    """bench.main caps recorded errors at 300 chars (source-level pin)."""
+    with open(os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "bench.py")) as f:
+        src = f.read()
+    assert "[:300]" in src

@@ -759,6 +759,48 @@ def test_device_pipeline_predict_matches_host():
     np.testing.assert_allclose(host, dev, rtol=1e-5, atol=1e-5)
 
 
+def test_predict_device_jit_composable():
+    """predict_device must trace under an OUTER jax.jit — the fused
+    featurizer->GBDT pipeline (BASELINE config #5) jit-wraps the whole step.
+    r4 regression: a traced cat_flags raised TracerArrayConversionError."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    rng = np.random.default_rng(71)
+    x = rng.normal(size=(256, 8))
+    y = (x[:, 0] - x[:, 4] > 0).astype(np.float64)
+    b = train({"objective": "binary", "num_iterations": 8, "num_leaves": 7}, x, y)
+    xj = jnp.asarray(x, jnp.float32)
+    eager = np.asarray(b.predict_device(xj))
+    jitted = np.asarray(jax.jit(lambda z: b.predict_device(z))(xj))
+    np.testing.assert_allclose(eager, jitted, rtol=1e-6, atol=1e-6)
+    # and inside lax.fori_loop (single fused XLA program, no host dispatch)
+    total = jax.jit(
+        lambda: lax.fori_loop(
+            0, 2, lambda i, acc: acc + b.predict_device(xj).sum(), 0.0))()
+    np.testing.assert_allclose(float(total), 2.0 * eager.sum(), rtol=1e-5)
+
+
+def test_predict_device_jit_composable_categorical():
+    """Same jit-composability with a categorical model (device category
+    lookup path)."""
+    import jax
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(72)
+    n = 400
+    cats = rng.integers(0, 6, size=n).astype(np.float64)
+    x = np.stack([cats, rng.normal(size=n)], axis=1)
+    y = np.isin(cats, [1, 3]).astype(np.float64) + 0.1 * x[:, 1]
+    b = train({"objective": "regression", "num_iterations": 5, "num_leaves": 6,
+               "min_data_in_leaf": 5, "categorical_feature": [0]}, x, y)
+    xj = jnp.asarray(x, jnp.float32)
+    eager = np.asarray(b.predict_device(xj))
+    jitted = np.asarray(jax.jit(lambda z: b.predict_device(z))(xj))
+    np.testing.assert_allclose(eager, jitted, rtol=1e-6, atol=1e-6)
+
+
 def test_gbdt_max_depth_and_delta_step(data):
     """maxDepth caps leaf-wise growth; maxDeltaStep clamps leaf outputs
     (reference LightGBMParams maxDepth/maxDeltaStep)."""
