@@ -216,11 +216,10 @@ class BinMapper:
         ``rows - nnz_j`` implicit zeros; quantile edges are computed as
         weighted quantiles with the zero mass folded in as one weighted
         point. Distinct-value features (the common case for hashed
-        counts) get the exact per-value bins of the dense path."""
-        if self.categorical_features:
-            raise NotImplementedError(
-                "categorical features are not supported for sparse input "
-                "(hash them through the featurizer instead)")
+        counts) get the exact per-value bins of the dense path.
+        Categorical features count stored values (implicit zeros carry the
+        zero-category's mass) and keep the most frequent ``max_bin``
+        categories, exactly like :meth:`fit` on the densified matrix."""
         n, d = csr.shape
         if self.max_bin_by_feature and len(self.max_bin_by_feature) != d:
             raise ValueError(
@@ -237,11 +236,31 @@ class BinMapper:
         edges: List[np.ndarray] = [None] * d
         self.cat_values = {}
         zero_edge = np.array([np.inf])
+        cat_feats = set(self.categorical_features)
         for j in range(d):
             lo, hi = starts[j], starts[j + 1]
             col = vals_sorted[lo:hi]
             col = col[np.isfinite(col)]
             n_zero_implicit = s_n - (hi - lo)
+            if j in cat_feats:
+                # category universe = stored values + the implicit zero
+                # category; keep the most frequent max_bin (same policy as
+                # the dense fit on the densified column)
+                vals, counts = np.unique(col, return_counts=True)
+                if n_zero_implicit > 0:
+                    pos = np.searchsorted(vals, 0.0)
+                    if pos < len(vals) and vals[pos] == 0.0:
+                        counts[pos] += n_zero_implicit
+                    else:
+                        vals = np.insert(vals, pos, 0.0)
+                        counts = np.insert(counts, pos, n_zero_implicit)
+                fmb = self._feature_max_bin(j)
+                if len(vals) > fmb:
+                    keep = np.argsort(-counts, kind="stable")[:fmb]
+                    vals = vals[keep]
+                self.cat_values[j] = np.sort(vals)
+                edges[j] = zero_edge  # placeholder, unused for cat
+                continue
             if col.size == 0:
                 edges[j] = zero_edge  # all-zero feature: single bin
                 continue
@@ -295,8 +314,13 @@ class BinMapper:
             if hi == lo:
                 continue
             j = int(cols_sorted[lo])
-            e = self.upper_edges[j]
             seg = vals_sorted[lo:hi]
+            if j in self.cat_values:
+                # exact-match category code (unseen/NaN -> missing bin),
+                # identical to transform_column on the densified column
+                out_sorted[lo:hi] = self.transform_column(j, seg)
+                continue
+            e = self.upper_edges[j]
             b = np.searchsorted(e, seg, side="left")
             np.clip(b, 0, len(e) - 1, out=b)
             b[~np.isfinite(seg)] = self.missing_bin

@@ -425,13 +425,14 @@ class GBDTBooster:
         branch, matching the float-threshold semantics)."""
         return self.mapper.transform(np.asarray(x, dtype=np.float64))
 
-    def _csr_used_binned(self, csr, T: int):
-        """Bin ONLY the features the first ``T`` trees reference — the CSR
-        predict path (reference ``predictForCSR``,
-        ``LightGBMBooster.scala:510``). At hashed-text width the full (n, d)
-        bin matrix is unbuildable, but trees touch at most T*(L-1) distinct
-        features: densify that submatrix (implicit entries are true zeros)
-        and remap tree feature ids into it. Returns ``(binned, feats)``."""
+    def _csr_used_sub(self, csr, T: int):
+        """Densify ONLY the features the first ``T`` trees reference.
+
+        At hashed-text width the full (n, d) matrix is unbuildable, but
+        trees touch at most T*(L-1) distinct features. Returns
+        ``(sub, F, feats)``: the raw (n, |F|) float submatrix (implicit
+        entries are true zeros), the ascending used-feature ids, and the
+        tree feature arrays remapped into submatrix columns."""
         n, d = csr.shape
         if d != self.mapper.n_features:
             raise ValueError(f"expected {self.mapper.n_features} features, "
@@ -446,11 +447,22 @@ class GBDTBooster:
         hi = np.searchsorted(cols_sorted, F, side="right")
         for k in range(len(F)):
             sub[rows_sorted[lo[k]:hi[k]], k] = vals_sorted[lo[k]:hi[k]]
-        binned = np.empty((n, len(F)), dtype=np.int32)
+        feats = np.searchsorted(F, self.feature[:T]).astype(np.int32)
+        return sub, F, feats
+
+    def _bin_used_sub(self, sub: np.ndarray, F: np.ndarray) -> np.ndarray:
+        """Bin a densified used-feature submatrix column by column."""
+        binned = np.empty(sub.shape, dtype=np.int32)
         for k, j in enumerate(F):
             binned[:, k] = self.mapper.transform_column(int(j), sub[:, k])
-        feats = np.searchsorted(F, self.feature[:T]).astype(np.int32)
-        return binned, feats
+        return binned
+
+    def _csr_used_binned(self, csr, T: int):
+        """Bin ONLY the features the first ``T`` trees reference — the CSR
+        predict path (reference ``predictForCSR``,
+        ``LightGBMBooster.scala:510``). Returns ``(binned, feats)``."""
+        sub, F, feats = self._csr_used_sub(csr, T)
+        return self._bin_used_sub(sub, F), feats
 
     def _leaf_of_binned(self, binned: np.ndarray, t: int, c: int,
                         feature: Optional[np.ndarray] = None) -> np.ndarray:
@@ -626,38 +638,83 @@ class GBDTBooster:
         return out
 
     def predict_contrib(self, x: np.ndarray, num_iteration: Optional[int] = None,
-                        approximate: bool = False) -> np.ndarray:
+                        approximate: bool = False):
         """Per-feature contributions + expected value (last column).
 
         Default is EXACT TreeSHAP (Lundberg's path algorithm, matching the
         reference's ``featuresShap`` / C++ TreeSHAP at
         ``LightGBMBooster.scala:510,529``); ``approximate=True`` selects the
         cheaper Saabas path attribution.
+
+        Sparse input (reference ``predictForCSR`` contrib dispatch,
+        ``LightGBMBooster.scala:397-419,510``): contributions are computed
+        over the used-feature submatrix — a feature appearing in no tree has
+        exactly zero SHAP value, so the result is returned as a
+        :class:`~.sparse.CSRMatrix` of shape (n, d+1) whose stored columns
+        are the trees' used features plus the expected-value column (a dense
+        (n, d+1) panel at hashed-feature width would be terabytes). For
+        multiclass a list of per-class CSRMatrix is returned; densified it
+        matches the dense path bit-for-bit.
         """
-        from .sparse import is_sparse_input
+        from .sparse import as_csr, is_sparse_input
 
         if is_sparse_input(x):
-            raise NotImplementedError(
-                "per-feature contributions over sparse input would "
-                "materialize a dense (n, d+1) panel at hashed-feature width; "
-                "densify a column subset first")
+            return self._predict_contrib_sparse(as_csr(x), num_iteration,
+                                                approximate)
+        x = np.asarray(x, dtype=np.float64)
+        n, d = x.shape
         if not approximate:
-            return self._predict_contrib_shap(x, num_iteration)
+            out = self._contrib_shap_panel(self._binned(x), self.feature,
+                                           n, d, num_iteration)
+        else:
+            out = self._contrib_saabas_panel(x, self.feature, self.threshold,
+                                             n, d, num_iteration)
+        C = self.num_class
+        out[:, :, d] += self.base_score[:, None]
+        return out[0] if C == 1 else out
+
+    def _predict_contrib_sparse(self, csr, num_iteration, approximate):
+        from .sparse import CSRMatrix
+
+        T = self._used_trees(num_iteration)
+        n, d = csr.shape
+        C = self.num_class
+        sub, F, feats = self._csr_used_sub(csr, T)
+        dF = len(F)
+        if not approximate:
+            out = self._contrib_shap_panel(self._bin_used_sub(sub, F), feats,
+                                           n, dF, num_iteration)
+        else:
+            # thresholds index by split slot, not feature — no remap needed
+            out = self._contrib_saabas_panel(sub, feats, self.threshold[:T],
+                                             n, dF, num_iteration)
+        out[:, :, dF] += self.base_score[:, None]
+        cols = np.concatenate([F.astype(np.int64), [d]])
+        indptr = np.arange(0, n * (dF + 1) + 1, dF + 1, dtype=np.int64)
+        results = [CSRMatrix(indptr, np.tile(cols, n).astype(np.int32),
+                             out[c].reshape(-1), (n, d + 1))
+                   for c in range(C)]
+        return results[0] if C == 1 else results
+
+    def _contrib_saabas_panel(self, xv, featmap, thrmap, n, d,
+                              num_iteration) -> np.ndarray:
+        """Saabas attributions, (C, n, d+1) WITHOUT base_score.
+
+        ``xv`` (n, d) raw values; ``featmap`` (T, C, S) feature column per
+        split (possibly remapped into a submatrix); ``thrmap`` (T, C, S)
+        float thresholds."""
         if self.cat_set is not None:
             raise ValueError("approximate (Saabas) contributions don't support "
                              "categorical splits; use approximate=False")
-        x = np.asarray(x, dtype=np.float64)
         T = self._used_trees(num_iteration)
-        n, d = x.shape
         C = self.num_class
         out = np.zeros((C, n, d + 1), dtype=np.float64)
-        out[:, :, d] = self.base_score[:, None]  # sum(contrib) == raw_predict exactly
         for t in range(T):
             sc = self.tree_scale[t] * (1.0 / T if self.boosting == "rf" else 1.0)
             for c in range(C):
                 par = self.parent[t, c]
-                feat = self.feature[t, c]
-                thr = self.threshold[t, c]
+                feat = featmap[t, c]
+                thr = thrmap[t, c]
                 V = self.leaf_value[t, c].astype(np.float64).copy()
                 Hs = np.maximum(self.leaf_hess[t, c].astype(np.float64), 1e-12).copy()
                 L1 = par.shape[0]
@@ -678,7 +735,7 @@ class GBDTBooster:
                     p = par[s]
                     if p < 0:
                         continue
-                    col = x[:, feat[s]]
+                    col = xv[:, feat[s]]
                     at_p = node == p
                     with np.errstate(invalid="ignore"):
                         go_right = at_p & (np.isnan(col) | (col > thr[s]))
@@ -687,30 +744,37 @@ class GBDTBooster:
                     out[c, at_p, feat[s]] += (new[at_p] - cur[at_p]) * sc
                     node[go_right] = s + 1
                     cur = new
-        return out[0] if C == 1 else out
+        return out
 
-    def _predict_contrib_shap(self, x: np.ndarray,
-                              num_iteration: Optional[int] = None) -> np.ndarray:
-        """Exact TreeSHAP over all trees; additivity: row sum == raw_predict."""
+    def _contrib_shap_panel(self, binned, featmap, n, d,
+                            num_iteration) -> np.ndarray:
+        """Exact TreeSHAP, (C, n, d+1) WITHOUT base_score; additivity:
+        row sum + base == raw_predict."""
         from .treeshap import build_explicit_tree, expected_value, tree_shap
 
-        x = np.asarray(x, dtype=np.float64)
-        binned = self._binned(x)
         T = self._used_trees(num_iteration)
-        n, d = x.shape
         C = self.num_class
         out = np.zeros((C, n, d + 1), dtype=np.float64)
-        out[:, :, d] = self.base_score[:, None]
         for t in range(T):
             sc = self.tree_scale[t] * (1.0 / T if self.boosting == "rf" else 1.0)
             for c in range(C):
                 root = build_explicit_tree(
-                    self.parent[t, c], self.feature[t, c], self.bin[t, c],
+                    self.parent[t, c], featmap[t, c], self.bin[t, c],
                     self.leaf_value[t, c], self.leaf_hess[t, c],
                     self.cat_set[t, c] if self.cat_set is not None else None)
                 out[c, :, :d] += sc * tree_shap(root, binned, d)
                 out[c, :, d] += sc * expected_value(root)
-        return out[0] if C == 1 else out
+        return out
+
+    def _predict_contrib_shap(self, x: np.ndarray,
+                              num_iteration: Optional[int] = None) -> np.ndarray:
+        """Exact TreeSHAP over a dense matrix (kept for callers)."""
+        x = np.asarray(x, dtype=np.float64)
+        n, d = x.shape
+        out = self._contrib_shap_panel(self._binned(x), self.feature, n, d,
+                                       num_iteration)
+        out[:, :, d] += self.base_score[:, None]
+        return out[0] if self.num_class == 1 else out
 
     def feature_importance(self, importance_type: str = "split",
                            num_iteration: Optional[int] = None) -> np.ndarray:
@@ -1289,15 +1353,11 @@ def train(params: Dict[str, Any], x: np.ndarray, y: Optional[np.ndarray] = None,
                                categorical_features=cat_features)
             mapper = mapper.fit_csr(csr) if sparse_in else mapper.fit(x)
     has_cat = bool(mapper.categorical_features)
-    if sparse_in:
-        if has_cat or cat_features:
-            raise NotImplementedError(
-                "categorical features are not supported for sparse input "
-                "(hash them through the featurizer instead)")
-        if p["boosting"] == "dart":
-            raise NotImplementedError(
-                "boosting='dart' needs host-side tree replay over the full "
-                "matrix; use gbdt/goss/rf for sparse input")
+    if sparse_in and p["boosting"] == "dart" and mesh is not None:
+        raise NotImplementedError(
+            "boosting='dart' over sparse input under a mesh: the drop/re-add "
+            "replay runs over the shard-blocked layout's local row ids; "
+            "train dart single-replica or use gbdt/goss/rf distributed")
     reuse_dataset = dataset is not None and mapper is dataset.mapper
     # Bin on DEVICE when exact: features whose raw values are all
     # f32-representable bin identically via device_bin_cat's floored-f32
@@ -1634,6 +1694,22 @@ def train(params: Dict[str, Any], x: np.ndarray, y: Optional[np.ndarray] = None,
             node[go_right] = s + 1
         return tr.leaf_value[c][node]
 
+    def replay_tree(tr, c):
+        """(n,) leaf values of one stored tree — dart's drop/re-add replay.
+
+        Dense: numpy replay over the host binned matrix. Sparse: device
+        replay straight over the binned triple (``predict_binned`` gathers
+        each split's column from the SparseBinned — tree bins and the triple
+        share the compact bin space, so no host matrix ever materializes)."""
+        if not sparse_in:
+            return predict_tree_binned(tr, host_binned(), c)
+        from .grow import GrownTree, predict_binned as _pb
+
+        gt = GrownTree(tr.parent[c], tr.feature[c], tr.bin[c], tr.gain[c],
+                       tr.leaf_value[c], tr.leaf_hess[c], tr.cat_set[c])
+        node = np.asarray(_pb(gt, binned_d))
+        return tr.leaf_value[c][node]
+
     key = jax.random.PRNGKey(int(p["seed"]))
     bkey = jax.random.PRNGKey(int(p["bagging_seed"]))  # separate bagging stream
     num_iter = int(p["num_iterations"])
@@ -1657,7 +1733,8 @@ def train(params: Dict[str, Any], x: np.ndarray, y: Optional[np.ndarray] = None,
         # which sparse training deliberately never materializes
         raise NotImplementedError(
             "sparse eval_set needs the on-device eval path: drop callbacks/"
-            f"mesh and use a device-supported metric (got {metric_name!r})")
+            "mesh/boosting='dart' and use a device-supported metric "
+            f"(got {metric_name!r})")
     if use_device_eval and num_iter > 0:
         eval_dev = [(eb if sparse_in else jnp.asarray(eb.astype(bin_dtype)),
                      jnp.asarray(ey, jnp.float32),
@@ -1737,8 +1814,8 @@ def train(params: Dict[str, Any], x: np.ndarray, y: Optional[np.ndarray] = None,
                 raw_np = np.array(raw_d)
                 for t in dart_dropped:
                     for c in range(C):
-                        raw_np[:, c] -= lr * tree_scales[t] * predict_tree_binned(
-                            trees_host[t], host_binned(), c)
+                        raw_np[:, c] -= lr * tree_scales[t] * replay_tree(
+                            trees_host[t], c)
                 raw_d = _reput(raw_np, raw_d)
 
         trees, raw_d = step(binned_d, y_d, w_d, raw_d, k1, k2)
@@ -1760,13 +1837,13 @@ def train(params: Dict[str, Any], x: np.ndarray, y: Optional[np.ndarray] = None,
             # normalize: dropped trees keep ``factor`` of their weight
             raw_np = np.array(raw_d)
             for c in range(C):
-                raw_np[:, c] -= (1.0 - scale) * lr * predict_tree_binned(tree_np, host_binned(), c)
+                raw_np[:, c] -= (1.0 - scale) * lr * replay_tree(tree_np, c)
             for t in dart_dropped:
                 old = tree_scales[t]
                 tree_scales[t] = old * factor
                 for c in range(C):
-                    raw_np[:, c] += lr * old * factor * predict_tree_binned(
-                        trees_host[t], binned_np, c)
+                    raw_np[:, c] += lr * old * factor * replay_tree(
+                        trees_host[t], c)
                     # keep eval margins in sync with the rescaled trees
                     for eb, _ey, eraw in eval_binned:
                         eraw[:, c] += lr * old * (factor - 1.0) * predict_tree_binned(
@@ -1813,6 +1890,16 @@ def train(params: Dict[str, Any], x: np.ndarray, y: Optional[np.ndarray] = None,
     if has_cat:
         cat_stack = (np.stack([t.cat_set for t in trees_host]).astype(np.int8)
                      if T else np.zeros((0, C, L - 1, mapper.n_bins), np.int8))
+        if cat_stack.shape[-1] < mapper.n_bins:
+            # sparse trees grow in the COMPACT bin space; the booster predicts
+            # from full-space codes (category codes coincide in both spaces,
+            # only the missing bin is remapped) — pad the set rows and move
+            # the compact missing bin's membership to the full missing bin
+            Bc = cat_stack.shape[-1]
+            padded = np.zeros(cat_stack.shape[:-1] + (mapper.n_bins,), np.int8)
+            padded[..., : Bc - 1] = cat_stack[..., : Bc - 1]
+            padded[..., mapper.missing_bin] = cat_stack[..., Bc - 1]
+            cat_stack = padded
     threshold = np.zeros(parent.shape, dtype=np.float64)
     for t in range(T):
         for c in range(C):

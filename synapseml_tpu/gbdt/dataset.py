@@ -100,12 +100,9 @@ class GBDTDataset:
             # CSR dataset (reference sparse native datasets,
             # ``DatasetAggregator.scala:84,143-148``): bin once from CSR, the
             # SparseBinned device triple is cached like the dense buffer
-            if cats:
-                raise NotImplementedError(
-                    "categorical features are not supported for sparse input")
             self.x = as_csr(x)
             self.mapper = BinMapper(
-                max_bin=self.max_bin, seed=int(seed),
+                max_bin=self.max_bin, seed=int(seed), categorical_features=cats,
                 sample_cnt=int(bin_sample_count),
                 max_bin_by_feature=max_bin_by_feature,
             ).fit_csr(self.x)

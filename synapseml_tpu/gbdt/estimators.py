@@ -288,7 +288,28 @@ class _LightGBMModelBase(Model):
             out = out.with_column(self.leaf_prediction_col,
                                   self.booster.predict_leaf(x).astype(np.float64))
         if self.features_shap_col:
+            from .sparse import CSRMatrix
+
             contrib = self.booster.predict_contrib(x)
+            if isinstance(contrib, (CSRMatrix, list)):
+                # sparse input -> sparse contributions: store per-row
+                # (indices, values) pairs, the same convention sparse
+                # feature columns use (a dense (n, d+1) panel at hashed
+                # width is the thing predict_contrib avoided). Multiclass
+                # offsets class c's columns by c*(d+1), matching the dense
+                # class-major flatten below.
+                mats = contrib if isinstance(contrib, list) else [contrib]
+                col = np.empty(mats[0].shape[0], dtype=object)
+                for i in range(len(col)):
+                    idx_parts, val_parts = [], []
+                    for ci, m in enumerate(mats):
+                        a, b = int(m.indptr[i]), int(m.indptr[i + 1])
+                        idx_parts.append(m.indices[a:b].astype(np.int64)
+                                         + ci * m.shape[1])
+                        val_parts.append(m.values[a:b])
+                    col[i] = (np.concatenate(idx_parts),
+                              np.concatenate(val_parts))
+                return out.with_column(self.features_shap_col, col)
             if contrib.ndim == 3:  # multiclass: flatten class-major like the reference
                 contrib = np.concatenate(list(contrib), axis=1)
             out = out.with_column(self.features_shap_col, contrib)

@@ -104,11 +104,9 @@ def grow_tree(binned, grad, hess, row_weight, feature_mask, cfg: TreeConfig,
     from .sparse import SparseBinned
 
     if isinstance(binned, SparseBinned):
-        if cat_mask is not None:
-            raise NotImplementedError(
-                "categorical features are not supported for sparse input")
         return _grow_tree_sparse(binned, grad, hess, row_weight,
-                                 feature_mask, cfg, axis_name)
+                                 feature_mask, cfg, axis_name,
+                                 cat_mask=cat_mask)
 
     n, d = binned.shape
     L, B = cfg.num_leaves, cfg.n_bins
@@ -379,7 +377,8 @@ def grow_tree(binned, grad, hess, row_weight, feature_mask, cfg: TreeConfig,
 
 
 def _grow_tree_sparse(sb, grad, hess, row_weight, feature_mask,
-                      cfg: TreeConfig, axis_name: Optional[str]):
+                      cfg: TreeConfig, axis_name: Optional[str],
+                      cat_mask=None):
     """Summary-based leaf-wise growth over a :class:`SparseBinned` matrix.
 
     The dense grower keeps every leaf's full (d, B, 3) histogram resident so
@@ -387,11 +386,18 @@ def _grow_tree_sparse(sb, grad, hess, row_weight, feature_mask,
     (L * d * B * 3 floats at d = 2^18 is gigabytes). This variant keeps only
     per-leaf best-split SUMMARIES (gain, feature, bin) plus G/H totals, and
     rebuilds the two child histograms of the split leaf transiently each step
-    with one O(nnz) scatter (``sparse_histogram_split``) — the same economy
-    as LightGBM's bounded histogram pool + per-leaf ``SplitInfo`` cache
-    (``serial_tree_learner``'s ``best_split_per_leaf_``). Numeric splits
-    only; parallelism 'data' psums the transient child histograms, 'voting'
+    with one scatter-free pass (``sparse_histogram_split``) — the same
+    economy as LightGBM's bounded histogram pool + per-leaf ``SplitInfo``
+    cache (``serial_tree_learner``'s ``best_split_per_leaf_``).
+    Parallelism 'data' psums the transient child histograms, 'voting'
     (PV-tree) exchanges per-child votes + the elected candidates.
+
+    Categorical splits (``cat_mask``): the gain table sorts each categorical
+    feature's bins by grad/hess ratio exactly like the dense grower; because
+    this grower keeps no resident histograms, applying a categorical split
+    recomputes the ONE (B, 3) feature histogram of the split leaf (an
+    O(max_run) bounded gather + tiny scatter, psum'd under a mesh) to derive
+    the left-going category set.
     """
     import jax.numpy as jnp
     from jax import lax
@@ -402,6 +408,7 @@ def _grow_tree_sparse(sb, grad, hess, row_weight, feature_mask,
     d, B = sb.d, sb.n_bins
     L = cfg.num_leaves
     l1, l2 = cfg.lambda_l1, cfg.lambda_l2
+    has_cat = cat_mask is not None
     voting = cfg.parallelism == "voting" and axis_name is not None
     if voting:
         k_local = min(cfg.top_k, d)
@@ -412,15 +419,10 @@ def _grow_tree_sparse(sb, grad, hess, row_weight, feature_mask,
     def gain_term(G, H):
         return _thresh_l1(G, l1) ** 2 / (H + l2)
 
-    def numeric_gain(h, fmask_sel):
-        """(..., d_sel, B, 3) hists -> (..., d_sel, B) threshold-split gains."""
-        G, H, C = h[..., 0], h[..., 1], h[..., 2]
+    def _split_gain_parts(G, H, C, GL, HL, CL, fmask_sel, extra_valid):
         GT = G.sum(-1, keepdims=True)
         HT = H.sum(-1, keepdims=True)
         CT = C.sum(-1, keepdims=True)
-        GL = jnp.cumsum(G, -1)
-        HL = jnp.cumsum(H, -1)
-        CL = jnp.cumsum(C, -1)
         GR, HR, CR = GT - GL, HT - HL, CT - CL
         g = gain_term(GL, HL) + gain_term(GR, HR) - gain_term(GT, HT)
         valid = (
@@ -429,9 +431,33 @@ def _grow_tree_sparse(sb, grad, hess, row_weight, feature_mask,
             & (CR >= cfg.min_data_in_leaf)
             & (HL >= cfg.min_sum_hessian)
             & (HR >= cfg.min_sum_hessian)
+            & extra_valid
             & (fmask_sel[..., None] > 0)
         )
         return jnp.where(valid, g, -jnp.inf)
+
+    def numeric_gain(h, fmask_sel, cmask_sel=None):
+        """(..., d_sel, B, 3) hists -> (..., d_sel, B) split gains.
+
+        Numeric entry b = 'bin <= b' threshold; categorical entry b =
+        best sorted-prefix of length b+1 (dense ``gain_table`` semantics)."""
+        G, H, C = h[..., 0], h[..., 1], h[..., 2]
+        g_num = _split_gain_parts(G, H, C, jnp.cumsum(G, -1),
+                                  jnp.cumsum(H, -1), jnp.cumsum(C, -1),
+                                  fmask_sel, True)
+        if not has_cat:
+            return g_num
+        ratio = G / (H + cfg.cat_smooth)
+        order = jnp.argsort(-ratio, axis=-1)
+        Gs = jnp.take_along_axis(G, order, -1)
+        Hs = jnp.take_along_axis(H, order, -1)
+        Cs = jnp.take_along_axis(C, order, -1)
+        g_cat = _split_gain_parts(G, H, C, jnp.cumsum(Gs, -1),
+                                  jnp.cumsum(Hs, -1), jnp.cumsum(Cs, -1),
+                                  fmask_sel,
+                                  pos + 1 <= cfg.max_cat_threshold)
+        cm = cat_mask if cmask_sel is None else cmask_sel
+        return jnp.where(cm[..., None] > 0, g_cat, g_num)
 
     def best_of_children(h2):
         """(2, d, B, 3) child hists -> per-child (gain, feat, bin).
@@ -454,7 +480,8 @@ def _grow_tree_sparse(sb, grad, hess, row_weight, feature_mask,
         cand = jnp.take_along_axis(h2, sel[:, :, None, None], axis=1)
         cand = lax.psum(cand, axis_name)                   # (2, 2k, B, 3)
         fmask_sel = jnp.take(feature_mask, sel)            # (2, 2k)
-        gain = numeric_gain(cand, fmask_sel)               # (2, 2k, B)
+        cmask_sel = jnp.take(cat_mask, sel) if has_cat else None
+        gain = numeric_gain(cand, fmask_sel, cmask_sel)    # (2, 2k, B)
         flat = gain.reshape(2, k_global * B)
         idx = jnp.argmax(flat, axis=-1)
         bg = jnp.take_along_axis(flat, idx[:, None], axis=-1)[:, 0]
@@ -473,9 +500,33 @@ def _grow_tree_sparse(sb, grad, hess, row_weight, feature_mask,
         bg, bf, bb = best_of_children(h2)
         return bg, bf, bb, totals
 
+    nnz_pad = sb.rows.shape[0]
+
+    def leaf_feature_hist(f, member):
+        """(B, 3) [G, H, count] histogram of ONE feature over one leaf's
+        rows — O(max_run) bounded gather plus a B-cell scatter; the
+        implicit-zero residual lands in the feature's zero bin. Used only to
+        derive a categorical split's left set at apply time (this grower
+        keeps no resident histograms to reorder)."""
+        ghc = jnp.stack([grad * row_weight, hess * row_weight, row_weight],
+                        axis=-1) * member.astype(jnp.float32)[:, None]
+        ghc_pad = jnp.concatenate([ghc, jnp.zeros((1, 3), jnp.float32)],
+                                  axis=0)
+        start = jnp.take(sb.starts, f).astype(jnp.int32)
+        cnt = jnp.take(sb.starts, f + 1).astype(jnp.int32) - start
+        j = jnp.arange(sb.max_run, dtype=jnp.int32)
+        valid = j < cnt
+        pidx = jnp.clip(start + j, 0, max(nnz_pad - 1, 0))
+        rows_f = jnp.where(valid, jnp.take(sb.rows, pidx), n)
+        bins_f = jnp.where(valid, jnp.take(sb.bins, pidx), 0)
+        panel = jnp.take(ghc_pad, rows_f, axis=0)   # pad/non-member rows -> 0
+        hist = jnp.zeros((B, 3), jnp.float32).at[bins_f].add(panel)
+        tot = ghc.sum(0)
+        return hist.at[jnp.take(sb.zero_bin, f)].add(tot - hist.sum(0))
+
     def step(s, state):
         (node, best_gain, best_feat, best_bin, G_leaf, H_leaf,
-         parent, feat, bin_, gains, depth) = state
+         parent, feat, bin_, gains, cat_sets, depth) = state
         leaf_gain = best_gain
         if cfg.max_depth > 0:
             leaf_gain = jnp.where(depth < cfg.max_depth, leaf_gain, -jnp.inf)
@@ -485,8 +536,22 @@ def _grow_tree_sparse(sb, grad, hess, row_weight, feature_mask,
         f_sel = best_feat[l]
         b_sel = best_bin[l]
         col = sparse_column(sb, f_sel, n)
-        go_left = col <= b_sel
         member = node == l
+        if has_cat:
+            is_cat = jnp.take(cat_mask, f_sel) > 0
+            row = leaf_feature_hist(f_sel, member)
+            if axis_name is not None:
+                row = lax.psum(row, axis_name)
+            ratio = row[:, 0] / (row[:, 1] + cfg.cat_smooth)
+            rank = jnp.argsort(jnp.argsort(-ratio))
+            # zero-mass bins stay OUT of the left set (dense split_detail:
+            # unseen categories route right, matching LightGBM bitsets)
+            in_set = (rank <= b_sel) & (row[:, 2] > 0)
+            go_left = jnp.where(is_cat, jnp.take(in_set, col), col <= b_sel)
+        else:
+            is_cat = jnp.zeros((), jnp.bool_)
+            in_set = jnp.zeros((B,), jnp.bool_)
+            go_left = col <= b_sel
         went_right = member & ~go_left & ok
         node = jnp.where(went_right, s + 1, node)
         side = jnp.where(member & ok,
@@ -503,13 +568,16 @@ def _grow_tree_sparse(sb, grad, hess, row_weight, feature_mask,
         H_leaf = jnp.where(ok, upd(H_leaf, totals[0, 1], totals[1, 1]), H_leaf)
         parent = parent.at[s].set(jnp.where(ok, l, -1).astype(jnp.int32))
         feat = feat.at[s].set(f_sel.astype(jnp.int32))
-        bin_ = bin_.at[s].set(b_sel.astype(jnp.int32))
+        bin_ = bin_.at[s].set(
+            jnp.where(is_cat, -1, b_sel).astype(jnp.int32))
         gains = gains.at[s].set(jnp.where(ok, g_best, 0.0).astype(jnp.float32))
+        cat_sets = cat_sets.at[s].set(
+            (in_set & is_cat & ok).astype(jnp.int8))
         child_depth = jnp.where(ok, depth[l] + 1, depth[l]).astype(jnp.int32)
         depth = jnp.where(ok, depth.at[s + 1].set(child_depth)
                           .at[l].set(child_depth), depth)
         return (node, best_gain, best_feat, best_bin, G_leaf, H_leaf,
-                parent, feat, bin_, gains, depth)
+                parent, feat, bin_, gains, cat_sets, depth)
 
     # root: everything on side 0
     root_side = jnp.zeros(n, jnp.int32)
@@ -526,17 +594,17 @@ def _grow_tree_sparse(sb, grad, hess, row_weight, feature_mask,
         jnp.zeros(L - 1, dtype=jnp.int32),
         jnp.zeros(L - 1, dtype=jnp.int32),
         jnp.zeros(L - 1, dtype=jnp.float32),
+        jnp.zeros((L - 1, B), dtype=jnp.int8),
         jnp.zeros(L, dtype=jnp.int32),
     )
-    (node, _bg, _bf, _bb, G_leaf, H_leaf,
-     parent, feat, bin_, gains, _depth) = lax.fori_loop(0, L - 1, step, state0)
+    (node, _bg, _bf, _bb, G_leaf, H_leaf, parent, feat, bin_, gains,
+     cat_sets, _depth) = lax.fori_loop(0, L - 1, step, state0)
 
     leaf_value = -_thresh_l1(G_leaf, l1) / (H_leaf + l2)
     leaf_value = jnp.where(H_leaf > 0, leaf_value, 0.0)
     if cfg.max_delta_step > 0:
         leaf_value = jnp.clip(leaf_value, -cfg.max_delta_step,
                               cfg.max_delta_step)
-    cat_sets = jnp.zeros((L - 1, B), dtype=jnp.int8)
     return (GrownTree(parent, feat, bin_, gains, leaf_value, H_leaf,
                       cat_sets), node)
 
@@ -545,7 +613,7 @@ def predict_binned(tree: GrownTree, binned):
     """Replay splits over a binned matrix -> leaf index per row (device or host).
 
     ``binned``: (n, d) int matrix or a :class:`SparseBinned` (column gathers
-    go through the sparse scatter path)."""
+    go through the bounded per-feature gather path)."""
     import jax.numpy as jnp
 
     from .sparse import SparseBinned, sparse_column

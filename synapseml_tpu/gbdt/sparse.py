@@ -473,6 +473,13 @@ def shard_sparse_binned(csr: CSRMatrix, mapper, n_shards: int,
     Returns ``(SparseBinned, local_rows)``.
     """
     n, d = csr.shape
+    if row_pad > n:
+        # wrapped padding replicates the FIRST row_pad rows; fewer rows than
+        # shards would index past indptr below with a raw IndexError
+        raise ValueError(
+            f"sparse training set has {n} rows for {n_shards} shards "
+            f"(needs {row_pad} wrapped padding rows); use fewer shards or "
+            "more rows")
     total = n + row_pad
     if total % n_shards:
         raise ValueError(f"padded rows {total} not divisible by {n_shards}")

@@ -166,9 +166,16 @@ class RoutingServer:
                 # next one — a worker death mid-stream must not surface to
                 # clients (the reference's serving tier survives exactly
                 # this, ``HTTPv2Suite.scala:328``). A TIMEOUT merely fails
-                # over without eviction: a cold-compiling or briefly slow
-                # worker is alive, and one slow burst must not permanently
-                # drain the routing table.
+                # over without eviction — but ONLY for idempotent methods:
+                # a timed-out worker may still complete the original
+                # request, so re-sending a POST would execute its side
+                # effects twice. Non-idempotent requests surface 504 after
+                # one timeout instead of at-least-once semantics (and the
+                # client never waits more than one timeout). Connection
+                # REFUSED is always safe to retry: the request was never
+                # received.
+                idempotent = method in ("GET", "HEAD")
+                timed_out = False
                 reply = None  # (status, content_type, entity)
                 for k in range(len(targets)):
                     target = targets[(start + k) % len(targets)]
@@ -189,10 +196,16 @@ class RoutingServer:
                         reply = (e.code, None, e.read())
                         break
                     except (TimeoutError, _socket.timeout):
+                        if not idempotent:
+                            timed_out = True
+                            break
                         continue  # alive but slow: fail over, keep it
                     except urllib.error.URLError as e:
                         if isinstance(e.reason, (TimeoutError,
                                                  _socket.timeout)):
+                            if not idempotent:
+                                timed_out = True
+                                break
                             continue
                         outer.registry.unregister(outer.service, target)
                         outer.workers_evicted += 1
@@ -209,7 +222,11 @@ class RoutingServer:
                 # hung up must not evict a healthy worker or re-send the
                 # request (duplicate side effects)
                 try:
-                    if reply is None:
+                    if reply is None and timed_out:
+                        self.send_error(
+                            504, "worker timed out; not retried "
+                                 "(non-idempotent method)")
+                    elif reply is None:
                         self.send_error(502, "no reachable workers")
                     else:
                         status, ct, ent = reply

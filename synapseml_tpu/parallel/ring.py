@@ -179,15 +179,25 @@ def ulysses_attention(q, k, v, axis_name: str, causal: bool = False,
         kh = jnp.repeat(kh, rep, axis=2)
         vh = jnp.repeat(vh, rep, axis=2)
     if local == "flash":
-        from .flash import flash_attention
-
         S = qh.shape[1]
-        out = flash_attention(qh, kh, vh, causal=causal,
-                              block_q=block_q or _auto_block(S),
-                              block_k=block_k or _auto_block(S),
-                              interpret=interpret)
-        out = to_seq(out.astype(q.dtype))
-        return out[:, :, :h] if pad_h else out
+        any_auto = block_q is None or block_k is None
+        bq = block_q or _auto_block(S)
+        bk = block_k or _auto_block(S)
+        if any_auto and min(bq, bk) < 128:
+            # an odd / small-power-of-2-factor gathered length auto-blocks
+            # below the (8, 128) Mosaic tile minimum — the kernel would be
+            # rejected or crawl at sub-tile grids; dense local attention is
+            # both correct and faster at these sizes. Explicit blocks are
+            # honored (interpret-mode tests and expert tuning).
+            pass  # falls through to the dense path below
+        else:
+            from .flash import flash_attention
+
+            out = flash_attention(qh, kh, vh, causal=causal,
+                                  block_q=bq, block_k=bk,
+                                  interpret=interpret)
+            out = to_seq(out.astype(q.dtype))
+            return out[:, :, :h] if pad_h else out
     scale = 1.0 / math.sqrt(d)
     s = jnp.einsum("bqhd,bkhd->bqhk", qh.astype(jnp.float32),
                    kh.astype(jnp.float32)) * scale

@@ -307,3 +307,74 @@ def test_serving_json_pipeline_with_model():
         assert body["probability"] > 0.5
     finally:
         engine.stop()
+
+
+def test_routing_timeout_failover_is_idempotency_aware():
+    """A timed-out worker may still complete its request, so the router must
+    NOT re-send non-idempotent methods (duplicate side effects) — POST gets
+    504 after one timeout; GET fails over to the next worker (ADVICE r4)."""
+    import http.server
+    import threading
+    import time
+
+    from synapseml_tpu.io.serving_v2 import RoutingServer, ServiceRegistry
+
+    hits = {("slow", "GET"): 0, ("slow", "POST"): 0,
+            ("fast", "GET"): 0, ("fast", "POST"): 0}
+
+    def make(name, delay):
+        class H(http.server.BaseHTTPRequestHandler):
+            def _serve(self):
+                hits[(name, self.command)] += 1
+                time.sleep(delay)
+                body = name.encode()
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            do_GET = _serve
+            do_POST = _serve
+
+            def log_message(self, *a):
+                pass
+
+        srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), H)
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        return srv
+
+    slow = make("slow", 2.0)   # > router timeout: always times out
+    fast = make("fast", 0.0)
+    reg = ServiceRegistry()
+    reg.register("svc", f"http://127.0.0.1:{slow.server_address[1]}")
+    reg.register("svc", f"http://127.0.0.1:{fast.server_address[1]}")
+    router = RoutingServer(reg, "svc", timeout=0.5)
+    try:
+        # drive enough requests that round-robin starts some on the slow
+        # worker; GETs must ALL succeed (timeout failover for idempotent)
+        for _ in range(4):
+            with urllib.request.urlopen(router.address + "/", timeout=15) as r:
+                assert r.read() == b"fast"
+        # POSTs landing on the slow worker must return 504, not re-execute
+        codes = []
+        for _ in range(4):
+            try:
+                req = urllib.request.Request(router.address + "/",
+                                             data=b"x", method="POST")
+                with urllib.request.urlopen(req, timeout=15) as r:
+                    codes.append(r.status)
+            except urllib.error.HTTPError as e:
+                codes.append(e.code)
+        assert 504 in codes and 200 in codes, codes
+        # exactly-once execution: every 504'd POST ran ONLY on the slow
+        # worker (never re-sent to fast), every 200 POST ran only on fast
+        assert hits[("slow", "POST")] == codes.count(504)
+        assert hits[("fast", "POST")] == codes.count(200)
+        # GET timeout failover DID re-send: fast served all 4 GETs
+        assert hits[("fast", "GET")] == 4
+        # neither worker was evicted: timeouts never drain the table
+        assert len(reg.lookup("svc")) == 2
+    finally:
+        router.close()
+        slow.shutdown()
+        fast.shutdown()
