@@ -150,26 +150,74 @@ def _obj_multiclass(num_class):
     return init, grads
 
 
-def make_lambdarank(group_sizes: np.ndarray, truncation: int = 30, sigma: float = 1.0):
-    """LambdaRank grad fn over contiguous query groups (reference objective
-    ``lambdarank``, ``LightGBMRankerParams``). Rows MUST be ordered by group.
-
-    Returns (init_fn, grad_fn) where grad_fn pads groups to the max group size and
-    computes the full pairwise lambda matrix per group on device — dense fixed-shape
-    (Q, G, G) work, the TPU-friendly formulation of the reference's per-query C++
-    loops.
-    """
-    sizes = np.asarray(group_sizes, dtype=np.int64)
-    n = int(sizes.sum())
+def _group_tables(sizes: np.ndarray, G: int, base: int = 0):
+    """(Q, G) row-index + validity tables for contiguous query groups whose
+    rows start at ``base``."""
     Q = len(sizes)
-    G = int(sizes.max())
     pad_idx = np.zeros((Q, G), dtype=np.int32)
     valid_np = np.zeros((Q, G), dtype=bool)
-    start = 0
+    start = base
     for q, sz in enumerate(sizes):
         pad_idx[q, :sz] = np.arange(start, start + sz)
         valid_np[q, :sz] = True
         start += sz
+    return pad_idx, valid_np
+
+
+def _lambda_grads(score, y, w, idx, valid, n: int, G: int,
+                  truncation: int, sigma: float):
+    """Pairwise LambdaRank grad/hess over (Q, G) group tables — dense
+    fixed-shape (Q, G, G) device work, the TPU-friendly formulation of the
+    reference's per-query C++ loops."""
+    import jax.numpy as jnp
+
+    s = jnp.where(valid, score[idx], -jnp.inf)  # (Q, G)
+    lab = jnp.where(valid, y[idx], 0.0)
+    # rank within group by current score, descending
+    order = jnp.argsort(-s, axis=1)
+    rank = jnp.argsort(order, axis=1)  # 0-based rank per doc
+    gain = jnp.exp2(lab) - 1.0
+    disc = jnp.where(valid, 1.0 / jnp.log2(2.0 + rank), 0.0)
+    # ideal DCG at truncation from sorted labels
+    ideal_gain = -jnp.sort(-jnp.where(valid, gain, 0.0), axis=1)
+    ideal_rank = jnp.arange(G)
+    trunc_mask = ideal_rank < truncation
+    max_dcg = (ideal_gain * (1.0 / jnp.log2(2.0 + ideal_rank)) * trunc_mask).sum(1)
+    max_dcg = jnp.maximum(max_dcg, 1e-12)[:, None, None]
+    sdiff = s[:, :, None] - s[:, None, :]
+    rho = 1.0 / (1.0 + jnp.exp(sigma * sdiff))  # sigmoid(-sigma * (s_i - s_j))
+    delta = (
+        jnp.abs(gain[:, :, None] - gain[:, None, :])
+        * jnp.abs(disc[:, :, None] - disc[:, None, :])
+        / max_dcg
+    )
+    in_trunc = (rank[:, :, None] < truncation) | (rank[:, None, :] < truncation)
+    pair = (
+        (lab[:, :, None] > lab[:, None, :])
+        & valid[:, :, None] & valid[:, None, :] & in_trunc
+    )
+    lam = jnp.where(pair, sigma * rho * delta, 0.0)
+    hpair = jnp.where(pair, sigma * sigma * rho * (1.0 - rho) * delta, 0.0)
+    # winner i of pair (i, j): push score up (negative grad); loser j: down
+    g_mat = -lam.sum(2) + lam.sum(1)
+    h_mat = hpair.sum(2) + hpair.sum(1)
+    g_flat = jnp.zeros(n, dtype=jnp.float32).at[idx.reshape(-1)].add(
+        jnp.where(valid, g_mat, 0.0).reshape(-1))
+    h_flat = jnp.zeros(n, dtype=jnp.float32).at[idx.reshape(-1)].add(
+        jnp.where(valid, h_mat, 0.0).reshape(-1))
+    return g_flat * w, jnp.maximum(h_flat, 1e-12) * w
+
+
+def make_lambdarank(group_sizes: np.ndarray, truncation: int = 30, sigma: float = 1.0):
+    """LambdaRank grad fn over contiguous query groups (reference objective
+    ``lambdarank``, ``LightGBMRankerParams``). Rows MUST be ordered by group.
+
+    Returns (init_fn, grad_fn); see :func:`_lambda_grads` for the math.
+    """
+    sizes = np.asarray(group_sizes, dtype=np.int64)
+    n = int(sizes.sum())
+    G = int(sizes.max())
+    pad_idx, valid_np = _group_tables(sizes, G)
 
     def init(y, w):
         return 0.0
@@ -177,45 +225,77 @@ def make_lambdarank(group_sizes: np.ndarray, truncation: int = 30, sigma: float 
     def grads(score, y, w):
         import jax.numpy as jnp
 
-        idx = jnp.asarray(pad_idx)
-        valid = jnp.asarray(valid_np)
-        s = jnp.where(valid, score[idx], -jnp.inf)  # (Q, G)
-        lab = jnp.where(valid, y[idx], 0.0)
-        # rank within group by current score, descending
-        order = jnp.argsort(-s, axis=1)
-        rank = jnp.argsort(order, axis=1)  # 0-based rank per doc
-        gain = jnp.exp2(lab) - 1.0
-        disc = jnp.where(valid, 1.0 / jnp.log2(2.0 + rank), 0.0)
-        # ideal DCG at truncation from sorted labels
-        ideal_gain = -jnp.sort(-jnp.where(valid, gain, 0.0), axis=1)
-        ideal_rank = jnp.arange(G)
-        trunc_mask = ideal_rank < truncation
-        max_dcg = (ideal_gain * (1.0 / jnp.log2(2.0 + ideal_rank)) * trunc_mask).sum(1)
-        max_dcg = jnp.maximum(max_dcg, 1e-12)[:, None, None]
-        sdiff = s[:, :, None] - s[:, None, :]
-        rho = 1.0 / (1.0 + jnp.exp(sigma * sdiff))  # sigmoid(-sigma * (s_i - s_j))
-        delta = (
-            jnp.abs(gain[:, :, None] - gain[:, None, :])
-            * jnp.abs(disc[:, :, None] - disc[:, None, :])
-            / max_dcg
-        )
-        in_trunc = (rank[:, :, None] < truncation) | (rank[:, None, :] < truncation)
-        pair = (
-            (lab[:, :, None] > lab[:, None, :])
-            & valid[:, :, None] & valid[:, None, :] & in_trunc
-        )
-        lam = jnp.where(pair, sigma * rho * delta, 0.0)
-        hpair = jnp.where(pair, sigma * sigma * rho * (1.0 - rho) * delta, 0.0)
-        # winner i of pair (i, j): push score up (negative grad); loser j: down
-        g_mat = -lam.sum(2) + lam.sum(1)
-        h_mat = hpair.sum(2) + hpair.sum(1)
-        g_flat = jnp.zeros(n, dtype=jnp.float32).at[idx.reshape(-1)].add(
-            jnp.where(valid, g_mat, 0.0).reshape(-1))
-        h_flat = jnp.zeros(n, dtype=jnp.float32).at[idx.reshape(-1)].add(
-            jnp.where(valid, h_mat, 0.0).reshape(-1))
-        return g_flat * w, jnp.maximum(h_flat, 1e-12) * w
+        return _lambda_grads(score, y, w, jnp.asarray(pad_idx),
+                             jnp.asarray(valid_np), n, G, truncation, sigma)
 
     return init, grads
+
+
+def make_lambdarank_mesh(group_sizes: np.ndarray, n_shards: int, axis: str,
+                         truncation: int = 30, sigma: float = 1.0):
+    """Distributed LambdaRank via GROUP-ALIGNED sharding.
+
+    The reference trains the ranker distributed by repartitioning on the
+    group column so every query's rows land whole in one partition
+    (``LightGBMRanker.scala:82-109``). TPU formulation: queries are assigned
+    to shards by a deterministic greedy row-count balance, each shard's row
+    block is padded to the widest shard with zero-weight rows, and the
+    grad fn selects its shard's (Q, G) group tables by ``axis_index`` inside
+    ``shard_map`` — per-query lambda computation stays entirely local; only
+    the histogram psum crosses shards, exactly like every other objective.
+
+    Returns ``(init_fn, grad_fn, order, w_mask, local)``:
+    ``order`` (n_shards * local,) original-row id per padded-global slot
+    (padding repeats row 0), ``w_mask`` zeroes the padding rows, ``local``
+    the per-shard row count. Callers permute the uploaded arrays by
+    ``order`` and multiply weights by ``w_mask``.
+    """
+    sizes = np.asarray(group_sizes, dtype=np.int64)
+    n = int(sizes.sum())
+    Q = len(sizes)
+    G = int(sizes.max())
+    starts = np.zeros(Q + 1, dtype=np.int64)
+    np.cumsum(sizes, out=starts[1:])
+    # deterministic contiguous assignment: a query goes to the shard its row
+    # MIDPOINT falls in under an even n/n_shards split — monotone in q, so
+    # chunks stay contiguous, and row counts balance to within one query
+    target = n / n_shards
+    mids = starts[:-1] + sizes / 2.0
+    shard_of = np.minimum((mids / target).astype(np.int64), n_shards - 1)
+    per_shard = [np.nonzero(shard_of == s)[0] for s in range(n_shards)]
+    rows_per_shard = [int(sizes[qs].sum()) for qs in per_shard]
+    local = max(max(rows_per_shard), 1)
+    q_max = max(max(len(qs) for qs in per_shard), 1)
+
+    order = np.zeros(n_shards * local, dtype=np.int64)
+    w_mask = np.zeros(n_shards * local, dtype=np.float64)
+    pad_idx = np.zeros((n_shards, q_max, G), dtype=np.int32)
+    valid_np = np.zeros((n_shards, q_max, G), dtype=bool)
+    for s, qs in enumerate(per_shard):
+        pos = 0
+        for qi, q in enumerate(qs):
+            sz = int(sizes[q])
+            order[s * local + pos: s * local + pos + sz] = \
+                np.arange(starts[q], starts[q + 1])
+            w_mask[s * local + pos: s * local + pos + sz] = 1.0
+            pad_idx[s, qi, :sz] = np.arange(pos, pos + sz)  # LOCAL row ids
+            valid_np[s, qi, :sz] = True
+            pos += sz
+
+    def init(y, w):
+        return 0.0
+
+    def grads(score, y, w):
+        import jax
+        import jax.numpy as jnp
+
+        sidx = jax.lax.axis_index(axis)
+        idx = jnp.take(jnp.asarray(pad_idx), sidx, axis=0)      # (Qmax, G)
+        valid = jnp.take(jnp.asarray(valid_np), sidx, axis=0)
+        return _lambda_grads(score, y, w, idx, valid, local, G,
+                             truncation, sigma)
+
+    return init, grads, order, w_mask, local
 
 
 def _metric_ndcg(k: int = 10):
@@ -766,16 +846,6 @@ class GBDTBooster:
                 out[c, :, d] += sc * expected_value(root)
         return out
 
-    def _predict_contrib_shap(self, x: np.ndarray,
-                              num_iteration: Optional[int] = None) -> np.ndarray:
-        """Exact TreeSHAP over a dense matrix (kept for callers)."""
-        x = np.asarray(x, dtype=np.float64)
-        n, d = x.shape
-        out = self._contrib_shap_panel(self._binned(x), self.feature, n, d,
-                                       num_iteration)
-        out[:, :, d] += self.base_score[:, None]
-        return out[0] if self.num_class == 1 else out
-
     def feature_importance(self, importance_type: str = "split",
                            num_iteration: Optional[int] = None) -> np.ndarray:
         """'split' counts or 'gain' sums per feature — reference
@@ -1055,6 +1125,13 @@ def _build_step(grad_fn=None, fobj=None, *, cfg, C, lr, boosting, d, cat_idx,
         fmask = jnp.where(fmask.sum() == 0, jnp.ones((d,), jnp.float32), fmask)
 
         bw = make_weights(key, jnp.abs(g).sum(axis=1), yv, g.shape[0])
+        # zero-weight rows are no-ops (the padding convention every mesh
+        # layout relies on: wrapped/duplicated pad rows carry w=0). Without
+        # this they still count 1 in the histogram COUNT channel — g/h are
+        # already zero via w — inflating min_data_in_leaf gating and
+        # breaking mesh-vs-single-replica tree equality whenever n doesn't
+        # divide the shard count (or under the lambdarank group layout).
+        bw = jnp.where(wv == 0, 0.0, bw)
 
         cmask = (jnp.asarray(cat_mask_np) if cat_mask_np is not None else None)
 
@@ -1263,11 +1340,8 @@ def train(params: Dict[str, Any], x: np.ndarray, y: Optional[np.ndarray] = None,
                     if (mesh is None or dataset.is_device) else None)
     if dev_data:
         # device-resident dataset: the raw matrix never crosses to the host
-        # (under a mesh the cached binned buffer reshards device-side)
-        if init_booster is not None:
-            raise NotImplementedError(
-                "continued training from a device-resident GBDTDataset needs "
-                "raw-margin replay; pass numpy features for continuation")
+        # (under a mesh the cached binned buffer reshards device-side);
+        # continuation replays the init booster's margins on device (below)
         if mapper is not None and mapper is not dataset.mapper:
             raise ValueError("a device-resident GBDTDataset owns its binning; "
                              "an overriding mapper would need the raw matrix "
@@ -1286,6 +1360,7 @@ def train(params: Dict[str, Any], x: np.ndarray, y: Optional[np.ndarray] = None,
     w_dev_in = weight if isinstance(weight, jnp.ndarray) else None
     w_np = np.ones(n) if weight is None else np.asarray(weight, dtype=np.float64)
 
+    lr_layout = None  # (order, w_mask) group-aligned mesh layout, lambdarank only
     if obj_name == "lambdarank":
         if group is None:
             raise ValueError("objective='lambdarank' requires group (query sizes, "
@@ -1293,12 +1368,22 @@ def train(params: Dict[str, Any], x: np.ndarray, y: Optional[np.ndarray] = None,
         if int(np.sum(group)) != n:
             raise ValueError(f"group sizes sum to {int(np.sum(group))}, expected {n}")
         if mesh is not None:
-            raise NotImplementedError(
-                "distributed lambdarank needs group-aligned sharding; train "
-                "single-replica or shard by query upstream")
-        init_fn, grad_fn = make_lambdarank(
-            group, truncation=int(p["lambdarank_truncation_level"]),
-            sigma=float(p["sigmoid"]))
+            # group-aligned sharding (reference repartition-by-group,
+            # ``LightGBMRanker.scala:82-109``): whole queries per shard,
+            # lambdas local, histograms psum'd like every other objective
+            if sparse_in or dev_data:
+                raise NotImplementedError(
+                    "distributed lambdarank reorders rows on upload and needs "
+                    "dense host features; pass a numpy matrix")
+            init_fn, grad_fn, lr_order, lr_wmask, _ = make_lambdarank_mesh(
+                group, int(mesh.shape[axis]), axis,
+                truncation=int(p["lambdarank_truncation_level"]),
+                sigma=float(p["sigmoid"]))
+            lr_layout = (lr_order, lr_wmask)
+        else:
+            init_fn, grad_fn = make_lambdarank(
+                group, truncation=int(p["lambdarank_truncation_level"]),
+                sigma=float(p["sigmoid"]))
     else:
         init_fn, grad_fn = _resolve_objective(p)
     # Resolve names -> indices BEFORE sorting: the list may mix indices and
@@ -1380,10 +1465,20 @@ def train(params: Dict[str, Any], x: np.ndarray, y: Optional[np.ndarray] = None,
     else:
         binned_np = None if use_device_bin else mapper.transform(x)
 
+    raw0_dev = None  # device-resident init margins (device-dataset continuation)
     if init_booster is not None:
         base = init_booster.base_score.copy()
-        raw0 = init_booster.raw_predict(csr if sparse_in else x)
-        raw0 = raw0.reshape(n, C)
+        if dev_data:
+            # continued training from a device-resident dataset: raw-margin
+            # replay entirely ON DEVICE — the init booster's device binning
+            # + jitted tree scan score the dataset's cached float matrix, so
+            # the raw features still never cross to the host (reference
+            # feeds batch N's model into N+1, ``LightGBMBase.scala:46-61``)
+            raw0_dev = init_booster.raw_predict_device(dataset.x)
+            raw0 = None
+        else:
+            raw0 = init_booster.raw_predict(csr if sparse_in else x)
+            raw0 = raw0.reshape(n, C)
     else:
         base = np.atleast_1d(np.asarray(init_fn(y, w_np), dtype=np.float64))
         if not p["boost_from_average"]:
@@ -1535,8 +1630,10 @@ def train(params: Dict[str, Any], x: np.ndarray, y: Optional[np.ndarray] = None,
                 else (w_dev_in.astype(jnp.float32) if w_dev_in is not None
                       else jnp.asarray(w_np, jnp.float32)),
                 fill_first=False), data_spec)
-            raw_d = dev_put(dpad(jnp.zeros((n, C), jnp.float32)
-                                 + jnp.asarray(base, jnp.float32)), data_spec)
+            raw_d = dev_put(dpad(
+                raw0_dev.astype(jnp.float32) if raw0_dev is not None
+                else jnp.zeros((n, C), jnp.float32)
+                + jnp.asarray(base, jnp.float32)), data_spec)
         elif sparse_in:
             # equal row blocks, per-block entries packed and padded
             # (sparse.py layout, hoisted to sb_host above); padding rows wrap
@@ -1559,7 +1656,16 @@ def train(params: Dict[str, Any], x: np.ndarray, y: Optional[np.ndarray] = None,
             w_d = dev_put(w_np.astype(np.float32), data_spec)
             raw_d = dev_put(raw0.astype(np.float32), data_spec)
         else:
-            if pad:
+            if lr_layout is not None:
+                # lambdarank group-aligned layout: shard s's block holds its
+                # whole queries (+ zero-weight padding); the grad fn's group
+                # tables are in these LOCAL coordinates
+                lr_order, lr_wmask = lr_layout
+                binned_np = binned_np[lr_order]
+                y = y[lr_order]
+                w_np = w_np[lr_order] * lr_wmask
+                raw0 = raw0[lr_order]
+            elif pad:
                 binned_np = np.concatenate([binned_np, binned_np[:pad]], axis=0)
                 y = np.concatenate([y, y[:pad]])
                 w_np = np.concatenate([w_np, np.zeros(pad)])  # zero wt: no-op
@@ -1595,9 +1701,13 @@ def train(params: Dict[str, Any], x: np.ndarray, y: Optional[np.ndarray] = None,
         w_d = (jnp.ones(n, jnp.float32) if weight is None
                else w_dev_in.astype(jnp.float32) if w_dev_in is not None
                else jnp.asarray(w_np, dtype=jnp.float32))
-        raw_d = (jnp.zeros((n, C), jnp.float32) + jnp.asarray(base, jnp.float32)
-                 if init_booster is None
-                 else jnp.asarray(raw0, dtype=jnp.float32))
+        if init_booster is None:
+            raw_d = (jnp.zeros((n, C), jnp.float32)
+                     + jnp.asarray(base, jnp.float32))
+        elif raw0_dev is not None:
+            raw_d = raw0_dev.astype(jnp.float32)
+        else:
+            raw_d = jnp.asarray(raw0, dtype=jnp.float32)
 
     # -- eval / early stopping state ----------------------------------------------
     if obj_name == "lambdarank":

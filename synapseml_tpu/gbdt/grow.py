@@ -539,7 +539,13 @@ def _grow_tree_sparse(sb, grad, hess, row_weight, feature_mask,
         member = node == l
         if has_cat:
             is_cat = jnp.take(cat_mask, f_sel) > 0
-            row = leaf_feature_hist(f_sel, member)
+            # the O(max_run) gather only pays on categorical splits (every
+            # shard picks the same f_sel from the reduced decision, so the
+            # branch is uniform); the psum stays OUTSIDE the cond so the
+            # collective schedule is shard-independent
+            row = lax.cond(
+                is_cat, lambda: leaf_feature_hist(f_sel, member),
+                lambda: jnp.zeros((B, 3), jnp.float32))
             if axis_name is not None:
                 row = lax.psum(row, axis_name)
             ratio = row[:, 0] / (row[:, 1] + cfg.cat_smooth)

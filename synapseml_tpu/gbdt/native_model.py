@@ -26,8 +26,18 @@ Categorical splits round-trip too (r4): export writes LightGBM's bitset
 encoding — ``decision_type`` bit 0 set, the split's ``threshold`` is an
 index into ``cat_boundaries``/``cat_threshold`` uint32 words whose bits are
 the LEFT-going category values — and import decodes it back into this
-engine's per-split ``cat_set`` membership rows. Only ``default_left``
-missing handling still raises (this engine routes missing right).
+engine's per-split ``cat_set`` membership rows.
+
+``default_left`` (r5): a numeric split that routes missing LEFT is encoded
+as a per-split SET over the feature's bin ids — ``{bins <= threshold} ∪
+{missing bin}`` — reusing the categorical ``cat_set`` machinery (``bin ==
+-1`` + membership row), with the float threshold kept so export writes the
+split back as ``threshold`` + the ``default_left`` decision bit. Every
+predict path (host, device, TreeSHAP) already dispatches per-split on
+``bin < 0``, so real-world LightGBM models trained on data with missing
+values load and predict bit-for-bit. Only ``zero_as_missing`` models
+(missing_type=Zero) still raise: the zero category is a magnitude test
+(|v| <= 1e-35), not expressible as a bin set over the model's thresholds.
 """
 
 from __future__ import annotations
@@ -44,7 +54,9 @@ __all__ = ["booster_to_native", "booster_from_native"]
 # bits 2-3 missing_type (0 none, 1 zero, 2 NaN)
 _DT_CATEGORICAL = 1
 _DT_DEFAULT_LEFT = 2
+_DT_MISSING_ZERO = 1 << 2
 _DT_MISSING_NAN = 2 << 2
+_DT_MISSING_MASK = 3 << 2
 
 
 def _fmt(v: float) -> str:
@@ -104,6 +116,13 @@ def _replay_to_pointer(parent, feature, threshold, gain, leaf_value,
     cat_boundaries = [0]
     cat_threshold: List[int] = []
     for s in steps:
+        if bins is not None and int(bins[s]) < 0 and \
+                np.isfinite(threshold[s]):
+            # numeric set-split with the missing bin IN the set (an imported
+            # default_left split): write back as threshold + default_left bit
+            thresholds.append(float(threshold[s]))
+            decision_types.append(_DT_MISSING_NAN | _DT_DEFAULT_LEFT)
+            continue
         if bins is not None and int(bins[s]) < 0:  # categorical split
             f = int(feature[s])
             vals = cat_values.get(f)
@@ -316,10 +335,12 @@ def booster_from_native(model_str: str):
         flts = lambda key: ([float(x) for x in kv.get(key, "").split()]
                             or None)
         dts = ints("decision_type")
-        if any(dt & _DT_DEFAULT_LEFT for dt in dts):
+        if any((dt & _DT_MISSING_MASK) == _DT_MISSING_ZERO for dt in dts):
             raise NotImplementedError(
-                "default_left missing handling is not supported (this "
-                "engine routes missing values right)")
+                "zero_as_missing (missing_type=Zero) models are not "
+                "supported: the zero test (|v| <= 1e-35) is not expressible "
+                "over the model's own thresholds; retrain with the default "
+                "missing_type=NaN")
         trees.append(dict(
             num_leaves=nl, split_feature=ints("split_feature"),
             threshold=flts("threshold") or [],
@@ -394,8 +415,11 @@ def booster_from_native(model_str: str):
     leaf_value = np.zeros((T, C, max_leaves), np.float32)
     leaf_hess = np.zeros((T, C, max_leaves), np.float32)
     B = mapper.n_bins
-    cat_set = (np.zeros(shape1 + (B,), np.int8) if cat_vals_by_feat
-               else None)
+    any_default_left = any(
+        (dt & _DT_DEFAULT_LEFT) and not (dt & _DT_CATEGORICAL)
+        for tr in trees for dt in tr["decision_type"])
+    cat_set = (np.zeros(shape1 + (B,), np.int8)
+               if cat_vals_by_feat or any_default_left else None)
     for idx, tr in enumerate(trees):
         t, c = divmod(idx, C)
         (parent[t, c], feature[t, c], threshold[t, c], gain[t, c],
@@ -409,17 +433,29 @@ def booster_from_native(model_str: str):
             if nd < 0:
                 continue
             f = int(feature[t, c, s])
+            dt = (tr["decision_type"][nd]
+                  if nd < len(tr["decision_type"]) else _DT_MISSING_NAN)
             if _is_cat_split(tr, nd):
+                # LightGBM categorical splits route NaN/unseen RIGHT
+                # regardless of default_left (not-in-bitset rule)
                 vals = mapper.cat_values[f]
                 left = _bitset_values(tr, int(tr["threshold"][nd]))
                 codes = np.searchsorted(vals, np.asarray(left, np.float64))
                 cat_set[t, c, s, codes] = 1
                 bin_[t, c, s] = -1
                 threshold[t, c, s] = np.nan
+                continue
+            # bin = position of the threshold in the feature's edges
+            b = int(np.searchsorted(mapper.upper_edges[f],
+                                    threshold[t, c, s]))
+            if dt & _DT_DEFAULT_LEFT:
+                # 'v <= t OR missing' as a set over the feature's bins:
+                # {0..b} ∪ {missing bin}; threshold kept for re-export
+                cat_set[t, c, s, : b + 1] = 1
+                cat_set[t, c, s, mapper.missing_bin] = 1
+                bin_[t, c, s] = -1
             else:
-                # bin = position of the threshold in the feature's edges
-                bin_[t, c, s] = int(np.searchsorted(
-                    mapper.upper_edges[f], threshold[t, c, s]))
+                bin_[t, c, s] = b
     return GBDTBooster(
         mapper=mapper, objective=objective, num_class=num_class,
         base_score=np.zeros(num_class),

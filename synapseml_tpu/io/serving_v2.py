@@ -173,7 +173,14 @@ class RoutingServer:
                 # one timeout instead of at-least-once semantics (and the
                 # client never waits more than one timeout). Connection
                 # REFUSED is always safe to retry: the request was never
-                # received.
+                # received. Delivery contract: exactly-once for timeouts;
+                # AT-LEAST-ONCE when a worker DIES mid-request (a crash
+                # after execution but before the response is
+                # indistinguishable from one before it, and the reference's
+                # kill-a-worker contract requires the retry —
+                # ``HTTPv2Suite.scala:328``); worker-side request-id dedup
+                # is the escalation path if a pipeline needs strict
+                # exactly-once across crashes.
                 idempotent = method in ("GET", "HEAD")
                 timed_out = False
                 reply = None  # (status, content_type, entity)

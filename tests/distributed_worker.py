@@ -72,6 +72,31 @@ def main() -> int:
                            csr, y, mesh=mesh)
     sparse_hash = hashlib.sha256(sparse_booster.to_json().encode()).hexdigest()
 
+    # -- lambdarank: GROUP-ALIGNED sharding across processes -----------------
+    # whole queries per shard (reference repartition-by-group,
+    # ``LightGBMRanker.scala:82-109``); the model must be bit-identical on
+    # every process AND match the single-replica NDCG
+    sizes = rng.integers(3, 9, size=16)
+    n_r = int(sizes.sum())
+    xr = rng.normal(size=(n_r, 6))
+    rel = np.zeros(n_r)
+    start = 0
+    for sz in sizes:
+        sc = xr[start:start + sz, 0]
+        rel[start:start + sz] = np.clip(
+            np.argsort(np.argsort(sc)) * 3 // sz, 0, 2)
+        start += sz
+    rank_params = {"objective": "lambdarank", "num_iterations": 2,
+                   "num_leaves": 4, "min_data_in_leaf": 2}
+    ranker = train(rank_params, xr, rel, group=sizes, mesh=mesh)
+    rank_hash = hashlib.sha256(ranker.to_json().encode()).hexdigest()
+    from synapseml_tpu.gbdt.boost import _metric_ndcg
+
+    ndcg_mesh = _metric_ndcg(10)(rel, ranker.predict(xr), np.ones(n_r), sizes)
+    ranker_one = train(rank_params, xr, rel, group=sizes)
+    ndcg_one = _metric_ndcg(10)(rel, ranker_one.predict(xr),
+                                np.ones(n_r), sizes)
+
     # -- VW learner: pass-boundary pmean across processes --------------------
     from synapseml_tpu.core import Table
     from synapseml_tpu.vw import VowpalWabbitClassifier, VowpalWabbitFeaturizer
@@ -89,7 +114,9 @@ def main() -> int:
     # parent parses the LAST stdout line of each worker
     print(json.dumps({"pid": pid, "process_count": jax.process_count(),
                       "n_devices": len(devs), "gbdt": gbdt_hash,
-                      "sparse": sparse_hash, "vw": vw_hash}))
+                      "sparse": sparse_hash, "vw": vw_hash,
+                      "rank": rank_hash, "ndcg_mesh": ndcg_mesh,
+                      "ndcg_one": ndcg_one}))
     return 0
 
 

@@ -90,8 +90,11 @@ def test_two_process_rendezvous_bit_identical_models():
         assert r["process_count"] == 2
         assert r["n_devices"] == 4  # the GLOBAL mesh spans both processes
     # identical rendezvous -> identical psum/pmean -> bit-identical models
-    for key in ("gbdt", "sparse", "vw"):
+    for key in ("gbdt", "sparse", "vw", "rank"):
         assert results[0][key] == results[1][key], key
+    # group-aligned mesh lambdarank reproduces the single-replica ranking
+    for r in results:
+        assert abs(r["ndcg_mesh"] - r["ndcg_one"]) < 1e-9, r
 
 
 def test_three_process_rendezvous():
@@ -100,5 +103,7 @@ def test_three_process_rendezvous():
     assert {r["pid"] for r in results} == {0, 1, 2}
     assert all(r["process_count"] == 3 for r in results)
     assert all(r["n_devices"] == 3 for r in results)
-    for key in ("gbdt", "sparse", "vw"):
+    for key in ("gbdt", "sparse", "vw", "rank"):
         assert len({r[key] for r in results}) == 1, key
+    for r in results:
+        assert abs(r["ndcg_mesh"] - r["ndcg_one"]) < 1e-9, r
