@@ -11,6 +11,11 @@ the converted stream through the chunked recognizer. Same design here:
 - WAV input falls back to a pure-numpy resample/downmix path (stdlib
   ``wave`` + linear interpolation) so the canonical
   resample-to-16k-mono-16bit case needs no external binary at all;
+- COMPRESSED WAV codecs — G.711 µ-law (format 7), G.711 A-law (format 6),
+  and IMA ADPCM (format 0x11) — decode in pure numpy (r5: the compressed
+  branch is CI-testable without vendoring an ffmpeg binary; these are the
+  telephony/container codecs, while mp3/ogg/flac still take the ffmpeg
+  subprocess);
 - anything else without ffmpeg raises with an actionable message.
 
 The target profile is the speech service's canonical PCM: 16 kHz, mono,
@@ -59,6 +64,161 @@ def _ffmpeg_transcode(data: bytes, rate: int) -> bytes:
     return proc.stdout
 
 
+# WAVE format tags with built-in pure-numpy decoders
+_FMT_PCM = 0x0001
+_FMT_ALAW = 0x0006
+_FMT_ULAW = 0x0007
+_FMT_IMA_ADPCM = 0x0011
+
+# IMA ADPCM tables (public spec: IMA Digital Audio Focus Group, 1992)
+_IMA_STEPS = np.array([
+    7, 8, 9, 10, 11, 12, 13, 14, 16, 17, 19, 21, 23, 25, 28, 31, 34, 37,
+    41, 45, 50, 55, 60, 66, 73, 80, 88, 97, 107, 118, 130, 143, 157, 173,
+    190, 209, 230, 253, 279, 307, 337, 371, 408, 449, 494, 544, 598, 658,
+    724, 796, 876, 963, 1060, 1166, 1282, 1411, 1552, 1707, 1878, 2066,
+    2272, 2499, 2749, 3024, 3327, 3660, 4026, 4428, 4871, 5358, 5894,
+    6484, 7132, 7845, 8630, 9493, 10442, 11487, 12635, 13899, 15289,
+    16818, 18500, 20350, 22385, 24623, 27086, 29794, 32767], np.int32)
+_IMA_INDEX_ADJ = np.array([-1, -1, -1, -1, 2, 4, 6, 8], np.int32)
+
+
+def _riff_chunks(data: bytes):
+    """Yield (fourcc, payload) for each top-level RIFF/WAVE chunk."""
+    if data[:4] != b"RIFF" or data[8:12] != b"WAVE":
+        raise ValueError("not a RIFF/WAVE payload")
+    pos = 12
+    while pos + 8 <= len(data):
+        cc = data[pos:pos + 4]
+        size = int.from_bytes(data[pos + 4:pos + 8], "little")
+        yield cc, data[pos + 8:pos + 8 + size]
+        pos += 8 + size + (size & 1)  # chunks are word-aligned
+
+
+def _ulaw_decode(b: np.ndarray) -> np.ndarray:
+    """G.711 µ-law byte -> float in [-1, 1)."""
+    u = (~b.astype(np.uint8)).astype(np.int32)
+    sign = u >> 7
+    exp = (u >> 4) & 7
+    mant = u & 15
+    mag = ((mant << 3) + 0x84 << exp) - 0x84
+    pcm = np.where(sign, -mag, mag)
+    return pcm.astype(np.float32) / 32768.0
+
+
+def _alaw_decode(b: np.ndarray) -> np.ndarray:
+    """G.711 A-law byte -> float in [-1, 1)."""
+    a = (b.astype(np.uint8) ^ 0x55).astype(np.int32)
+    sign = a >> 7  # after the XOR, a SET sign bit means POSITIVE (G.711)
+    exp = (a >> 4) & 7
+    mant = a & 15
+    mag = np.where(exp == 0, (mant << 4) + 8,
+                   ((mant << 4) + 0x108) << np.maximum(exp - 1, 0))
+    pcm = np.where(sign, mag, -mag)
+    return pcm.astype(np.float32) / 32768.0
+
+
+def _ima_adpcm_decode(raw: bytes, channels: int, block_align: int) -> np.ndarray:
+    """IMA ADPCM (WAVE format 0x11) -> float mono-interleavable array.
+
+    Block layout per channel: 4-byte header (s16 predictor, u8 step index,
+    reserved), then 4-bit nibbles in 4-byte words interleaved per channel.
+    The sequential predictor recurrence is per-block, so blocks decode
+    independently (vectorization happens across blocks via the outer loop —
+    payloads here are seconds of speech, not hours)."""
+    n_blocks, rem = divmod(len(raw), block_align)
+    if rem:
+        raw = raw[: n_blocks * block_align]
+    out = []
+    for bi in range(n_blocks):
+        blk = raw[bi * block_align:(bi + 1) * block_align]
+        preds = np.empty(channels, np.int32)
+        idxs = np.empty(channels, np.int32)
+        chans = [[] for _ in range(channels)]
+        for c in range(channels):
+            h = blk[c * 4:(c + 1) * 4]
+            preds[c] = int.from_bytes(h[0:2], "little", signed=True)
+            idxs[c] = min(max(h[2], 0), 88)
+            chans[c].append(preds[c])
+        body = blk[channels * 4:]
+        # nibble stream: 4-byte words per channel, channels interleaved
+        words = [body[i:i + 4] for i in range(0, len(body) - 3, 4)]
+        for wi, word in enumerate(words):
+            c = wi % channels
+            pred, idx = int(preds[c]), int(idxs[c])
+            for byte in word:
+                for nib in (byte & 0xF, byte >> 4):
+                    step = int(_IMA_STEPS[idx])
+                    diff = step >> 3
+                    if nib & 1:
+                        diff += step >> 2
+                    if nib & 2:
+                        diff += step >> 1
+                    if nib & 4:
+                        diff += step
+                    pred = pred - diff if nib & 8 else pred + diff
+                    pred = min(max(pred, -32768), 32767)
+                    idx = min(max(idx + int(_IMA_INDEX_ADJ[nib & 7]), 0), 88)
+                    chans[c].append(pred)
+            preds[c], idxs[c] = pred, idx
+        n_samp = min(len(ch) for ch in chans)
+        inter = np.empty(n_samp * channels, np.float32)
+        for c in range(channels):
+            inter[c::channels] = np.asarray(chans[c][:n_samp],
+                                            np.float32) / 32768.0
+        out.append(inter)
+    return np.concatenate(out) if out else np.empty(0, np.float32)
+
+
+def _compressed_wav_decode(data: bytes):
+    """Decode a compressed-codec WAV (µ-law / A-law / IMA ADPCM) to
+    (float samples interleaved, rate, channels); ValueError when the codec
+    has no built-in decoder (caller falls through to ffmpeg)."""
+    fmt = None
+    body = None
+    for cc, payload in _riff_chunks(data):
+        if cc == b"fmt ":
+            fmt = payload
+        elif cc == b"data":
+            body = payload
+    if fmt is None or body is None:
+        raise ValueError("WAV missing fmt/data chunks")
+    tag = int.from_bytes(fmt[0:2], "little")
+    channels = int.from_bytes(fmt[2:4], "little") or 1
+    rate = int.from_bytes(fmt[4:8], "little")
+    if rate <= 0:
+        # fuzzed/corrupt header: fall through to the ffmpeg/error chain
+        # rather than dividing by zero in the resampler
+        raise ValueError("compressed WAV declares sample rate 0")
+    block_align = int.from_bytes(fmt[12:14], "little")
+    if tag == _FMT_ULAW:
+        x = _ulaw_decode(np.frombuffer(body, np.uint8))
+    elif tag == _FMT_ALAW:
+        x = _alaw_decode(np.frombuffer(body, np.uint8))
+    elif tag == _FMT_IMA_ADPCM:
+        x = _ima_adpcm_decode(body, channels, max(block_align, channels * 4))
+    else:
+        raise ValueError(f"no built-in decoder for WAVE format 0x{tag:04x}")
+    return x, rate, channels
+
+
+def _float_to_wav(x: np.ndarray, src_rate: int, channels: int,
+                  rate: int) -> bytes:
+    """Interleaved float samples -> canonical 16 kHz mono s16 WAV."""
+    if channels > 1:
+        x = x[: len(x) // channels * channels].reshape(-1, channels).mean(1)
+    if src_rate != rate and len(x):
+        n_out = max(int(round(len(x) * rate / src_rate)), 1)
+        x = np.interp(np.linspace(0, len(x) - 1, n_out), np.arange(len(x)), x)
+    pcm = np.clip(np.round(x * 32767.0), -32768, 32767).astype("<i2")
+    buf = io.BytesIO()
+    with wave.open(buf, "wb") as w:
+        w.setnchannels(1)
+        w.setsampwidth(2)
+        w.setframerate(rate)
+        w.writeframes(pcm.tobytes())
+    return buf.getvalue()
+
+
 def _wav_transcode(data: bytes, rate: int) -> bytes:
     """Pure-numpy WAV -> 16 kHz mono s16 WAV (no external binary)."""
     with wave.open(io.BytesIO(data)) as w:
@@ -75,20 +235,7 @@ def _wav_transcode(data: bytes, rate: int) -> bytes:
         x = np.frombuffer(raw, dtype="<i4").astype(np.float32) / 2147483648.0
     else:
         raise ValueError(f"unsupported WAV sample width {width}")
-    if channels > 1:
-        x = x.reshape(-1, channels).mean(axis=1)  # downmix
-    if src_rate != rate and len(x):
-        n_out = max(int(round(len(x) * rate / src_rate)), 1)
-        x = np.interp(np.linspace(0, len(x) - 1, n_out),
-                      np.arange(len(x)), x)
-    pcm = np.clip(np.round(x * 32767.0), -32768, 32767).astype("<i2")
-    buf = io.BytesIO()
-    with wave.open(buf, "wb") as w:
-        w.setnchannels(1)
-        w.setsampwidth(2)
-        w.setframerate(rate)
-        w.writeframes(pcm.tobytes())
-    return buf.getvalue()
+    return _float_to_wav(x, src_rate, channels, rate)
 
 
 def transcode_to_wav(data: bytes, src_format: str = "auto",
@@ -108,13 +255,18 @@ def transcode_to_wav(data: bytes, src_format: str = "auto",
                     and info["sample_width"] == 2):
                 return data  # already canonical: no copy, no subprocess
             return _wav_transcode(data, rate)
-        except (wave.Error, ValueError):
-            # malformed header or a width the numpy path doesn't speak
-            # (e.g. 24-bit studio PCM): let ffmpeg try
-            pass
+        except (wave.Error, ValueError, EOFError):
+            # non-PCM codec, malformed header, or a width the plain path
+            # doesn't speak: try the built-in compressed decoders
+            try:
+                x, src_rate, channels = _compressed_wav_decode(data)
+                return _float_to_wav(x, src_rate, channels, rate)
+            except ValueError:
+                pass  # codec without a built-in decoder: let ffmpeg try
     if ffmpeg_available():
         return _ffmpeg_transcode(data, rate)
     raise RuntimeError(
         f"transcoding {src_format!r} audio needs an ffmpeg binary on PATH "
-        "(only 8/16/32-bit WAV has a built-in converter); install ffmpeg or "
-        "pre-convert to 16 kHz mono 16-bit WAV")
+        "(8/16/32-bit PCM, mu-law/A-law, and IMA ADPCM WAV have built-in "
+        "converters); install ffmpeg or pre-convert to 16 kHz mono 16-bit "
+        "WAV")

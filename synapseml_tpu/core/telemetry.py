@@ -17,7 +17,7 @@ import time
 from typing import Any, Dict, List
 
 __all__ = ["log_stage_call", "recent_events", "clear_events", "get_logger",
-           "BUILD_VERSION"]
+           "profile_trace", "BUILD_VERSION"]
 
 BUILD_VERSION = "0.1.0"
 
@@ -44,6 +44,37 @@ def log_stage_call(stage, method: str, **extra) -> None:
         _events.append(evt)
     if _logger.isEnabledFor(logging.DEBUG):
         _logger.debug("metrics/ %s", json.dumps(evt, default=str))
+
+
+def profile_trace(trace_dir: str):
+    """Context manager capturing a ``jax.profiler`` trace into ``trace_dir``
+    (SURVEY §5 prescription: the analogue of the reference's StopWatch/VW
+    phase-timing diagnostics, but at XLA-op granularity — open the result
+    with TensorBoard or ``tensorboard_plugin_profile``).
+
+    The device trace shows per-HLO time, fusion boundaries, and HBM traffic
+    — the data the engine's perf plateaus get debugged with. A telemetry
+    event records the capture so traces are discoverable after the fact.
+
+    >>> from synapseml_tpu.core.telemetry import profile_trace
+    >>> with profile_trace("/tmp/trace"):   # doctest: +SKIP
+    ...     model.transform(table)
+    """
+    import contextlib
+
+    @contextlib.contextmanager
+    def _ctx():
+        import jax
+
+        evt = {"method": "profile_trace", "trace_dir": trace_dir,
+               "className": "profiler", "uid": "profiler",
+               "buildVersion": BUILD_VERSION, "ts": time.time()}
+        with _lock:
+            _events.append(evt)
+        with jax.profiler.trace(trace_dir):
+            yield trace_dir
+
+    return _ctx()
 
 
 def recent_events() -> List[Dict[str, Any]]:

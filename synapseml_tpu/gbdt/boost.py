@@ -782,8 +782,12 @@ class GBDTBooster:
 
         ``xv`` (n, d) raw values; ``featmap`` (T, C, S) feature column per
         split (possibly remapped into a submatrix); ``thrmap`` (T, C, S)
-        float thresholds."""
-        if self.cat_set is not None:
+        float thresholds. Numeric SET splits (``bin < 0`` with a finite
+        threshold — imported default_left models) route missing left; true
+        categorical splits (NaN threshold) have no raw-value walk and
+        raise."""
+        if self.cat_set is not None and bool(
+                ((self.bin < 0) & ~np.isfinite(self.threshold)).any()):
             raise ValueError("approximate (Saabas) contributions don't support "
                              "categorical splits; use approximate=False")
         T = self._used_trees(num_iteration)
@@ -818,7 +822,12 @@ class GBDTBooster:
                     col = xv[:, feat[s]]
                     at_p = node == p
                     with np.errstate(invalid="ignore"):
-                        go_right = at_p & (np.isnan(col) | (col > thr[s]))
+                        if self.bin[t, c, s] < 0:
+                            # default_left set split: NaN routes LEFT
+                            # (NaN > thr compares False)
+                            go_right = at_p & (col > thr[s])
+                        else:
+                            go_right = at_p & (np.isnan(col) | (col > thr[s]))
                     go_left = at_p & ~go_right
                     new = np.where(go_right, right_val[s], np.where(go_left, left_val[s], cur))
                     out[c, at_p, feat[s]] += (new[at_p] - cur[at_p]) * sc
@@ -1125,13 +1134,15 @@ def _build_step(grad_fn=None, fobj=None, *, cfg, C, lr, boosting, d, cat_idx,
         fmask = jnp.where(fmask.sum() == 0, jnp.ones((d,), jnp.float32), fmask)
 
         bw = make_weights(key, jnp.abs(g).sum(axis=1), yv, g.shape[0])
-        # zero-weight rows are no-ops (the padding convention every mesh
-        # layout relies on: wrapped/duplicated pad rows carry w=0). Without
-        # this they still count 1 in the histogram COUNT channel — g/h are
-        # already zero via w — inflating min_data_in_leaf gating and
-        # breaking mesh-vs-single-replica tree equality whenever n doesn't
-        # divide the shard count (or under the lambdarank group layout).
-        bw = jnp.where(wv == 0, 0.0, bw)
+        # mesh PADDING rows are marked with weight NEGATIVE ZERO (-0.0) by
+        # train()'s upload layouts: their g/h are zero via the weight, but
+        # without this mask they would still count 1 in the histogram COUNT
+        # channel, inflating min_data_in_leaf gating and breaking
+        # mesh-vs-single-replica tree equality whenever n doesn't divide
+        # the shard count (or under the lambdarank group layout). A USER's
+        # +0.0 sample weight keeps its count — LightGBM counts zero-weight
+        # rows too.
+        bw = jnp.where(jnp.signbit(wv) & (wv == 0), 0.0, bw)
 
         cmask = (jnp.asarray(cat_mask_np) if cat_mask_np is not None else None)
 
@@ -1358,7 +1369,13 @@ def train(params: Dict[str, Any], x: np.ndarray, y: Optional[np.ndarray] = None,
         n, d = x.shape
     y = np.asarray(y, dtype=np.float64)
     w_dev_in = weight if isinstance(weight, jnp.ndarray) else None
-    w_np = np.ones(n) if weight is None else np.asarray(weight, dtype=np.float64)
+    # + 0.0 normalizes a user's -0.0 weights to +0.0: NEGATIVE zero is the
+    # in-band mesh-padding sentinel (one_iter zeroes those rows' histogram
+    # count), and a user zero weight must keep its count like LightGBM's
+    if w_dev_in is not None:
+        w_dev_in = w_dev_in + 0.0
+    w_np = np.ones(n) if weight is None else \
+        np.asarray(weight, dtype=np.float64) + 0.0
 
     lr_layout = None  # (order, w_mask) group-aligned mesh layout, lambdarank only
     if obj_name == "lambdarank":
@@ -1616,10 +1633,14 @@ def train(params: Dict[str, Any], x: np.ndarray, y: Optional[np.ndarray] = None,
             # collective placement, no host round-trip); padding rows wrap
             # to the front with zero weight
             def dpad(a, fill_first=True):
+                # fill_first=False is the WEIGHT column: padding rows carry
+                # -0.0, the sentinel one_iter uses to zero their histogram
+                # count (a user's +0.0 weight still counts, like LightGBM)
                 if pad:
                     a = jnp.concatenate(
                         [a, a[:pad] if fill_first else
-                         jnp.zeros((pad,) + a.shape[1:], a.dtype)], axis=0)
+                         jnp.full((pad,) + a.shape[1:], -0.0, a.dtype)],
+                        axis=0)
                 return a
             binned_d = dev_put(dpad(dataset.device_binned()), data_spec)
             y_d = dev_put(dpad(
@@ -1650,7 +1671,8 @@ def train(params: Dict[str, Any], x: np.ndarray, y: Optional[np.ndarray] = None,
                 d=sb.d, n_bins=sb.n_bins, n=sb.n, max_run=sb.max_run)
             if pad:
                 y = np.concatenate([y, y[:pad]])
-                w_np = np.concatenate([w_np, np.zeros(pad)])
+                # -0.0: padding sentinel (zero weight AND zero hist count)
+                w_np = np.concatenate([w_np, np.full(pad, -0.0)])
                 raw0 = np.concatenate([raw0, raw0[:pad]], axis=0)
             y_d = dev_put(y.astype(np.float32), data_spec)
             w_d = dev_put(w_np.astype(np.float32), data_spec)
@@ -1658,17 +1680,19 @@ def train(params: Dict[str, Any], x: np.ndarray, y: Optional[np.ndarray] = None,
         else:
             if lr_layout is not None:
                 # lambdarank group-aligned layout: shard s's block holds its
-                # whole queries (+ zero-weight padding); the grad fn's group
+                # whole queries (+ -0.0-weight padding); the grad fn's group
                 # tables are in these LOCAL coordinates
                 lr_order, lr_wmask = lr_layout
                 binned_np = binned_np[lr_order]
                 y = y[lr_order]
-                w_np = w_np[lr_order] * lr_wmask
+                w_np = np.where(lr_wmask == 0, -0.0,
+                                w_np[lr_order] * lr_wmask)
                 raw0 = raw0[lr_order]
             elif pad:
                 binned_np = np.concatenate([binned_np, binned_np[:pad]], axis=0)
                 y = np.concatenate([y, y[:pad]])
-                w_np = np.concatenate([w_np, np.zeros(pad)])  # zero wt: no-op
+                # -0.0: padding sentinel (zero weight AND zero hist count)
+                w_np = np.concatenate([w_np, np.full(pad, -0.0)])
                 raw0 = np.concatenate([raw0, raw0[:pad]], axis=0)
             binned_d = dev_put(binned_np.astype(bin_dtype), data_spec)
             y_d = dev_put(y.astype(np.float32), data_spec)

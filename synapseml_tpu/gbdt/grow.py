@@ -87,6 +87,29 @@ def _thresh_l1(g, l1):
     return jnp.sign(g) * jnp.maximum(jnp.abs(g) - l1, 0.0)
 
 
+def _prefix_bins(h):
+    """Inclusive prefix sum along the BIN axis (-2) of (..., B, c) via a
+    lower-triangular MXU matmul.
+
+    XLA lowers ``cumsum`` on TPU to an O(B^2) reduce-window on the VPU —
+    at Adult scale (B=256) that single op was ~30% of per-split device time
+    (r5 trace: 263 us). The triangular dot does the same O(B^2) flops on
+    the MXU in single-digit microseconds. Summation order differs from the
+    sequential scan only in fp rounding; split-gain ties are resolved the
+    same way on every backend since the formulation is used everywhere."""
+    import jax
+    import jax.numpy as jnp
+
+    B = h.shape[-2]
+    tri = jnp.tril(jnp.ones((B, B), jnp.float32))
+    # HIGHEST: default TPU matmul precision truncates operands to bf16 —
+    # fine for the one-hot histogram (0/1 and raw per-row values are
+    # bf16-exact) but NOT for these already-accumulated per-bin sums
+    return jnp.einsum("ij,...jc->...ic", tri, h,
+                      precision=jax.lax.Precision.HIGHEST,
+                      preferred_element_type=jnp.float32)
+
+
 def grow_tree(binned, grad, hess, row_weight, feature_mask, cfg: TreeConfig,
               axis_name: Optional[str] = None, cat_mask=None):
     """Grow one tree. Returns (GrownTree of device arrays, node_of_row (n,) int32).
@@ -204,17 +227,15 @@ def grow_tree(binned, grad, hess, row_weight, feature_mask, cfg: TreeConfig,
             )
             return jnp.where(valid, g, -jnp.inf)
 
-        gain_num = split_gain(jnp.cumsum(G, -1), jnp.cumsum(H, -1),
-                              jnp.cumsum(C, -1), True)
+        cum = _prefix_bins(hists)
+        gain_num = split_gain(cum[..., 0], cum[..., 1], cum[..., 2], True)
         if not has_cat:
             return gain_num
         ratio = G / (H + cfg.cat_smooth)
         order = jnp.argsort(-ratio, axis=-1)
-        Gs = jnp.take_along_axis(G, order, -1)
-        Hs = jnp.take_along_axis(H, order, -1)
-        Cs = jnp.take_along_axis(C, order, -1)
-        gain_cat = split_gain(jnp.cumsum(Gs, -1), jnp.cumsum(Hs, -1),
-                              jnp.cumsum(Cs, -1),
+        hs = jnp.take_along_axis(hists, order[..., None], axis=-2)
+        cums = _prefix_bins(hs)
+        gain_cat = split_gain(cums[..., 0], cums[..., 1], cums[..., 2],
                               pos + 1 <= cfg.max_cat_threshold)
         return gain_num, gain_cat
 
@@ -442,19 +463,17 @@ def _grow_tree_sparse(sb, grad, hess, row_weight, feature_mask,
         Numeric entry b = 'bin <= b' threshold; categorical entry b =
         best sorted-prefix of length b+1 (dense ``gain_table`` semantics)."""
         G, H, C = h[..., 0], h[..., 1], h[..., 2]
-        g_num = _split_gain_parts(G, H, C, jnp.cumsum(G, -1),
-                                  jnp.cumsum(H, -1), jnp.cumsum(C, -1),
-                                  fmask_sel, True)
+        cum = _prefix_bins(h)
+        g_num = _split_gain_parts(G, H, C, cum[..., 0], cum[..., 1],
+                                  cum[..., 2], fmask_sel, True)
         if not has_cat:
             return g_num
         ratio = G / (H + cfg.cat_smooth)
         order = jnp.argsort(-ratio, axis=-1)
-        Gs = jnp.take_along_axis(G, order, -1)
-        Hs = jnp.take_along_axis(H, order, -1)
-        Cs = jnp.take_along_axis(C, order, -1)
-        g_cat = _split_gain_parts(G, H, C, jnp.cumsum(Gs, -1),
-                                  jnp.cumsum(Hs, -1), jnp.cumsum(Cs, -1),
-                                  fmask_sel,
+        hs = jnp.take_along_axis(h, order[..., None], axis=-2)
+        cums = _prefix_bins(hs)
+        g_cat = _split_gain_parts(G, H, C, cums[..., 0], cums[..., 1],
+                                  cums[..., 2], fmask_sel,
                                   pos + 1 <= cfg.max_cat_threshold)
         cm = cat_mask if cmask_sel is None else cmask_sel
         return jnp.where(cm[..., None] > 0, g_cat, g_num)

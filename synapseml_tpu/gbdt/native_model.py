@@ -415,11 +415,21 @@ def booster_from_native(model_str: str):
     leaf_value = np.zeros((T, C, max_leaves), np.float32)
     leaf_hess = np.zeros((T, C, max_leaves), np.float32)
     B = mapper.n_bins
-    any_default_left = any(
-        (dt & _DT_DEFAULT_LEFT) and not (dt & _DT_CATEGORICAL)
-        for tr in trees for dt in tr["decision_type"])
+
+    def _missing_goes_left(dt: int, thr: float) -> bool:
+        if dt & _DT_CATEGORICAL:
+            return False  # LightGBM cat splits route NaN/unseen right
+        if (dt & _DT_MISSING_MASK) == _DT_MISSING_NAN:
+            return bool(dt & _DT_DEFAULT_LEFT)
+        # missing_type=None: NaN converts to 0.0 before the compare
+        return 0.0 <= thr
+
+    any_missing_left = any(
+        _missing_goes_left(dt, thr)
+        for tr in trees
+        for dt, thr in zip(tr["decision_type"], tr["threshold"]))
     cat_set = (np.zeros(shape1 + (B,), np.int8)
-               if cat_vals_by_feat or any_default_left else None)
+               if cat_vals_by_feat or any_missing_left else None)
     for idx, tr in enumerate(trees):
         t, c = divmod(idx, C)
         (parent[t, c], feature[t, c], threshold[t, c], gain[t, c],
@@ -448,7 +458,7 @@ def booster_from_native(model_str: str):
             # bin = position of the threshold in the feature's edges
             b = int(np.searchsorted(mapper.upper_edges[f],
                                     threshold[t, c, s]))
-            if dt & _DT_DEFAULT_LEFT:
+            if _missing_goes_left(dt, threshold[t, c, s]):
                 # 'v <= t OR missing' as a set over the feature's bins:
                 # {0..b} ∪ {missing bin}; threshold kept for re-export
                 cat_set[t, c, s, : b + 1] = 1

@@ -155,14 +155,26 @@ class Explode(Transformer):
 
 
 class TimerModel(Model):
-    """Fitted Timer: times the wrapped fitted stage's transform."""
+    """Fitted Timer: times the wrapped fitted stage's transform.
+
+    ``profile_dir`` additionally captures a ``jax.profiler`` trace of the
+    transform (per-HLO device timeline — SURVEY §5's prescription for
+    debugging where a stage's device time actually goes)."""
 
     inner_model = ComplexParam("wrapped fitted transformer", object, default=None)
     log_to_logger = Param("emit timing to logger", bool, default=True)
+    profile_dir = Param("capture a jax profiler trace into this directory",
+                        str, default=None)
 
     def _transform(self, table: Table) -> Table:
+        import contextlib
+
+        from ..core.telemetry import profile_trace
+
         sw = StopWatch()
-        with sw.measure():
+        ctx = (profile_trace(self.profile_dir) if self.profile_dir
+               else contextlib.nullcontext())
+        with ctx, sw.measure():
             out = self.inner_model.transform(table)
         self._last_elapsed_s = sw.elapsed_s
         if self.log_to_logger:

@@ -227,3 +227,55 @@ def test_model_stage_native_save_load(data, tmp_path):
     np.testing.assert_allclose(np.asarray(m2.transform(t)["probability"]),
                                np.asarray(m.transform(t)["probability"]),
                                rtol=1e-5, atol=1e-5)
+
+
+def test_import_missing_type_none_converts_nan_to_zero():
+    """missing_type=None (bits 2-3 == 00, real dumps of NaN-free training):
+    LightGBM converts NaN to 0.0 BEFORE the compare, so missing routes left
+    exactly when 0 <= threshold — regardless of the default_left bit."""
+    def model(dt, thr):
+        return "\n".join([
+            "tree", "num_class=1", "num_tree_per_iteration=1",
+            "max_feature_idx=0", "objective=regression", "",
+            "Tree=0", "num_leaves=2", "num_cat=0",
+            "split_feature=0", "split_gain=1",
+            f"threshold={thr}", f"decision_type={dt}",
+            "left_child=-1", "right_child=-2",
+            "leaf_value=-1.0 1.0", "leaf_weight=3 3", "",
+            "end of trees", "",
+        ])
+
+    xnan = np.array([[np.nan]])
+    # t = -1.0: NaN -> 0.0 > -1.0 -> RIGHT, even with default_left set
+    for dt in (0, 2):
+        b = GBDTBooster.from_native_model(model(dt, -1.0))
+        np.testing.assert_allclose(b.raw_predict(xnan), [1.0], atol=1e-7)
+    # t = +1.0: NaN -> 0.0 <= 1.0 -> LEFT
+    for dt in (0, 2):
+        b = GBDTBooster.from_native_model(model(dt, 1.0))
+        np.testing.assert_allclose(b.raw_predict(xnan), [-1.0], atol=1e-7)
+    # missing_type=NaN honors default_left directly
+    b = GBDTBooster.from_native_model(model(10, -1.0))
+    np.testing.assert_allclose(b.raw_predict(xnan), [-1.0], atol=1e-7)
+    b = GBDTBooster.from_native_model(model(8, 1.0))
+    np.testing.assert_allclose(b.raw_predict(xnan), [1.0], atol=1e-7)
+
+
+def test_default_left_saabas_contrib():
+    """Saabas contributions walk imported default_left set splits (missing
+    routes left) instead of refusing; true categorical splits still raise."""
+    text = "\n".join([
+        "tree", "num_class=1", "num_tree_per_iteration=1",
+        "max_feature_idx=0", "objective=regression", "",
+        "Tree=0", "num_leaves=2", "num_cat=0",
+        "split_feature=0", "split_gain=1",
+        "threshold=0.25", "decision_type=10",
+        "left_child=-1", "right_child=-2",
+        "leaf_value=-1.0 1.0", "leaf_weight=3 3", "",
+        "end of trees", "",
+    ])
+    b = GBDTBooster.from_native_model(text)
+    x = np.array([[0.0], [1.0], [np.nan]])
+    contrib = b.predict_contrib(x, approximate=True)
+    np.testing.assert_allclose(contrib.sum(axis=1), b.raw_predict(x),
+                               atol=1e-6)
