@@ -56,14 +56,44 @@ def dense_attention(q, k, v, causal: bool = False, pv_dtype=None):
     return out.astype(q.dtype)
 
 
-def flash_attention(q, k, v, causal: bool = False, block_q: int = 512,
-                    block_k: int = 512, interpret: bool = False):
+def _pow2_divisor(s: int, cap: int) -> int:
+    """Largest power-of-2 divisor of ``s`` that is <= cap."""
+    b = cap
+    while b > 1 and s % b:
+        b //= 2
+    return b
+
+
+def _pick_blocks(bh: int, s_q: int, s_k: int):
+    """Block sizes tuned from the r5 TPU v5e sweep (bench.py harness,
+    single-dispatch timing):
+
+    - small grids (bh < 32) at long S are latency-bound per grid step —
+      wide (2048, 1024) q/k blocks win (S=32k, B=1, H=8: 31.5 ms / 0.354
+      MFU vs 41 ms at (2048, 512));
+    - bigger grids (serving batches, B*H >= 32) saturate with (1024, 1024)
+      AND must stay there: (2048, 512) at bh=64 exceeds the 16 MB scoped
+      VMEM limit (B=8, S=8k OOM'd in the sweep);
+    - everything clamps to power-of-2 divisors of the sequence lengths.
+    """
+    bq_target = 2048 if (bh < 32 and s_q >= 16384) else 1024
+    return (_pow2_divisor(s_q, bq_target), _pow2_divisor(s_k, 1024))
+
+
+def flash_attention(q, k, v, causal: bool = False, block_q: int = None,
+                    block_k: int = None, interpret: bool = False):
     """Blockwise-online-softmax attention as ONE Pallas kernel.
 
-    ``q`` (B, S_q, H, D), ``k``/``v`` (B, S_k, H, D) -> (B, S_q, H, D).
+    ``q`` (B, S_q, H, D), ``k``/``v`` (B, S_k, H_kv, D) -> (B, S_q, H, D).
+    ``H_kv`` may divide ``H`` (grouped-query attention): the kernel maps
+    each query head's grid step onto its K/V group IN-KERNEL via the block
+    index map, so grouped K/V are never expanded in HBM (Llama/Mistral
+    checkpoints pay 1/group of the K/V bandwidth).
+
     ``causal`` aligns the diagonal to the END of the key sequence (queries
     are the LAST S_q positions), matching decode/ring conventions. Block
-    sizes must divide the respective sequence lengths.
+    sizes default to the r5 sweep's auto-pick (:func:`_pick_blocks`);
+    explicit values must divide the sequence lengths.
 
     ``bench.py``'s ``flash_attention_32k`` config records throughput on the
     round's TPU; at short S the kernel is dispatch-bound and roughly ties
@@ -74,27 +104,35 @@ def flash_attention(q, k, v, causal: bool = False, block_q: int = 512,
 
     b, s_q, h, d = q.shape
     s_k = k.shape[1]
-    if k.shape != (b, s_k, h, d) or v.shape != (b, s_k, h, d):
+    h_kv = k.shape[2]
+    if k.shape != (b, s_k, h_kv, d) or v.shape != (b, s_k, h_kv, d):
         raise ValueError(f"shape mismatch: q {q.shape}, k {k.shape}, "
                          f"v {v.shape}")
+    if h % h_kv:
+        raise ValueError(f"query heads {h} must be a multiple of kv heads "
+                         f"{h_kv} (GQA groups)")
+    rep = h // h_kv
     if causal and s_q > s_k:
         # queries are the LAST s_q positions of the key sequence; more
         # queries than keys would leave leading rows with no visible key
         # (and silently all-zero outputs)
         raise ValueError(f"causal flash attention needs s_q <= s_k, got "
                          f"s_q={s_q} > s_k={s_k}")
-    block_q = min(block_q, s_q)
-    block_k = min(block_k, s_k)
+    auto_bq, auto_bk = _pick_blocks(b * h, s_q, s_k)
+    block_q = min(block_q or auto_bq, s_q)  # each side auto-fills alone
+    block_k = min(block_k or auto_bk, s_k)
     if s_q % block_q or s_k % block_k:
         raise ValueError(f"block sizes ({block_q}, {block_k}) must divide "
                          f"sequence lengths ({s_q}, {s_k})")
 
-    # (B, S, H, D) -> (B*H, S, D): batch*head is the embarrassing grid axis
+    # (B, S, H, D) -> (B*H, S, D): batch*head is the embarrassing grid axis.
+    # K/V keep their GROUPED head count; the kernel's index map divides.
     def to_bh(x):
-        return jnp.transpose(x, (0, 2, 1, 3)).reshape(b * h, x.shape[1], d)
+        return jnp.transpose(x, (0, 2, 1, 3)).reshape(
+            b * x.shape[2], x.shape[1], d)
 
     out = _flash_bh(to_bh(q), to_bh(k), to_bh(v), bool(causal), int(block_q),
-                    int(block_k), bool(interpret))
+                    int(block_k), int(rep), bool(interpret))
     return (out.reshape(b, h, s_q, d).transpose(0, 2, 1, 3)).astype(q.dtype)
 
 
@@ -104,16 +142,16 @@ def _flash_bh_jit():
     import jax
 
     return jax.jit(_flash_bh_impl,
-                   static_argnames=("causal", "block_q", "block_k",
+                   static_argnames=("causal", "block_q", "block_k", "rep",
                                     "interpret"))
 
 
-def _flash_bh(q, k, v, causal, block_q, block_k, interpret):
+def _flash_bh(q, k, v, causal, block_q, block_k, rep, interpret):
     return _flash_bh_jit()(q, k, v, causal=causal, block_q=block_q,
-                           block_k=block_k, interpret=interpret)
+                           block_k=block_k, rep=rep, interpret=interpret)
 
 
-def _flash_bh_impl(q, k, v, causal, block_q, block_k, interpret):
+def _flash_bh_impl(q, k, v, causal, block_q, block_k, rep, interpret):
     import jax
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
@@ -182,17 +220,30 @@ def _flash_bh_impl(q, k, v, causal, block_q, block_k, interpret):
                         ).astype(o_ref.dtype)
 
     grid = (bh, s_q // block_q, nk)
+    # GQA: query-head grid step bhi reads K/V group bhi // rep — since
+    # h = rep * h_kv, (batch*h + head) // rep == batch*h_kv + head//rep,
+    # so one integer divide maps flattened (b, h) onto flattened (b, h_kv);
+    # the grouped K/V are never expanded in HBM. rep == 1 keeps the plain
+    # identity map (a division in the index map can pessimize Mosaic's
+    # block-revisit analysis).
+    if rep == 1:
+        kv_map = lambda bhi, i, j: (bhi, j, 0)
+    else:
+        kv_map = lambda bhi, i, j: (bhi // rep, j, 0)
     return pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda bhi, i, j: (bhi, i, 0)),
-            pl.BlockSpec((1, block_k, d), lambda bhi, i, j: (bhi, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda bhi, i, j: (bhi, j, 0)),
+            pl.BlockSpec((1, block_k, d), kv_map),
+            pl.BlockSpec((1, block_k, d), kv_map),
         ],
         out_specs=pl.BlockSpec((1, block_q, d),
                                lambda bhi, i, j: (bhi, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((bh, s_q, d), jnp.float32),
+        # output in the INPUT dtype: the caller casts to q.dtype anyway, and
+        # the f32 out block was what pushed (2048, 1024) past the 16 MB
+        # scoped-VMEM limit when operands arrive as arguments (r5)
+        out_shape=jax.ShapeDtypeStruct((bh, s_q, d), q.dtype),
         scratch_shapes=[
             # running max (lane 0) + denominator (lane 1)
             pltpu.VMEM((block_q, 128), jnp.float32),

@@ -50,11 +50,11 @@ def _expand_gqa(q, k, v):
 def _auto_block(s: int, cap: int = 512) -> int:
     """Largest power-of-2 block <= cap dividing ``s`` (flash blocks must
     divide the sequence; gathered Ulysses sequences are rarely multiples of
-    the kernel's 512 default)."""
-    b = cap
-    while b > 1 and s % b:
-        b //= 2
-    return b
+    the kernel defaults). Shares the divisor rule with the kernel's own
+    auto-pick so the two cannot drift."""
+    from .flash import _pow2_divisor
+
+    return _pow2_divisor(s, cap)
 
 
 def ring_attention(q, k, v, axis_name: str, causal: bool = False):
@@ -175,7 +175,10 @@ def ulysses_attention(q, k, v, axis_name: str, causal: bool = False,
                               tiled=True)
 
     qh, kh, vh = to_heads(q), to_heads(k), to_heads(v)  # (B, S, H_pad/n, D)
-    if rep > 1:  # GQA: expand grouped K/V locally after the collective
+    if rep > 1 and local != "flash":
+        # dense local path: expand grouped K/V after the collective; the
+        # flash kernel instead resolves GQA in-kernel via its BlockSpec
+        # index map, so the expanded K/V never materialize in HBM (r5)
         kh = jnp.repeat(kh, rep, axis=2)
         vh = jnp.repeat(vh, rep, axis=2)
     if local == "flash":
@@ -198,6 +201,10 @@ def ulysses_attention(q, k, v, axis_name: str, causal: bool = False,
                                   interpret=interpret)
             out = to_seq(out.astype(q.dtype))
             return out[:, :, :h] if pad_h else out
+    if kh.shape[2] != qh.shape[2]:
+        # reached via the flash sub-tile fallback with grouped K/V intact
+        kh = jnp.repeat(kh, qh.shape[2] // kh.shape[2], axis=2)
+        vh = jnp.repeat(vh, qh.shape[2] // vh.shape[2], axis=2)
     scale = 1.0 / math.sqrt(d)
     s = jnp.einsum("bqhd,bkhd->bqhk", qh.astype(jnp.float32),
                    kh.astype(jnp.float32)) * scale

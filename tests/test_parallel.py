@@ -236,3 +236,54 @@ def test_ulysses_flash_local_matches_dense(mesh, causal):
                                      interpret=True)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=2e-5, atol=2e-5)
+
+
+def test_flash_native_gqa_matches_expanded(mesh):
+    """The flash kernel resolves GQA in-kernel (grouped K/V never expand in
+    HBM): grouped inputs must match the pre-expanded computation exactly."""
+    from synapseml_tpu.parallel.flash import flash_attention
+
+    rng = np.random.default_rng(17)
+    B, S, H, Hkv, D = 2, 256, 8, 2, 16
+    q = rng.normal(size=(B, S, H, D)).astype(np.float32)
+    k = rng.normal(size=(B, S, Hkv, D)).astype(np.float32)
+    v = rng.normal(size=(B, S, Hkv, D)).astype(np.float32)
+    grouped = np.asarray(flash_attention(q, k, v, causal=True, block_q=128,
+                                         block_k=128, interpret=True))
+    kx, vx = np.repeat(k, 4, axis=2), np.repeat(v, 4, axis=2)
+    expanded = np.asarray(flash_attention(q, kx, vx, causal=True, block_q=128,
+                                          block_k=128, interpret=True))
+    np.testing.assert_allclose(grouped, expanded, rtol=1e-6, atol=1e-6)
+    ref = _dense_reference(q, kx, vx, causal=True)
+    np.testing.assert_allclose(grouped, ref, rtol=2e-3, atol=2e-3)
+
+
+def test_flash_auto_blocks():
+    """With no explicit blocks the kernel auto-picks divisors from the r5
+    sweep table; non-power-of-2-friendly lengths clamp to divisors."""
+    from synapseml_tpu.parallel.flash import _pick_blocks, flash_attention
+
+    assert _pick_blocks(8, 32768, 32768) == (2048, 1024)
+    assert _pick_blocks(64, 8192, 8192) == (1024, 1024)
+    assert _pick_blocks(8, 8192, 8192) == (1024, 1024)
+    # 3*512: largest pow2 divisor <= target
+    assert _pick_blocks(8, 1536, 1536) == (512, 512)
+    rng = np.random.default_rng(18)
+    q = rng.normal(size=(1, 1536, 4, 16)).astype(np.float32)
+    out = np.asarray(flash_attention(q, q, q, causal=True, interpret=True))
+    ref = _dense_reference(q, q, q, causal=True)
+    np.testing.assert_allclose(out, ref, rtol=2e-3, atol=2e-3)
+
+
+def test_ulysses_flash_gqa_grouped_in_kernel(mesh):
+    """Ulysses + local flash passes GROUPED K/V straight to the kernel."""
+    rng = np.random.default_rng(19)
+    q = rng.normal(size=(2, 128, 8, 16)).astype(np.float32)
+    k = rng.normal(size=(2, 128, 2, 16)).astype(np.float32)
+    v = rng.normal(size=(2, 128, 2, 16)).astype(np.float32)
+    out = np.asarray(sequence_sharded_attention(
+        q, k, v, mesh, strategy="ulysses", local="flash", causal=True,
+        interpret=True, block_q=128, block_k=128))
+    kx, vx = np.repeat(k, 4, axis=2), np.repeat(v, 4, axis=2)
+    ref = _dense_reference(q, kx, vx, causal=True)
+    np.testing.assert_allclose(out, ref, rtol=2e-3, atol=2e-3)
