@@ -308,12 +308,34 @@ def _cell_sum_fn(panel):
     hessian 1e-3 must not inherit an absolute error from a 3e6 prefix).
     Returns ``cell_sums(ends, starts) -> (cells, c)``.
     """
+    import jax
     import jax.numpy as jnp
 
     nnz_pad, c = panel.shape
     nc = nnz_pad // _CHUNK
     pc = panel.reshape(nc, _CHUNK, c)
-    intra = jnp.cumsum(pc, axis=1)                      # (nc, CH, c)
+    if jax.default_backend() == "tpu":
+        # intra-chunk inclusive prefix via two triangular MXU matmuls
+        # instead of jnp.cumsum: XLA's cumsum lowers to an O(len^2) VPU
+        # reduce-window, and at 12.5M-entry scale the chunked cumsums were
+        # ~13 ms of every sparse split step (r5 trace). 128-sub-block
+        # decomposition: prefix inside each 128-row sub-block + exclusive
+        # prefix of sub-block totals. CPU keeps the sequential cumsum: it
+        # is already fast there and its summation order is what the
+        # mesh-vs-single parity tests pin on tie-heavy data.
+        sb_n = _CHUNK // 128
+        x = pc.reshape(nc, sb_n, 128, c)
+        tri = jnp.tril(jnp.ones((128, 128), jnp.float32))
+        hi = jax.lax.Precision.HIGHEST  # operands are accumulated sums
+        within = jnp.einsum("ij,nkjc->nkic", tri, x, precision=hi,
+                            preferred_element_type=jnp.float32)
+        subtot = x.sum(axis=2)                           # (nc, sb_n, c)
+        tri_x = jnp.tril(jnp.ones((sb_n, sb_n), jnp.float32), k=-1)
+        suboff = jnp.einsum("ij,njc->nic", tri_x, subtot, precision=hi,
+                            preferred_element_type=jnp.float32)
+        intra = (within + suboff[:, :, None, :]).reshape(nc, _CHUNK, c)
+    else:
+        intra = jnp.cumsum(pc, axis=1)                  # (nc, CH, c)
     tot = intra[:, -1]                                  # (nc, c)
     mean = tot.mean(axis=0)                             # (c,)
     offs_c = jnp.concatenate(

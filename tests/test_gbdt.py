@@ -1002,3 +1002,55 @@ def test_distributed_matches_single_device_nondivisible(eight_device_mesh):
     np.testing.assert_array_equal(bd.feature, bs.feature)
     np.testing.assert_allclose(bd.predict(x), bs.predict(x),
                                rtol=1e-5, atol=1e-6)
+
+
+def test_train_param_aliases_and_unknown_warning():
+    """LightGBM alias names resolve to canonical params; a typo'd key warns
+    instead of silently training a default model (reference Config::Set)."""
+    rng = np.random.default_rng(41)
+    x = rng.normal(size=(400, 6))
+    y = (x[:, 0] > 0).astype(np.float64)
+    b_alias = train({"objective": "binary", "n_estimators": 7,
+                     "eta": 0.2, "max_leaf_nodes": 7,
+                     "min_child_samples": 5, "random_state": 4}, x, y)
+    b_canon = train({"objective": "binary", "num_iterations": 7,
+                     "learning_rate": 0.2, "num_leaves": 7,
+                     "min_data_in_leaf": 5, "seed": 4}, x, y)
+    assert b_alias.num_trees == 7
+    np.testing.assert_allclose(b_alias.predict(x), b_canon.predict(x),
+                               rtol=1e-6)
+    # explicit canonical key wins over its alias
+    b_both = train({"objective": "binary", "num_iterations": 3,
+                    "n_estimators": 9}, x, y)
+    assert b_both.num_trees == 3
+    # typo'd key warns (and is ignored)
+    with pytest.warns(UserWarning, match="nmu_iterations"):
+        train({"objective": "binary", "nmu_iterations": 5,
+               "num_iterations": 2}, x, y)
+
+
+def test_train_param_alias_edge_cases():
+    rng = np.random.default_rng(42)
+    x = rng.normal(size=(300, 5))
+    y = (x[:, 0] > 0).astype(np.float64)
+    # two conflicting aliases of one canonical key warn
+    with pytest.warns(UserWarning, match="multiple aliases"):
+        train({"objective": "binary", "n_estimators": 4,
+               "num_boost_round": 2}, x, y)
+    # inert LightGBM keys (threading/device) are accepted silently
+    import warnings as _w
+
+    with _w.catch_warnings():
+        _w.simplefilter("error")
+        b = train({"objective": "binary", "num_iterations": 3,
+                   "num_threads": 8, "device_type": "gpu",
+                   "verbosity": -1}, x, y)
+    assert b.num_trees == 3
+    # alias-passed binning params still trigger the dataset-owns-binning
+    # warning (canonicalization happens before the conflict checks)
+    from synapseml_tpu.gbdt import GBDTDataset
+
+    ds = GBDTDataset(x, label=y, max_bin=63)
+    with pytest.warns(UserWarning, match="max_bin=31 ignored"):
+        train({"objective": "binary", "num_iterations": 2,
+               "max_bins": 31}, ds)
