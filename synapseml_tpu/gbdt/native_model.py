@@ -35,9 +35,17 @@ as a per-split SET over the feature's bin ids — ``{bins <= threshold} ∪
 split back as ``threshold`` + the ``default_left`` decision bit. Every
 predict path (host, device, TreeSHAP) already dispatches per-split on
 ``bin < 0``, so real-world LightGBM models trained on data with missing
-values load and predict bit-for-bit. Only ``zero_as_missing`` models
-(missing_type=Zero) still raise: the zero category is a magnitude test
-(|v| <= 1e-35), not expressible as a bin set over the model's thresholds.
+values load and predict bit-for-bit.
+
+``zero_as_missing`` models (missing_type=Zero) import exactly too (r5):
+features carrying such splits get a dedicated ZERO-BAND bin — synthetic
+edges at ``(nextafter(-1e-35), +1e-35]`` reproduce LightGBM's
+``|v| <= kZeroThreshold`` test in bin space — and the band (plus NaN,
+which the native predictor converts to 0.0 first) routes by the split's
+``default_left`` bit via the same set encoding. One caveat: RE-exporting a
+zero_as_missing import writes the NaN-missing ``default_left`` form, so
+the re-exported text predicts zeros by threshold under stock LightGBM;
+this engine's own predictions stay exact.
 """
 
 from __future__ import annotations
@@ -118,10 +126,13 @@ def _replay_to_pointer(parent, feature, threshold, gain, leaf_value,
     for s in steps:
         if bins is not None and int(bins[s]) < 0 and \
                 np.isfinite(threshold[s]):
-            # numeric set-split with the missing bin IN the set (an imported
-            # default_left split): write back as threshold + default_left bit
+            # numeric set-split (an imported missing-direction split):
+            # write back as threshold + the direction bit read from the
+            # set's MISSING-bin membership (the last bin), so default-right
+            # zero_as_missing imports don't flip their NaN routing
             thresholds.append(float(threshold[s]))
-            decision_types.append(_DT_MISSING_NAN | _DT_DEFAULT_LEFT)
+            left_bit = _DT_DEFAULT_LEFT if cat_set[s][-1] else 0
+            decision_types.append(_DT_MISSING_NAN | left_bit)
             continue
         if bins is not None and int(bins[s]) < 0:  # categorical split
             f = int(feature[s])
@@ -335,12 +346,6 @@ def booster_from_native(model_str: str):
         flts = lambda key: ([float(x) for x in kv.get(key, "").split()]
                             or None)
         dts = ints("decision_type")
-        if any((dt & _DT_MISSING_MASK) == _DT_MISSING_ZERO for dt in dts):
-            raise NotImplementedError(
-                "zero_as_missing (missing_type=Zero) models are not "
-                "supported: the zero test (|v| <= 1e-35) is not expressible "
-                "over the model's own thresholds; retrain with the default "
-                "missing_type=NaN")
         trees.append(dict(
             num_leaves=nl, split_feature=ints("split_feature"),
             threshold=flts("threshold") or [],
@@ -381,6 +386,7 @@ def booster_from_native(model_str: str):
     # not-in-bitset behavior)
     thr_by_feat: List[set] = [set() for _ in range(d)]
     cat_vals_by_feat: Dict[int, set] = {}
+    zero_feats: set = set()  # features with any missing_type=Zero split
     for tr in trees:
         for node, (f, t) in enumerate(zip(tr["split_feature"],
                                           tr["threshold"])):
@@ -389,6 +395,18 @@ def booster_from_native(model_str: str):
                     _bitset_values(tr, int(t)))
             else:
                 thr_by_feat[f].add(float(t))
+                dts = tr["decision_type"]
+                if node < len(dts) and \
+                        (dts[node] & _DT_MISSING_MASK) == _DT_MISSING_ZERO:
+                    zero_feats.add(f)
+    # zero_as_missing features get a dedicated ZERO-BAND bin: edges at
+    # (nextafter(-kZeroThreshold, -inf), +kZeroThreshold] reproduce
+    # LightGBM's |v| <= 1e-35 zero test exactly in bin space, so the
+    # band can be routed per split like the missing bin
+    _KZERO = 1e-35
+    for f in zero_feats:
+        thr_by_feat[f].add(float(np.nextafter(-_KZERO, -np.inf)))
+        thr_by_feat[f].add(_KZERO)
     max_cat = max((len(v) for v in cat_vals_by_feat.values()), default=0)
     mapper = BinMapper(
         max_bin=max(2, max((len(s) + 1) for s in thr_by_feat), max_cat),
@@ -416,20 +434,25 @@ def booster_from_native(model_str: str):
     leaf_hess = np.zeros((T, C, max_leaves), np.float32)
     B = mapper.n_bins
 
-    def _missing_goes_left(dt: int, thr: float) -> bool:
+    def _needs_set_split(dt: int, thr: float) -> bool:
+        """True when the split routes some bin against its threshold order
+        and therefore needs the bin-set encoding."""
         if dt & _DT_CATEGORICAL:
             return False  # LightGBM cat splits route NaN/unseen right
-        if (dt & _DT_MISSING_MASK) == _DT_MISSING_NAN:
+        mt = dt & _DT_MISSING_MASK
+        if mt == _DT_MISSING_ZERO:
+            return True  # the zero band routes by default_left, not by t
+        if mt == _DT_MISSING_NAN:
             return bool(dt & _DT_DEFAULT_LEFT)
         # missing_type=None: NaN converts to 0.0 before the compare
         return 0.0 <= thr
 
-    any_missing_left = any(
-        _missing_goes_left(dt, thr)
+    any_set_split = any(
+        _needs_set_split(dt, thr)
         for tr in trees
         for dt, thr in zip(tr["decision_type"], tr["threshold"]))
     cat_set = (np.zeros(shape1 + (B,), np.int8)
-               if cat_vals_by_feat or any_missing_left else None)
+               if cat_vals_by_feat or any_set_split else None)
     for idx, tr in enumerate(trees):
         t, c = divmod(idx, C)
         (parent[t, c], feature[t, c], threshold[t, c], gain[t, c],
@@ -458,14 +481,31 @@ def booster_from_native(model_str: str):
             # bin = position of the threshold in the feature's edges
             b = int(np.searchsorted(mapper.upper_edges[f],
                                     threshold[t, c, s]))
-            if _missing_goes_left(dt, threshold[t, c, s]):
-                # 'v <= t OR missing' as a set over the feature's bins:
-                # {0..b} ∪ {missing bin}; threshold kept for re-export
-                cat_set[t, c, s, : b + 1] = 1
-                cat_set[t, c, s, mapper.missing_bin] = 1
-                bin_[t, c, s] = -1
-            else:
+            if not _needs_set_split(dt, threshold[t, c, s]):
                 bin_[t, c, s] = b
+                continue
+            # set encoding over the feature's bins; threshold kept for
+            # re-export (NaN-missing default_left form; a re-exported
+            # zero_as_missing model keeps OUR predictions exact, but its
+            # zeros route by threshold under stock LightGBM)
+            cat_set[t, c, s, : b + 1] = 1
+            if (dt & _DT_MISSING_MASK) == _DT_MISSING_ZERO:
+                # EVERY bin inside [-kZero, +kZero] (and NaN, which the
+                # native predictor converts to 0.0) routes by default_left
+                # regardless of the threshold order. A model threshold can
+                # fall inside the band (LightGBM emits -kZero as a bin
+                # upper bound under zero_as_missing), fragmenting it into
+                # several bins — mark the whole [first, last] band range.
+                go_left = bool(dt & _DT_DEFAULT_LEFT)
+                edges = mapper.upper_edges[f]
+                zb_lo = int(np.searchsorted(edges, -_KZERO))
+                zb_hi = int(np.searchsorted(edges, _KZERO))
+                cat_set[t, c, s, zb_lo: zb_hi + 1] = 1 if go_left else 0
+                cat_set[t, c, s, mapper.missing_bin] = 1 if go_left else 0
+            else:
+                # NaN-missing (default_left) or None (NaN -> 0.0 <= t)
+                cat_set[t, c, s, mapper.missing_bin] = 1
+            bin_[t, c, s] = -1
     return GBDTBooster(
         mapper=mapper, objective=objective, num_class=num_class,
         base_score=np.zeros(num_class),

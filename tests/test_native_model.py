@@ -99,15 +99,65 @@ def test_import_handwritten_lightgbm_text():
 
 
 def test_native_model_unsupported_cases(data):
-    # zero_as_missing (missing_type=Zero, bits 2-3 == 01) is the one split
-    # semantic not expressible over the model's own thresholds
-    bad = "tree\nnum_class=1\nmax_feature_idx=0\n\nTree=0\nnum_leaves=2\n" \
-          "num_cat=0\nsplit_feature=0\nthreshold=0\ndecision_type=4\n" \
-          "left_child=-1\nright_child=-2\nleaf_value=0 1\n\nend of trees\n"
-    with pytest.raises(NotImplementedError, match="zero_as_missing"):
-        GBDTBooster.from_native_model(bad)
     with pytest.raises(ValueError, match="text model"):
         GBDTBooster.from_native_model("{json}")
+
+
+def test_import_zero_as_missing():
+    """missing_type=Zero (zero_as_missing=true models): |v| <= 1e-35 is the
+    missing test, routed by default_left; NaN converts to 0.0 first. The
+    import encodes the zero band as a dedicated bin (VERDICT r4: this was
+    the last native-interop refusal)."""
+    def model(dt, thr):
+        return "\n".join([
+            "tree", "num_class=1", "num_tree_per_iteration=1",
+            "max_feature_idx=0", "objective=regression", "",
+            "Tree=0", "num_leaves=2", "num_cat=0",
+            "split_feature=0", "split_gain=1",
+            f"threshold={thr}", f"decision_type={dt}",
+            "left_child=-1", "right_child=-2",
+            "leaf_value=-1.0 1.0", "leaf_weight=3 3", "",
+            "end of trees", "",
+        ])
+
+    x = np.array([[-2.0], [0.0], [5e-36], [-5e-36], [1e-35], [2e-35],
+                  [2.0], [np.nan]])
+    # dt=6: Zero missing + default_left, t=1.0 -> zeros/NaN LEFT; the
+    # threshold would also send them left, so this pins band membership
+    # with t=-1.0 where the threshold would send them RIGHT:
+    b = GBDTBooster.from_native_model(model(6, -1.0))
+    #  -2 <= -1 left; zero-band (0, 5e-36, -5e-36, 1e-35) LEFT by default;
+    #  2e-35 > -1 right; 2 right; NaN -> 0 -> band -> LEFT
+    np.testing.assert_allclose(
+        b.raw_predict(x), [-1, -1, -1, -1, -1, 1, 1, -1], atol=1e-7)
+    # dt=4: Zero missing + default RIGHT, t=1.0 -> the threshold would send
+    # zeros LEFT, but the zero band routes RIGHT
+    b = GBDTBooster.from_native_model(model(4, 1.0))
+    np.testing.assert_allclose(
+        b.raw_predict(x), [-1, 1, 1, 1, 1, -1, 1, 1], atol=1e-7)
+    # values just OUTSIDE the band follow the threshold: 2e-35 <= 1.0 left
+    # (checked above); device path agrees with host on the band encoding
+    np.testing.assert_allclose(
+        b.raw_predict(x, backend="device"),
+        b.raw_predict(x, backend="host"), atol=1e-6)
+    # re-export keeps the MISSING DIRECTION: a default-right import must not
+    # come back routing NaN left (the zero band itself degrades to
+    # by-threshold in the re-exported text — documented caveat — so only
+    # NaN routing is pinned here)
+    b2 = GBDTBooster.from_native_model(b.save_native_model())
+    np.testing.assert_allclose(b2.raw_predict(np.array([[np.nan], [2.0]])),
+                               b.raw_predict(np.array([[np.nan], [2.0]])),
+                               atol=1e-7)
+
+    # a model threshold ON the band boundary (-1e-35 is a real LightGBM bin
+    # bound under zero_as_missing) fragments the band into several bins;
+    # every fragment must still route by default_left
+    bf = GBDTBooster.from_native_model(model(4, -1e-35))
+    xf = np.array([[-1e-35], [-5e-36], [0.0], [1e-35], [-2e-35], [2e-35]])
+    #  first four are |v| <= 1e-35 -> missing -> RIGHT (default right);
+    #  -2e-35 <= t left; 2e-35 > t right
+    np.testing.assert_allclose(bf.raw_predict(xf), [1, 1, 1, 1, -1, 1],
+                               atol=1e-7)
 
 
 def test_import_default_left():
