@@ -329,3 +329,96 @@ def test_default_left_saabas_contrib():
     contrib = b.predict_contrib(x, approximate=True)
     np.testing.assert_allclose(contrib.sum(axis=1), b.raw_predict(x),
                                atol=1e-6)
+
+
+def test_import_randomized_differential():
+    """Property test: random pointer trees over every missing_type x
+    default_left combination, serialized as LightGBM text, imported, and
+    checked against an independent interpreter of LightGBM's documented
+    decision semantics (NumericalDecision: NaN->0.0 unless missing_type is
+    NaN; missing routes default_left; zero band |v| <= 1e-35 for Zero)."""
+    rng = np.random.default_rng(123)
+    KZERO = 1e-35
+
+    def ref_predict(tree, row):
+        node = 0
+        while True:
+            f = tree["split_feature"][node]
+            t = tree["threshold"][node]
+            dt = tree["decision_type"][node]
+            mt = dt & (3 << 2)
+            v = row[f]
+            if mt != (2 << 2) and np.isnan(v):  # not NaN-missing: NaN -> 0
+                v = 0.0
+            if mt == (2 << 2) and np.isnan(v):
+                go_left = bool(dt & 2)
+            elif mt == (1 << 2) and abs(v) <= KZERO:
+                go_left = bool(dt & 2)
+            else:
+                go_left = v <= t
+            child = tree["left_child"][node] if go_left \
+                else tree["right_child"][node]
+            if child < 0:
+                return tree["leaf_value"][~child]
+            node = child
+
+    for trial in range(20):
+        d = int(rng.integers(2, 5))
+        n_splits = int(rng.integers(1, 6))
+        # random binary pointer tree over ARBITRARY topology: each new
+        # split attaches to a uniformly random open (node, side) slot, so
+        # combs, balanced trees, and everything between all occur — this is
+        # what exercises the importer's parent-first slot bookkeeping
+        split_feature, threshold, decision_type = [], [], []
+        left_child, right_child = [], []
+        for s in range(n_splits):
+            split_feature.append(int(rng.integers(0, d)))
+            threshold.append(float(np.round(rng.normal(), 3)
+                                   if rng.random() < 0.8
+                                   else rng.choice([-KZERO, KZERO, 0.0])))
+            mt = int(rng.choice([0, 1 << 2, 2 << 2]))
+            dl = int(rng.choice([0, 2]))
+            decision_type.append(mt | dl)
+            left_child.append(-1)
+            right_child.append(-1)
+        open_slots = [(0, "l"), (0, "r")]
+        for s in range(1, n_splits):
+            node, side = open_slots.pop(int(rng.integers(len(open_slots))))
+            (left_child if side == "l" else right_child)[node] = s
+            open_slots += [(s, "l"), (s, "r")]
+        nl = 0
+        for node, side in open_slots:  # remaining slots become leaves
+            (left_child if side == "l" else right_child)[node] = ~nl
+            nl += 1
+        leaf_value = [float(np.round(rng.normal(), 3)) for _ in range(nl)]
+        tree = dict(split_feature=split_feature, threshold=threshold,
+                    decision_type=decision_type, left_child=left_child,
+                    right_child=right_child, leaf_value=leaf_value)
+        text = "\n".join([
+            "tree", "num_class=1", "num_tree_per_iteration=1",
+            f"max_feature_idx={d - 1}", "objective=regression", "",
+            "Tree=0", f"num_leaves={nl}", "num_cat=0",
+            "split_feature=" + " ".join(map(str, split_feature)),
+            "split_gain=" + " ".join(["1"] * n_splits),
+            "threshold=" + " ".join(repr(t) for t in threshold),
+            "decision_type=" + " ".join(map(str, decision_type)),
+            "left_child=" + " ".join(map(str, left_child)),
+            "right_child=" + " ".join(map(str, right_child)),
+            "leaf_value=" + " ".join(repr(v) for v in leaf_value[:nl]),
+            "leaf_weight=" + " ".join(["1"] * nl), "",
+            "end of trees", "",
+        ])
+        b = GBDTBooster.from_native_model(text)
+        # probe values: random, zeros, band edges, band interior, NaN
+        probes = np.concatenate([
+            rng.normal(size=(30, d)),
+            np.zeros((2, d)),
+            np.full((1, d), KZERO), np.full((1, d), -KZERO),
+            np.full((1, d), 5e-36), np.full((1, d), 2e-35),
+            np.full((1, d), np.nan),
+        ])
+        got = b.raw_predict(probes)
+        want = np.array([ref_predict(tree, row) for row in probes])
+        np.testing.assert_allclose(
+            got, want, atol=1e-6,
+            err_msg=f"trial {trial}: tree={tree}")
