@@ -442,6 +442,58 @@ def bench_serving(platform):
     }
 
 
+def bench_span_overhead(platform):
+    """Per-transform overhead of the observability stage spans.
+
+    The span contract (docs/observability.md): < 5% per transform. Two
+    measurements: (1) the BARE span cost — a tight loop over the span
+    machinery alone, the exact per-call cost spans add to a transform; (2)
+    the per-transform baseline of a cheap real stage (a 100k-row
+    standardize: mean/std + normalize, the shape of the cheapest stages in
+    stages/basic.py) with spans disabled. ``span_overhead_pct`` is
+    span_cost / baseline. (An on-vs-off delta of the full transform was
+    tried first and rejected: the extra small allocations shift large-array
+    placement, and the resulting ±20% swings in the memory-bound workload
+    dwarf the ~4µs effect being measured.)"""
+    from synapseml_tpu import observability
+    from synapseml_tpu.core import Table, UnaryTransformer
+    from synapseml_tpu.observability.spans import stage_span
+
+    class _SpanBenchScale(UnaryTransformer):  # _ prefix: stays out of the registry
+        def _transform_column(self, col, table):
+            return (col - col.mean()) / (col.std() + 1e-12)
+
+    table = Table({"input": np.random.default_rng(5).normal(size=100_000)})
+    stage = _SpanBenchScale()
+    stage.transform(table)  # warm (cold-span + any lazy allocation)
+
+    n_span = 100_000
+
+    def span_loop():
+        for _ in range(n_span):
+            with stage_span(stage, "transform") as sp:
+                sp.set_rows(100_000)
+
+    span_loop()  # untimed warm pass (branch caches / CPU clock ramp)
+    span_us = _best_of(3, span_loop) / n_span * 1e6
+
+    n = 300
+
+    def run():
+        for _ in range(n):
+            stage.transform(table)
+
+    enabled_before = observability.is_enabled()
+    try:
+        observability.disable()
+        base_us = _best_of(5, run) / n * 1e6
+    finally:
+        (observability.enable if enabled_before else observability.disable)()
+    return {"per_transform_base_us": round(base_us, 2),
+            "span_cost_us": round(span_us, 3),
+            "span_overhead_pct": round(span_us / base_us * 100.0, 2)}
+
+
 def _balanced_json_at(s: str, start: int):
     """Parse the balanced ``{...}`` object starting at ``s[start]`` (which
     must be ``{``); None if unterminated or invalid."""
@@ -612,6 +664,7 @@ def main() -> None:
         ("vit_to_gbdt_pipeline", lambda: bench_vit_gbdt(platform, peak)),
         ("flash_attention_32k", lambda: bench_flash_attention(platform, peak)),
         ("serving_latency", lambda: bench_serving(platform)),
+        ("observability_span_overhead", lambda: bench_span_overhead(platform)),
     ]:
         try:
             extra[key] = fn()

@@ -21,6 +21,7 @@ from __future__ import annotations
 import inspect
 from typing import Any, Dict, List, Optional, Sequence
 
+from ..observability.spans import stage_span
 from .params import ComplexParam, Param, Params
 from .table import Table
 from .telemetry import log_stage_call
@@ -105,7 +106,10 @@ class Transformer(PipelineStage):
 
     def transform(self, table: Table) -> Table:
         log_stage_call(self, "transform")
-        return self._transform(table)
+        with stage_span(self, "transform") as sp:
+            out = self._transform(table)
+            sp.set_rows(len(out) if isinstance(out, Table) else None)
+        return out
 
     def _transform(self, table: Table) -> Table:  # pragma: no cover - abstract
         raise NotImplementedError
@@ -121,7 +125,9 @@ class Estimator(PipelineStage):
 
     def fit(self, table: Table) -> "Model":
         log_stage_call(self, "fit")
-        model = self._fit(table)
+        with stage_span(self, "fit") as sp:
+            sp.set_rows(len(table) if isinstance(table, Table) else None)
+            model = self._fit(table)
         model.parent = self
         return model
 

@@ -16,7 +16,8 @@ import threading
 import time
 from typing import Any, Dict, List
 
-__all__ = ["log_stage_call", "recent_events", "clear_events", "get_logger",
+__all__ = ["log_stage_call", "recent_events", "clear_events", "drain_events",
+           "get_logger", "set_event_capacity", "event_capacity",
            "profile_trace", "BUILD_VERSION"]
 
 BUILD_VERSION = "0.1.0"
@@ -27,11 +28,36 @@ def get_logger(name: str) -> logging.Logger:
     return logging.getLogger(f"synapseml_tpu.{name}")
 
 _logger = logging.getLogger("synapseml_tpu.telemetry")
-_events: "collections.deque[Dict[str, Any]]" = collections.deque(maxlen=4096)
+_DEFAULT_CAPACITY = 4096
+_events: "collections.deque[Dict[str, Any]]" = \
+    collections.deque(maxlen=_DEFAULT_CAPACITY)
 _lock = threading.Lock()
 
 
+def set_event_capacity(n: int) -> None:
+    """Resize the event ring buffer (keeps the newest events). Long-running
+    serving hosts tune this instead of living with the old hardcoded 4096."""
+    if n < 1:
+        raise ValueError(f"event capacity must be >= 1, got {n}")
+    global _events
+    with _lock:
+        _events = collections.deque(_events, maxlen=n)
+
+
+def event_capacity() -> int:
+    with _lock:
+        return _events.maxlen
+
+
 def log_stage_call(stage, method: str, **extra) -> None:
+    """Record one structured stage-call event.
+
+    ``ts`` is wall-clock (for cross-host correlation); any DURATION passed
+    in ``extra`` must be measured with the monotonic clock
+    (``core.clock.StopWatch``) — wall-clock deltas jump under NTP slew.
+    Aggregate timings live in ``synapseml_tpu.observability`` spans; this
+    event stream is the per-call view.
+    """
     evt = {
         "uid": getattr(stage, "uid", "?"),
         "className": type(stage).__name__,
@@ -66,13 +92,21 @@ def profile_trace(trace_dir: str):
     def _ctx():
         import jax
 
+        from .clock import StopWatch
+
         evt = {"method": "profile_trace", "trace_dir": trace_dir,
                "className": "profiler", "uid": "profiler",
                "buildVersion": BUILD_VERSION, "ts": time.time()}
         with _lock:
             _events.append(evt)
-        with jax.profiler.trace(trace_dir):
-            yield trace_dir
+        # duration via the MONOTONIC clock (wall-clock deltas jump under NTP
+        # slew); ts above stays wall-clock for cross-host correlation
+        sw = StopWatch()
+        try:
+            with sw.measure(), jax.profiler.trace(trace_dir):
+                yield trace_dir
+        finally:
+            evt["duration_s"] = sw.elapsed_s
 
     return _ctx()
 
@@ -80,6 +114,16 @@ def profile_trace(trace_dir: str):
 def recent_events() -> List[Dict[str, Any]]:
     with _lock:
         return list(_events)
+
+
+def drain_events() -> List[Dict[str, Any]]:
+    """Atomic snapshot-and-clear: no event is ever seen twice or dropped
+    between a ``recent_events()`` and a ``clear_events()`` racing with a
+    concurrent ``log_stage_call``."""
+    with _lock:
+        out = list(_events)
+        _events.clear()
+    return out
 
 
 def clear_events() -> None:

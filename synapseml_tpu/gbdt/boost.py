@@ -22,6 +22,8 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from ..core.clock import StopWatch
+from ..observability import get_registry
 from .binning import BinMapper
 from .grow import GrownTree, TreeConfig, grow_tree
 
@@ -1310,8 +1312,9 @@ def _build_step(grad_fn=None, fobj=None, *, cfg, C, lr, boosting, d, cat_idx,
         return trees, raw, eraws, metrics, key
 
     if mesh is not None:
-        from jax import shard_map
         from jax.sharding import PartitionSpec as Pspec
+
+        from ..runtime.topology import shard_map_compat
 
         data_spec = Pspec(axis)
         rep = Pspec()
@@ -1331,19 +1334,20 @@ def _build_step(grad_fn=None, fobj=None, *, cfg, C, lr, boosting, d, cat_idx,
         in_specs = (binned_spec, data_spec, data_spec, data_spec, rep, rep)
         out_specs = (rep, data_spec)
         if scan_iters is not None:
-            return jax.jit(shard_map(scan_loop, mesh=mesh, in_specs=in_specs,
-                                     out_specs=out_specs, check_vma=False))
+            return jax.jit(shard_map_compat(scan_loop, mesh=mesh,
+                                            in_specs=in_specs,
+                                            out_specs=out_specs, check=False))
 
         def sharded_iter(binned, yv, wv, raw, key, fkey):
             key = jax.random.fold_in(key, jax.lax.axis_index(axis))
             trees, new_raw = one_iter(binned, yv, wv, raw, key, fkey)
             return trees, new_raw
 
-        return jax.jit(shard_map(
+        return jax.jit(shard_map_compat(
             sharded_iter, mesh=mesh,
             in_specs=in_specs,
             out_specs=out_specs,
-            check_vma=False,
+            check=False,
         ))
     if scan_iters is not None and n_eval > 0:
         return jax.jit(scan_loop_eval)
@@ -1398,6 +1402,22 @@ def train(params: Dict[str, Any], x: np.ndarray, y: Optional[np.ndarray] = None,
     params_c = _canonicalize_params(params)
     p.update(params_c)
     obj_name = p["objective"]
+    # per-boosting-iteration observability (docs/observability.md): the
+    # host-synced loop (dart/eval/callbacks) observes every iteration; the
+    # fused lax.scan paths observe whole chunks (one dispatch IS the unit of
+    # work there) and count the iterations they contain
+    _obs = get_registry()
+    _m_iters = _obs.counter(
+        "smt_gbdt_iterations_total", "boosting iterations trained",
+        ("objective",)).labels(obj_name)
+    _m_iter_s = _obs.histogram(
+        "smt_gbdt_iteration_seconds",
+        "wall time per boosting iteration (host-synced loop)",
+        ("objective",)).labels(obj_name)
+    _m_chunk_s = _obs.histogram(
+        "smt_gbdt_scan_seconds",
+        "wall time per fused lax.scan training chunk",
+        ("objective",)).labels(obj_name)
     C = int(p["num_class"]) if obj_name in ("multiclass", "softmax") else 1
     from .dataset import GBDTDataset
 
@@ -1965,12 +1985,17 @@ def train(params: Dict[str, Any], x: np.ndarray, y: Optional[np.ndarray] = None,
             loop_fn = (loop_full if k_iters == chunk else
                        make_step(scan_iters=k_iters, eval_metric=metric_name,
                                  n_eval=len(eval_dev)))
+            _sw = StopWatch()
+            _sw.start()
             trees_stacked, raw_d, eraws, mseries, key = loop_fn(
                 binned_d, y_d, w_d, raw_d, key, bkey, jnp.int32(it0),
                 base_d, tuple(eval_dev))
             eval_dev = [(eb, ey, ew, eraw)
                         for (eb, ey, ew, _), eraw in zip(eval_dev, eraws)]
             stacked_np = jax.device_get(trees_stacked)
+            _sw.stop()  # device_get is the completion barrier
+            _m_chunk_s.observe(_sw.elapsed_s)
+            _m_iters.inc(k_iters)
             trees_host += [jax.tree.map(lambda a, i=i: a[i], stacked_np)
                            for i in range(k_iters)]
             mnp = np.asarray(mseries)  # (k_iters, n_eval)
@@ -1992,8 +2017,13 @@ def train(params: Dict[str, Any], x: np.ndarray, y: Optional[np.ndarray] = None,
 
     if not sync_each_iter and num_iter > 0:
         loop_fn = make_step(scan_iters=num_iter)
+        _sw = StopWatch()
+        _sw.start()
         trees_stacked, raw_d = loop_fn(binned_d, y_d, w_d, raw_d, key, bkey)
         stacked_np = jax.device_get(trees_stacked)  # each field (T, C, ...)
+        _sw.stop()  # device_get is the completion barrier
+        _m_chunk_s.observe(_sw.elapsed_s)
+        _m_iters.inc(num_iter)
         trees_host = [jax.tree.map(lambda a, i=i: a[i], stacked_np)
                       for i in range(num_iter)]
         tree_scales = [1.0] * num_iter
@@ -2029,11 +2059,16 @@ def train(params: Dict[str, Any], x: np.ndarray, y: Optional[np.ndarray] = None,
                             trees_host[t], c)
                 raw_d = _reput(raw_np, raw_d)
 
+        _sw = StopWatch()
+        _sw.start()
         trees, raw_d = step(binned_d, y_d, w_d, raw_d, k1, k2)
         # the no-sync case runs the scan fast-path above; this loop only
         # exists for dart/eval/callbacks, which all need host trees
         tree_np = jax.tree.map(np.asarray, trees)
         trees_host.append(tree_np)
+        _sw.stop()  # the np.asarray pull is the completion barrier
+        _m_iter_s.observe(_sw.elapsed_s)
+        _m_iters.inc()
 
         scale = 1.0
         if boosting == "dart" and dart_dropped:

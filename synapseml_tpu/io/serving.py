@@ -28,10 +28,13 @@ import numpy as np
 
 from ..core import Param, Table, Transformer
 from ..core.telemetry import get_logger
+from ..observability import CONTENT_TYPE as _PROM_CONTENT_TYPE
+from ..observability import get_registry, render_prometheus
 from ..runtime.shared import shared_singleton
 from .http_schema import HTTPRequestData, HTTPResponseData
 
 __all__ = ["ServingServer", "MicroBatchServingEngine", "serve",
+           "serve_metrics_exposition",
            "request_to_string", "string_to_response"]
 
 _logger = get_logger("io.serving")
@@ -68,6 +71,13 @@ class ServingServer:
 
         class Handler(BaseHTTPRequestHandler):
             def _handle(self, method: str):
+                if method == "GET" and \
+                        self.path.partition("?")[0] == "/metrics":
+                    # answered by the SERVER, not the pipeline: scrapes must
+                    # work even when the engine is wedged, and must never
+                    # occupy a micro-batch slot
+                    serve_metrics_exposition(self)
+                    return
                 length = int(self.headers.get("Content-Length") or 0)
                 body = self.rfile.read(length) if length else None
                 req = HTTPRequestData(
@@ -131,12 +141,37 @@ class ServingServer:
 
         self._httpd = Server((host, port), Handler)
         self.host, self.port = self._httpd.server_address[:2]
+        # registry metrics, labeled by this server's address so fleets of
+        # in-process servers share one registry without colliding; created
+        # BEFORE the accept thread starts so handlers never race them.
+        # Request/response COUNTERS sync from the existing plain ints at
+        # snapshot time (registry collector) — zero added locking on the
+        # request hot path, which is measurably tail-latency sensitive
+        # under the GIL; only the latency histogram observes per reply.
+        self.server_label = f"{self.host}:{self.port}"
+        reg = self._reg = get_registry()
+        self._m_requests = reg.counter(
+            "smt_serving_requests_total", "HTTP requests received",
+            ("server",)).labels(self.server_label)
+        self._m_responses = reg.counter(
+            "smt_serving_responses_total", "pipeline replies sent",
+            ("server",)).labels(self.server_label)
+        self._m_latency = reg.histogram(
+            "smt_serving_latency_seconds", "enqueue->reply latency",
+            ("server",)).labels(self.server_label)
+        reg.register_collector(self._collect_metrics)
         self._thread = threading.Thread(target=self._httpd.serve_forever,
                                         name=f"serving-{self.port}", daemon=True)
         self._thread.start()
 
     def _on_enqueue(self) -> None:
         """Hook for push-mode engines (continuous serving overrides)."""
+
+    def _collect_metrics(self) -> None:
+        """Snapshot-time sync of the plain-int request counters into the
+        registry (see the collector note in ``__init__``)."""
+        self._m_requests.sync_total(self.requests_received)
+        self._m_responses.sync_total(self.responses_sent)
 
     @property
     def address(self) -> str:
@@ -160,7 +195,11 @@ class ServingServer:
             return
         slot.response = response
         slot.event.set()
-        self._latencies.append(time.perf_counter() - slot.t_enqueue)
+        lat = time.perf_counter() - slot.t_enqueue
+        self._latencies.append(lat)
+        # same sample into the MERGEABLE histogram: fleet quantiles come
+        # from these buckets combined across workers (merge.py)
+        self._m_latency.observe(lat)
 
     def latency_quantile(self, q: float = 0.5) -> Optional[float]:
         """Enqueue->reply latency quantile in seconds over recent requests."""
@@ -179,6 +218,55 @@ class ServingServer:
             slot.event.set()
         self._httpd.shutdown()
         self._httpd.server_close()
+        # retire this server's series + collector: ephemeral ports mean a
+        # churning process would otherwise grow the registry without bound
+        self._reg.unregister_collector(self._collect_metrics)
+        for series in (self._m_requests, self._m_responses, self._m_latency):
+            series.remove()
+
+
+def engine_metrics(reg, server_label: str, engine: str):
+    """The per-engine metric series shared by the micro-batch and continuous
+    engines: (batches counter, batch-size histogram, pipeline-error counter),
+    labeled (server, engine). One definition so the two engines cannot fork
+    the family schema."""
+    batches = reg.counter(
+        "smt_serving_batches_total", "pipeline batches processed",
+        ("server", "engine")).labels(server_label, engine)
+    batch_size = reg.histogram(
+        "smt_serving_batch_size", "requests fused per pipeline batch",
+        ("server", "engine")).labels(server_label, engine)
+    errors = reg.counter(
+        "smt_serving_pipeline_errors_total", "batches answered 500",
+        ("server", "engine")).labels(server_label, engine)
+    return batches, batch_size, errors
+
+
+def serve_metrics_exposition(handler, snapshot: Optional[dict] = None) -> None:
+    """Answer a ``/metrics`` GET on ``handler`` (a BaseHTTPRequestHandler).
+
+    Default: Prometheus text format of ``snapshot`` (the process-default
+    registry when omitted). ``?format=json`` returns the raw registry
+    snapshot — the machine-readable side the routing front door scrapes and
+    merges (snapshots ride in ordinary worker replies; no side channel).
+    """
+    if snapshot is None:
+        snapshot = get_registry().snapshot()
+    query = handler.path.partition("?")[2]
+    if "format=json" in query.split("&"):
+        body = json.dumps(snapshot).encode()
+        ctype = "application/json"
+    else:
+        body = render_prometheus(snapshot).encode()
+        ctype = _PROM_CONTENT_TYPE
+    try:
+        handler.send_response(200)
+        handler.send_header("Content-Type", ctype)
+        handler.send_header("Content-Length", str(len(body)))
+        handler.end_headers()
+        handler.wfile.write(body)
+    except OSError:
+        pass  # scraper went away
 
 
 class MicroBatchServingEngine:
@@ -211,6 +299,13 @@ class MicroBatchServingEngine:
         # the previous batch transforms
         self._work = threading.Event()
         server._on_enqueue = self._work.set
+        self._m_reg = get_registry()
+        self._m_batches, self._m_batch_size, self._m_pipeline_errors = \
+            engine_metrics(self._m_reg, server.server_label, "microbatch")
+        self._m_reg.register_collector(self._collect_metrics)
+
+    def _collect_metrics(self) -> None:
+        self._m_batches.sync_total(self.batches_processed)
 
     def start(self) -> "MicroBatchServingEngine":
         self._thread.start()
@@ -237,15 +332,21 @@ class MicroBatchServingEngine:
                     self.server.respond(rid, HTTPResponseData(
                         500, "pipeline error", entity=str(e).encode()))
                 self._error = e
+                self._m_pipeline_errors.inc()
                 continue
             respond_batch(self.server, ids, out_ids, replies)
             self.batches_processed += 1
+            self._m_batch_size.observe(len(batch))
 
     def stop(self) -> None:
         self._stop.set()
         self._work.set()
         self._thread.join(timeout=5)
         self.server.close()
+        self._m_reg.unregister_collector(self._collect_metrics)
+        for series in (self._m_batches, self._m_batch_size,
+                       self._m_pipeline_errors):
+            series.remove()
         if self._error is not None:
             _logger.warning("serving engine saw pipeline errors; last: %s", self._error)
 

@@ -25,6 +25,7 @@ TPU-native design:
 
 from __future__ import annotations
 
+import json
 import threading
 import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -35,8 +36,10 @@ import numpy as np
 
 from ..core import Table, Transformer
 from ..core.telemetry import get_logger
+from ..observability import get_registry, histogram_quantile, merge_snapshots
 from .http_schema import HTTPResponseData
-from .serving import MicroBatchServingEngine, ServingServer, respond_batch
+from .serving import (MicroBatchServingEngine, ServingServer, engine_metrics,
+                      respond_batch, serve_metrics_exposition)
 
 __all__ = ["ContinuousServingEngine", "DistributedServingEngine",
            "ProcessServingFleet", "ServiceRegistry", "RoutingServer",
@@ -61,8 +64,15 @@ class ContinuousServingEngine:
         self.requests_processed = 0
         # push hook: request arrival wakes the dispatcher immediately
         server._on_enqueue = self._work.set
+        self._m_reg = get_registry()
+        self._m_batches, self._m_batch_size, self._m_pipeline_errors = \
+            engine_metrics(self._m_reg, server.server_label, "continuous")
+        self._m_reg.register_collector(self._collect_metrics)
         self._thread = threading.Thread(target=self._run,
                                         name="serving-continuous", daemon=True)
+
+    def _collect_metrics(self) -> None:
+        self._m_batches.sync_total(self.batches_processed)
 
     def start(self) -> "ContinuousServingEngine":
         self._thread.start()
@@ -94,10 +104,12 @@ class ContinuousServingEngine:
                 self.server.respond(rid, HTTPResponseData(
                     500, "pipeline error", entity=str(e).encode()))
             self._error = e
+            self._m_pipeline_errors.inc()
             return
         respond_batch(self.server, ids, out_ids, replies)
         self.batches_processed += 1
         self.requests_processed += len(batch)
+        self._m_batch_size.observe(len(batch))
 
     def latency_p50(self) -> Optional[float]:
         return self.server.latency_quantile(0.5)
@@ -107,6 +119,10 @@ class ContinuousServingEngine:
         self._work.set()
         self._thread.join(timeout=5)
         self.server.close()
+        self._m_reg.unregister_collector(self._collect_metrics)
+        for series in (self._m_batches, self._m_batch_size,
+                       self._m_pipeline_errors):
+            series.remove()
 
 
 class ServiceRegistry:
@@ -154,6 +170,15 @@ class RoutingServer:
             def _forward(self, method: str):
                 import socket as _socket
 
+                if method == "GET" and \
+                        self.path.partition("?")[0] == "/metrics":
+                    # the FLEET view: this front door scrapes every worker's
+                    # /metrics?format=json reply (the snapshot rides in the
+                    # ordinary HTTP reply — no side channel) and merges.
+                    # Worker histograms share the fixed bucket layout, so
+                    # fleet quantiles come from the combined distribution.
+                    serve_metrics_exposition(self, outer.fleet_snapshot())
+                    return
                 targets = outer.registry.lookup(outer.service)
                 if not targets:
                     self.send_error(503, "no workers registered")
@@ -261,17 +286,62 @@ class RoutingServer:
 
         self._httpd = Server((host, port), Handler)
         self.host, self.port = self._httpd.server_address[:2]
+        label = f"{self.host}:{self.port}"
+        reg = self._m_reg = get_registry()
+        self._m_routed = reg.counter(
+            "smt_routing_requests_total", "requests forwarded to workers",
+            ("server",)).labels(label)
+        self._m_evicted = reg.counter(
+            "smt_routing_evictions_total", "workers evicted as unreachable",
+            ("server",)).labels(label)
+        # synced from the plain ints at snapshot time (hot-path-free)
+        reg.register_collector(self._collect_metrics)
         self._thread = threading.Thread(target=self._httpd.serve_forever,
                                         name=f"routing-{self.port}", daemon=True)
         self._thread.start()
+
+    def _collect_metrics(self) -> None:
+        self._m_routed.sync_total(self.requests_routed)
+        self._m_evicted.sync_total(self.workers_evicted)
 
     @property
     def address(self) -> str:
         return f"http://{self.host}:{self.port}"
 
+    def fleet_snapshot(self) -> dict:
+        """Merged registry snapshot: this process's registry + every
+        registered worker's ``/metrics?format=json`` reply.
+
+        In-process fleets share the process-default registry, so the scraped
+        snapshots carry the SAME ``registry_id`` and dedupe instead of
+        double-counting; cross-process workers have distinct ids and sum
+        (``observability.merge``). Unreachable workers are skipped — a
+        scrape must not fail because one worker died."""
+        from ..core.clock import buffered_map
+
+        def scrape(target):
+            try:
+                with urllib.request.urlopen(
+                        target + "/metrics?format=json",
+                        timeout=min(self.timeout, 5.0)) as r:
+                    return json.loads(r.read().decode())
+            except Exception:
+                return None
+
+        # concurrent scrape: one wedged worker costs its own timeout, not
+        # timeout x fleet size serialized inside the handler thread
+        snaps = [get_registry().snapshot()]
+        snaps += [s for s in buffered_map(scrape,
+                                          self.registry.lookup(self.service),
+                                          concurrency=8) if s is not None]
+        return merge_snapshots(snaps)
+
     def close(self) -> None:
         self._httpd.shutdown()
         self._httpd.server_close()
+        self._m_reg.unregister_collector(self._collect_metrics)
+        self._m_routed.remove()
+        self._m_evicted.remove()
 
 
 class DistributedServingEngine:
@@ -305,9 +375,22 @@ class DistributedServingEngine:
         return self.registry.routing_table()
 
     def latency_p50(self) -> Optional[float]:
-        lats = [w.server.latency_quantile(0.5) for w in self.workers]
-        lats = [v for v in lats if v is not None]
-        return float(np.mean(lats)) if lats else None
+        """FLEET p50 from the workers' latency histograms merged bucket-wise.
+
+        A mean of per-worker p50s (the old implementation) is not a fleet
+        p50 — a slow worker serving 1% of traffic would shift the "median"
+        by its full latency. Bucket-wise merging computes the quantile of
+        the combined distribution (same estimator Prometheus's
+        ``histogram_quantile`` applies to a summed fleet histogram).
+
+        Like any Prometheus histogram this is CUMULATIVE over the servers'
+        lifetimes; for a recent-window view scrape ``/metrics`` and rate()
+        the buckets, or use the per-engine ``latency_p50`` (bounded recent
+        deque) on a single worker."""
+        labels = {"server": {w.server.server_label for w in self.workers}}
+        return histogram_quantile(get_registry().snapshot(),
+                                  "smt_serving_latency_seconds", 0.5,
+                                  label_filter=labels)
 
     def stop(self) -> None:
         self.router.close()
@@ -423,6 +506,21 @@ class ProcessServingFleet:
 
     def routing_table(self):
         return self.registry.routing_table()
+
+    def metrics_snapshot(self) -> dict:
+        """Merged fleet snapshot (router + every live worker PROCESS — each
+        worker's registry rides in its ``/metrics?format=json`` reply)."""
+        return self.router.fleet_snapshot()
+
+    def latency_p50(self) -> Optional[float]:
+        """Fleet p50 across worker processes, from merged histogram buckets
+        (never a mean of per-worker quantiles). Filtered to THIS fleet's
+        workers: the router process's registry may carry latency series from
+        unrelated in-process servers."""
+        labels = {a[len("http://"):] for a in self.addresses}
+        return histogram_quantile(self.metrics_snapshot(),
+                                  "smt_serving_latency_seconds", 0.5,
+                                  label_filter={"server": labels})
 
     def kill_worker(self, i: int) -> str:
         """SIGKILL worker ``i`` (the fault-injection hook); returns its

@@ -1,0 +1,46 @@
+"""Observability subsystem: metrics registry, stage spans, fleet exposition.
+
+What the reference covers with ``BasicLogging`` + ``StopWatch`` phase
+timing, rebuilt as first-class metrics (docs/observability.md):
+
+- :mod:`.metrics` — thread-safe ``Counter``/``Gauge``/``Histogram``
+  families in a :class:`MetricsRegistry`; histograms share one fixed
+  log-spaced bucket layout so they merge exactly across workers.
+- :mod:`.spans` — ``span(...)`` / per-stage instrumentation wired through
+  ``core/stage.py`` (wall time, row counts, cold/warm compile split);
+  ``enable()``/``disable()`` gate SPAN recording specifically. Serving and
+  GBDT engine metrics are not gated: they are per-reply/per-iteration (not
+  per-row), and the fleet latency quantiles depend on them.
+- :mod:`.exposition` — hand-rolled Prometheus text format for the
+  ``/metrics`` endpoints on the serving servers (``io/serving*.py``).
+- :mod:`.merge` — snapshot merging + ``histogram_quantile`` so fleet
+  quantiles come from combined bucket counts, not averaged per-worker
+  quantiles.
+
+Stdlib-only; never imports jax (the no-jax-at-import gate covers this
+package — ``tests/test_import_hygiene.py``).
+"""
+
+from .exposition import CONTENT_TYPE, render_prometheus
+from .merge import histogram_quantile, merge_snapshots
+from .metrics import (DEFAULT_BUCKETS, MetricFamily, MetricsRegistry,
+                      get_registry, set_registry)
+from .spans import Span, disable, enable, is_enabled, span, stage_span
+
+__all__ = [
+    "CONTENT_TYPE",
+    "DEFAULT_BUCKETS",
+    "MetricFamily",
+    "MetricsRegistry",
+    "Span",
+    "disable",
+    "enable",
+    "get_registry",
+    "histogram_quantile",
+    "is_enabled",
+    "merge_snapshots",
+    "render_prometheus",
+    "set_registry",
+    "span",
+    "stage_span",
+]

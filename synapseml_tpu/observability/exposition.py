@@ -1,0 +1,83 @@
+"""Prometheus text-format exposition (hand-rolled, version 0.0.4).
+
+Renders a registry snapshot (or a ``merge_snapshots`` aggregate) as the
+plain-text format every Prometheus-compatible scraper understands — no
+client-library dependency. Served by the ``/metrics`` endpoint on the
+micro-batch, continuous, and routing servers (``synapseml_tpu.io``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+__all__ = ["render_prometheus", "CONTENT_TYPE"]
+
+CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+
+def _escape_help(s: str) -> str:
+    return s.replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def _escape_label(s: str) -> str:
+    return (s.replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _fmt(v: float) -> str:
+    """Compact numeric rendering: integral values without a decimal point
+    (Prometheus parsers accept both; goldens want stability). Non-finite
+    values render as the spec's '+Inf'/'-Inf'/'NaN' — a user-recorded inf
+    must not crash the scrape handler forever."""
+    f = float(v)
+    if f != f:
+        return "NaN"
+    if f == float("inf"):
+        return "+Inf"
+    if f == float("-inf"):
+        return "-Inf"
+    if f == int(f) and abs(f) < 1e15:
+        return str(int(f))
+    return format(f, ".12g")
+
+
+def _labelstr(labelnames, labelvalues, extra: str = "") -> str:
+    parts = [f'{n}="{_escape_label(str(v))}"'
+             for n, v in zip(labelnames, labelvalues)]
+    if extra:
+        parts.append(extra)
+    return "{" + ",".join(parts) + "}" if parts else ""
+
+
+def render_prometheus(snapshot: Dict[str, Any]) -> str:
+    """Snapshot -> Prometheus text format. Histogram buckets render
+    cumulatively with the ``le`` label plus ``_sum``/``_count``, per the
+    exposition spec."""
+    lines = []
+    for name in sorted((snapshot.get("families") or {})):
+        fam = snapshot["families"][name]
+        typ = fam["type"]
+        labelnames = fam.get("labelnames", [])
+        lines.append(f"# HELP {name} {_escape_help(fam.get('help', ''))}")
+        lines.append(f"# TYPE {name} {typ}")
+        for s in fam.get("series", []):
+            lv = s["labels"]
+            if typ == "histogram":
+                cum = 0
+                for b, c in zip(fam["buckets"], s["counts"]):
+                    cum += c
+                    le = 'le="' + _fmt(b) + '"'
+                    lines.append(
+                        f"{name}_bucket{_labelstr(labelnames, lv, le)} {cum}")
+                cum += s["counts"][len(fam["buckets"])]
+                inf = 'le="+Inf"'
+                lines.append(
+                    f"{name}_bucket{_labelstr(labelnames, lv, inf)} {cum}")
+                lines.append(f"{name}_sum{_labelstr(labelnames, lv)} "
+                             f"{_fmt(s['sum'])}")
+                lines.append(f"{name}_count{_labelstr(labelnames, lv)} "
+                             f"{s['count']}")
+            else:
+                lines.append(f"{name}{_labelstr(labelnames, lv)} "
+                             f"{_fmt(s['value'])}")
+    return "\n".join(lines) + "\n"

@@ -1,0 +1,111 @@
+"""Merging registry snapshots across workers.
+
+The fleet observability contract: every worker's ``/metrics?format=json``
+reply carries its registry snapshot (``MetricsRegistry.snapshot()``), the
+routing front door merges them here, and fleet quantiles come from the
+**combined** bucket counts — never from averaging per-worker quantiles
+(the mean of per-worker p50s is not a fleet p50; that bug is what
+``DistributedServingEngine.latency_p50`` had before this subsystem).
+
+Dedup rule: snapshots carry ``registry_id``. Two snapshots with the same id
+are two scrapes of the SAME registry (the in-process fleet shares one
+process-default registry across all workers), so the later one in the list
+wins instead of double-counting. Distinct ids (cross-process workers) sum.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterable, List, Optional, Sequence
+
+from .metrics import bucket_quantile
+
+__all__ = ["merge_snapshots", "histogram_quantile"]
+
+
+def merge_snapshots(snapshots: Sequence[Dict[str, Any]]) -> Dict[str, Any]:
+    """Merge registry snapshots: counters/gauges sum per label set,
+    histograms sum bucket-wise (exact — all histograms share the fixed
+    log-spaced layout). Same-``registry_id`` snapshots dedupe (last wins).
+    Families whose schema disagrees across snapshots are skipped rather
+    than mis-merged."""
+    by_id: Dict[str, Dict[str, Any]] = {}
+    anon: List[Dict[str, Any]] = []  # already-merged snapshots have no id
+    for snap in snapshots:
+        if not isinstance(snap, dict):
+            continue
+        rid = snap.get("registry_id")
+        if rid:
+            by_id[rid] = snap
+        else:
+            anon.append(snap)
+
+    merged_fams: Dict[str, Dict[str, Any]] = {}
+    for snap in list(by_id.values()) + anon:
+        for name, fam in (snap.get("families") or {}).items():
+            out = merged_fams.get(name)
+            if out is None:
+                merged_fams[name] = {
+                    "type": fam["type"], "help": fam.get("help", ""),
+                    "labelnames": list(fam.get("labelnames", [])),
+                    "series": [dict(s, labels=list(s["labels"]),
+                                    **({"counts": list(s["counts"])}
+                                       if "counts" in s else {}))
+                               for s in fam.get("series", [])],
+                    **({"buckets": list(fam["buckets"])}
+                       if fam.get("buckets") else {}),
+                }
+                continue
+            if (out["type"] != fam["type"]
+                    or out["labelnames"] != list(fam.get("labelnames", []))
+                    or out.get("buckets") != (list(fam["buckets"])
+                                              if fam.get("buckets") else None)):
+                continue  # schema drift across workers: don't mis-merge
+            index = {tuple(s["labels"]): s for s in out["series"]}
+            for s in fam.get("series", []):
+                key = tuple(s["labels"])
+                tgt = index.get(key)
+                if tgt is None:
+                    tgt = dict(s, labels=list(s["labels"]))
+                    if "counts" in s:
+                        tgt["counts"] = list(s["counts"])
+                    out["series"].append(tgt)
+                    index[key] = tgt
+                elif fam["type"] == "histogram":
+                    tgt["counts"] = [a + b for a, b in zip(tgt["counts"],
+                                                           s["counts"])]
+                    tgt["sum"] += s["sum"]
+                    tgt["count"] += s["count"]
+                else:  # counters AND gauges sum across workers (a fleet
+                    # gauge like in-flight requests is additive)
+                    tgt["value"] += s["value"]
+    # no registry_id: a merged snapshot is an aggregate, not a scrape of one
+    # registry, so a second-level merger must treat it as anonymous (sum)
+    return {"registry_id": None, "families": merged_fams}
+
+
+def histogram_quantile(snapshot: Dict[str, Any], name: str, q: float,
+                       label_filter: Optional[Dict[str, Iterable[str]]] = None,
+                       ) -> Optional[float]:
+    """q-quantile of histogram family ``name`` with ALL its series merged
+    bucket-wise (optionally only series whose label values pass
+    ``label_filter``: label name -> allowed values). This is how a fleet
+    p50 is computed from per-worker histograms. None when empty/absent."""
+    fam = (snapshot.get("families") or {}).get(name)
+    if fam is None or fam.get("type") != "histogram":
+        return None
+    buckets = fam.get("buckets") or []
+    labelnames = list(fam.get("labelnames", []))
+    allowed = None
+    if label_filter:
+        allowed = {ln: set(str(v) for v in vals)
+                   for ln, vals in label_filter.items()}
+    counts = [0] * (len(buckets) + 1)
+    for s in fam.get("series", []):
+        if allowed is not None:
+            lv = dict(zip(labelnames, s["labels"]))
+            if any(ln in lv and lv[ln] not in vals
+                   for ln, vals in allowed.items()):
+                continue
+        for i, c in enumerate(s["counts"]):
+            counts[i] += c
+    return bucket_quantile(buckets, counts, q)

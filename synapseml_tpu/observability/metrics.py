@@ -1,0 +1,327 @@
+"""Thread-safe in-process metrics registry: Counter / Gauge / Histogram.
+
+The reference's operational surface is ``BasicLogging`` events plus ad-hoc
+``StopWatch`` phase timing; production serving (ROADMAP north star) needs
+scrapeable aggregates instead. This module is the process-local half of the
+observability subsystem: labeled metric families in a registry whose
+snapshots are plain JSON-able dicts, so a fleet front door can merge worker
+registries **without a side channel** — snapshots travel inside ordinary
+HTTP replies (see ``synapseml_tpu.io.serving``'s ``/metrics`` endpoint and
+``merge.merge_snapshots``).
+
+Design constraints:
+
+- **No dependencies** (stdlib only; numpy/jax never imported here) — the
+  package is importable anywhere, including serving worker processes before
+  jax initializes, preserving the repo's no-jax-at-import contract.
+- **Histograms use one fixed log-spaced bucket layout**
+  (:data:`DEFAULT_BUCKETS`) so per-worker histograms merge *exactly*
+  bucket-wise: fleet quantiles are computed from the combined distribution,
+  not averaged per-worker quantiles (averaging p50s is not a fleet p50).
+- Every mutation happens under the family lock; concurrent increments from
+  request-handler threads sum exactly (asserted by
+  ``tests/test_observability.py``).
+"""
+
+from __future__ import annotations
+
+import threading
+import uuid
+from bisect import bisect_left
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+__all__ = [
+    "DEFAULT_BUCKETS",
+    "MetricFamily",
+    "MetricsRegistry",
+    "get_registry",
+    "set_registry",
+]
+
+# Log-spaced upper bounds, 4 per decade, 1e-6 .. 1e8 (57 finite buckets +
+# implicit +Inf). One fixed layout for every histogram in the process means
+# any two workers' histograms share bucket edges and merge exactly. The
+# range covers sub-microsecond span timings through 1e8-row row counts;
+# anything beyond lands in +Inf and still merges/counts correctly.
+DEFAULT_BUCKETS: Tuple[float, ...] = tuple(10.0 ** (k / 4.0)
+                                           for k in range(-24, 33))
+
+
+class _Series:
+    """One labeled time series inside a family (or the family's sole series
+    when it has no labels). Mutations lock the owning family."""
+
+    __slots__ = ("_family", "labelvalues", "value", "counts", "sum", "count")
+
+    def __init__(self, family: "MetricFamily", labelvalues: Tuple[str, ...]):
+        self._family = family
+        self.labelvalues = labelvalues
+        if family.type == "histogram":
+            self.counts = [0] * (len(family.buckets) + 1)  # + the +Inf bucket
+            self.sum = 0.0
+            self.count = 0
+        else:
+            self.value = 0.0
+
+    # counter / gauge -----------------------------------------------------
+    def inc(self, v: float = 1.0) -> None:
+        if v < 0 and self._family.type == "counter":  # happy path: one cmp
+            raise ValueError("counters only go up; use a gauge")
+        with self._family._lock:
+            self.value += v
+
+    def sync_total(self, v: float) -> None:
+        """Overwrite the cumulative value from an externally-maintained
+        total (a plain GIL-atomic int bumped on a hot path). Lets servers
+        keep per-request cost at zero and reconcile at snapshot time via a
+        registry collector instead of taking a lock per event."""
+        with self._family._lock:
+            self.value = float(v)
+
+    def dec(self, v: float = 1.0) -> None:
+        if self._family.type != "gauge":
+            raise ValueError("dec() is gauge-only")
+        self.inc(-v)
+
+    def set(self, v: float) -> None:
+        if self._family.type != "gauge":
+            raise ValueError("set() is gauge-only")
+        with self._family._lock:
+            self.value = float(v)
+
+    # histogram -----------------------------------------------------------
+    def observe(self, v: float) -> None:
+        fam = self._family
+        if fam.type != "histogram":
+            raise ValueError("observe() is histogram-only")
+        i = bisect_left(fam.buckets, v)  # first bucket with upper >= v
+        with fam._lock:
+            self.counts[i] += 1
+            self.sum += v
+            self.count += 1
+
+    def quantile(self, q: float) -> Optional[float]:
+        """Estimated q-quantile by linear interpolation inside the bucket
+        (the ``histogram_quantile`` estimator). None when empty."""
+        with self._family._lock:
+            counts = list(self.counts)
+        return bucket_quantile(self._family.buckets, counts, q)
+
+    def remove(self) -> None:
+        """Retire this series from its family (owner went away)."""
+        self._family.remove(*self.labelvalues)
+
+
+def bucket_quantile(buckets: Sequence[float], counts: Sequence[int],
+                    q: float) -> Optional[float]:
+    """Quantile of a (buckets, counts) histogram; shared by live series and
+    merged snapshots. Values past the last finite bucket clamp to it."""
+    total = sum(counts)
+    if total == 0:
+        return None
+    target = q * total
+    cum = 0.0
+    for i, c in enumerate(counts):
+        prev_cum = cum
+        cum += c
+        if cum >= target and c > 0:
+            if i >= len(buckets):  # +Inf bucket: clamp to last finite edge
+                return float(buckets[-1])
+            lo = buckets[i - 1] if i > 0 else 0.0
+            hi = buckets[i]
+            return float(lo + (hi - lo) * (target - prev_cum) / c)
+    return float(buckets[-1])
+
+
+class MetricFamily:
+    """A named metric with a fixed label schema; ``labels(...)`` returns the
+    series for one label-value assignment (created on first use)."""
+
+    def __init__(self, name: str, type_: str, help_: str,
+                 labelnames: Tuple[str, ...],
+                 buckets: Optional[Tuple[float, ...]] = None):
+        if type_ not in ("counter", "gauge", "histogram"):
+            raise ValueError(f"unknown metric type {type_!r}")
+        self.name = name
+        self.type = type_
+        self.help = help_
+        self.labelnames = labelnames
+        self.buckets = tuple(buckets) if type_ == "histogram" else None
+        # plain Lock (not RLock): never held across a call that could
+        # re-enter, and it is on the per-observation hot path
+        self._lock = threading.Lock()
+        self._series: Dict[Tuple[str, ...], _Series] = {}
+        if not labelnames:  # unlabeled family IS its single series
+            self._default = self.labels()
+
+    def labels(self, *values: Any, **kv: Any) -> _Series:
+        if kv:
+            if values:
+                raise ValueError("pass label values positionally OR by name")
+            values = tuple(kv[n] for n in self.labelnames)
+        if len(values) != len(self.labelnames):
+            raise ValueError(f"{self.name} expects labels {self.labelnames}, "
+                             f"got {values!r}")
+        key = tuple(str(v) for v in values)
+        with self._lock:
+            s = self._series.get(key)
+            if s is None:
+                s = self._series[key] = _Series(self, key)
+            return s
+
+    def remove(self, *values: Any) -> None:
+        """Drop one labeled series (a departed server/engine). A scrape
+        after removal simply no longer lists it — standard Prometheus
+        series-goes-away semantics; no-op if absent."""
+        key = tuple(str(v) for v in values)
+        with self._lock:
+            self._series.pop(key, None)
+
+    # unlabeled convenience: family.inc()/observe()/set() hit the () series
+    def inc(self, v: float = 1.0) -> None:
+        self._default.inc(v)
+
+    def dec(self, v: float = 1.0) -> None:
+        self._default.dec(v)
+
+    def set(self, v: float) -> None:
+        self._default.set(v)
+
+    def observe(self, v: float) -> None:
+        self._default.observe(v)
+
+    def quantile(self, q: float) -> Optional[float]:
+        return self._default.quantile(q)
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            series: List[Dict[str, Any]] = []
+            for key, s in sorted(self._series.items()):
+                if self.type == "histogram":
+                    series.append({"labels": list(key),
+                                   "counts": list(s.counts),
+                                   "sum": s.sum, "count": s.count})
+                else:
+                    series.append({"labels": list(key), "value": s.value})
+        out: Dict[str, Any] = {"type": self.type, "help": self.help,
+                               "labelnames": list(self.labelnames),
+                               "series": series}
+        if self.buckets is not None:
+            out["buckets"] = list(self.buckets)
+        return out
+
+
+class MetricsRegistry:
+    """Process-local registry of metric families.
+
+    ``registry_id`` travels with every snapshot so a merger can tell "two
+    scrapes of the same registry" (deduplicate) from "two workers"
+    (sum) — the in-process worker fleet shares one registry while the
+    cross-process fleet has one per worker, and the routing front door
+    merges both correctly without knowing which it is talking to.
+    """
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._families: Dict[str, MetricFamily] = {}
+        self._collectors: List[Any] = []  # weakrefs to callables
+        self.registry_id = uuid.uuid4().hex
+
+    def register_collector(self, fn) -> None:
+        """Register a callback run at the start of every ``snapshot()``
+        (the Prometheus custom-collector pattern): components that maintain
+        cheap plain-int totals on their hot paths sync them into their
+        series here, at scrape frequency instead of event frequency. Held
+        by weakref — a dead component's collector unregisters itself."""
+        import weakref
+
+        try:
+            ref = weakref.WeakMethod(fn)
+        except TypeError:
+            ref = weakref.ref(fn)
+        with self._lock:
+            self._collectors.append(ref)
+
+    def unregister_collector(self, fn) -> None:
+        """Remove a collector registered for ``fn`` (a closed component
+        stops being scraped); no-op if absent."""
+        with self._lock:
+            self._collectors = [r for r in self._collectors
+                                if r() is not None and r() != fn
+                                and r() is not fn]
+
+    def _family(self, name: str, type_: str, help_: str,
+                labelnames: Sequence[str],
+                buckets: Optional[Sequence[float]] = None) -> MetricFamily:
+        labelnames = tuple(labelnames)
+        buckets = tuple(buckets) if buckets else None
+        with self._lock:
+            fam = self._families.get(name)
+            if fam is None:
+                fam = MetricFamily(name, type_, help_, labelnames, buckets)
+                self._families[name] = fam
+                return fam
+        if (fam.type != type_ or fam.labelnames != labelnames
+                or fam.buckets != buckets):
+            raise ValueError(
+                f"metric {name!r} re-registered with a different schema: "
+                f"{fam.type}{fam.labelnames}/{fam.buckets} vs "
+                f"{type_}{labelnames}/{buckets}")
+        return fam
+
+    def counter(self, name: str, help_: str = "",
+                labelnames: Sequence[str] = ()) -> MetricFamily:
+        return self._family(name, "counter", help_, labelnames)
+
+    def gauge(self, name: str, help_: str = "",
+              labelnames: Sequence[str] = ()) -> MetricFamily:
+        return self._family(name, "gauge", help_, labelnames)
+
+    def histogram(self, name: str, help_: str = "",
+                  labelnames: Sequence[str] = (),
+                  buckets: Sequence[float] = DEFAULT_BUCKETS) -> MetricFamily:
+        return self._family(name, "histogram", help_, labelnames, buckets)
+
+    def snapshot(self) -> Dict[str, Any]:
+        """JSON-able point-in-time copy of every family (collectors run
+        first so scrape-time-synced totals are fresh)."""
+        with self._lock:
+            collectors = list(self._collectors)
+        dead = []
+        for ref in collectors:
+            fn = ref()
+            if fn is None:
+                dead.append(ref)
+                continue
+            try:
+                fn()
+            except Exception:  # a broken collector must not kill scrapes
+                pass
+        if dead:
+            with self._lock:
+                self._collectors = [r for r in self._collectors
+                                    if r not in dead]
+        with self._lock:
+            fams = list(self._families.items())
+        return {"registry_id": self.registry_id,
+                "families": {name: fam.snapshot() for name, fam in fams}}
+
+
+_default_registry = MetricsRegistry()
+_default_lock = threading.Lock()
+
+
+def get_registry() -> MetricsRegistry:
+    """The process-default registry (what stage spans and serving servers
+    record into, and what ``/metrics`` exposes)."""
+    return _default_registry
+
+
+def set_registry(registry: MetricsRegistry) -> MetricsRegistry:
+    """Swap the process-default registry; returns the previous one (tests
+    install a fresh registry for isolation)."""
+    global _default_registry
+    with _default_lock:
+        prev = _default_registry
+        _default_registry = registry
+    return prev

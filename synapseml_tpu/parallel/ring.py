@@ -232,7 +232,6 @@ def sequence_sharded_attention(q, k, v, mesh, axis: str = "seq",
     ``block_q``/``block_k`` tune the ``local='flash'`` kernel (default:
     auto-picked to divide the gathered sequence)."""
     import jax
-    from jax import shard_map
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     if strategy not in ("ring", "ulysses"):
@@ -259,8 +258,9 @@ def _sharded_attn_fn(mesh, axis: str, strategy: str, causal: bool,
     # cached per (mesh, axis, strategy, causal): a fresh jit closure per call
     # would retrace + recompile on every invocation (per layer / per step)
     import jax
-    from jax import shard_map
     from jax.sharding import PartitionSpec as P
+
+    from ..runtime.topology import shard_map_compat
 
     if strategy == "ring":
         fn = partial(ring_attention, axis_name=axis, causal=causal)
@@ -269,8 +269,8 @@ def _sharded_attn_fn(mesh, axis: str, strategy: str, causal: bool,
                      local=local, interpret=interpret,
                      block_q=block_q, block_k=block_k)
     spec = P(None, axis, None, None)
-    return jax.jit(shard_map(
+    return jax.jit(shard_map_compat(
         fn,
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
-        check_vma=False,
+        check=False,
     ))

@@ -30,7 +30,37 @@ __all__ = [
     "initialize_distributed",
     "device_kind",
     "is_tpu",
+    "shard_map_compat",
 ]
+
+
+def shard_map_compat(f, mesh, in_specs, out_specs, check: bool = True):
+    """``shard_map`` across jax version drift.
+
+    Newer jax exposes ``jax.shard_map`` with a ``check_vma`` kwarg; 0.4.x
+    has ``jax.experimental.shard_map.shard_map`` with ``check_rep``. Every
+    mesh-distributed code path in this repo goes through this wrapper —
+    never ``from jax import shard_map`` directly — so an interpreter's jax
+    picks the right spelling at call time (jax stays lazily imported).
+
+    ``check`` defaults to True, matching jax's own replication checking
+    default; the trainers pass ``check=False`` explicitly where the body's
+    collectives are known-good and the check costs tracing time."""
+    import inspect
+
+    import jax
+
+    sm = getattr(jax, "shard_map", None)
+    if sm is None:
+        from jax.experimental.shard_map import shard_map as sm
+    params = inspect.signature(sm).parameters
+    if "check_vma" in params:
+        kw = {"check_vma": check}
+    elif "check_rep" in params:
+        kw = {"check_rep": check}
+    else:
+        kw = {}
+    return sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kw)
 
 _logger = logging.getLogger("synapseml_tpu.topology")
 

@@ -152,8 +152,9 @@ def train_linear(
     axis_name = axis if mesh is not None else None
 
     if mesh is not None:
-        from jax import shard_map
         from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from ..runtime.topology import shard_map_compat
 
         shards = mesh.shape[axis]
         per = -(-n // shards)  # rows per shard, rounded up
@@ -189,10 +190,10 @@ def train_linear(
                 jax.lax.pmax(s, axis_name))
 
         ds = P(axis)
-        sharded_pass = shard_map(
+        sharded_pass = shard_map_compat(
             pass_fn, mesh=mesh,
             in_specs=(P(), ds, ds, ds, ds), out_specs=P(),
-            check_vma=False,
+            check=False,
         )
         step_fn = sharded_pass
         args = (jax.device_put(bi, NamedSharding(mesh, ds)),

@@ -1,0 +1,45 @@
+"""No-jax-at-import gate.
+
+Importing ``synapseml_tpu`` (and the operational layers a serving worker
+touches before any pipeline runs — io, core, observability) must never
+import jax: worker processes, scrapers, and CLI tools import the package at
+startup and jax initialization is both slow and environment-sensitive.
+Modules lazy-import jax inside functions instead. Checked in a SUBPROCESS
+so the test is immune to whatever the surrounding pytest session already
+imported (conftest.py imports jax eagerly).
+"""
+
+import subprocess
+import sys
+
+# every module the gate covers; extend when adding import-time-critical
+# packages (the observability subsystem is explicitly listed: it is
+# stdlib-only by design and must stay that way)
+_GATED_MODULES = [
+    "synapseml_tpu",
+    "synapseml_tpu.core.clock",
+    "synapseml_tpu.core.stage",
+    "synapseml_tpu.core.telemetry",
+    "synapseml_tpu.observability",
+    "synapseml_tpu.observability.exposition",
+    "synapseml_tpu.observability.merge",
+    "synapseml_tpu.observability.metrics",
+    "synapseml_tpu.observability.spans",
+    "synapseml_tpu.io.serving",
+    "synapseml_tpu.io.serving_v2",
+    "synapseml_tpu.io.serving_worker",
+    "synapseml_tpu.gbdt.boost",
+]
+
+
+def test_no_jax_at_import():
+    code = "\n".join(
+        ["import sys"]
+        + [f"import {m}" for m in _GATED_MODULES]
+        + ["bad = sorted(m for m in sys.modules if m == 'jax' "
+           "or m.startswith('jax.'))",
+           "assert not bad, f'jax imported at module import time: {bad[:5]}'"]
+    )
+    proc = subprocess.run([sys.executable, "-c", code],
+                          capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stderr

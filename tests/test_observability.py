@@ -1,0 +1,482 @@
+"""Observability subsystem tests: registry exactness, exposition format,
+span wiring (registry-wide), and fleet-merged quantiles.
+
+Acceptance contract (ISSUE 2): concurrent increments sum exactly; the
+Prometheus text format is byte-stable; every registered stage's
+``transform``/``fit`` goes through the span-instrumented base methods; the
+fleet ``/metrics`` front door serves merged histograms whose p50 comes from
+the combined distribution.
+"""
+
+import importlib
+import json
+import pkgutil
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+import synapseml_tpu
+from synapseml_tpu import observability as obs
+from synapseml_tpu.core import Table, Transformer, Estimator, Model
+from synapseml_tpu.core.stage import STAGE_REGISTRY
+from synapseml_tpu.observability import (DEFAULT_BUCKETS, MetricsRegistry,
+                                         histogram_quantile, merge_snapshots,
+                                         render_prometheus)
+
+
+@pytest.fixture
+def fresh_registry():
+    """Install an isolated process-default registry for the test."""
+    reg = MetricsRegistry()
+    prev = obs.set_registry(reg)
+    try:
+        yield reg
+    finally:
+        obs.set_registry(prev)
+
+
+# ---------------------------------------------------------------------------
+# registry exactness
+# ---------------------------------------------------------------------------
+
+def test_concurrent_increments_sum_exactly():
+    reg = MetricsRegistry()
+    c = reg.counter("c_total", "c")
+    h = reg.histogram("h", "h")
+    g = reg.gauge("g", "g", ("k",))
+    n_threads, per_thread = 8, 5000
+
+    def work(i):
+        child = g.labels(str(i % 2))
+        for _ in range(per_thread):
+            c.inc()
+            h.observe(0.01)
+            child.inc(2.0)
+
+    threads = [threading.Thread(target=work, args=(i,))
+               for i in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    snap = reg.snapshot()
+    assert snap["families"]["c_total"]["series"][0]["value"] == \
+        n_threads * per_thread
+    hs = snap["families"]["h"]["series"][0]
+    assert hs["count"] == n_threads * per_thread
+    assert sum(hs["counts"]) == n_threads * per_thread
+    gvals = {tuple(s["labels"]): s["value"]
+             for s in snap["families"]["g"]["series"]}
+    assert gvals == {("0",): 4 * per_thread * 2.0,
+                     ("1",): 4 * per_thread * 2.0}
+
+
+def test_counter_rejects_negative_and_schema_conflicts():
+    reg = MetricsRegistry()
+    c = reg.counter("c_total", "c")
+    with pytest.raises(ValueError):
+        c.inc(-1)
+    with pytest.raises(ValueError):
+        reg.gauge("c_total", "now a gauge?")
+    with pytest.raises(ValueError):
+        reg.counter("c_total", "c", ("extra_label",))
+    # histogram bucket layout is part of the schema: silently handing back
+    # the first registration's edges would corrupt the caller's quantiles
+    reg.histogram("h", "h", buckets=(0.1, 1.0))
+    with pytest.raises(ValueError):
+        reg.histogram("h", "h", buckets=(0.5, 5.0))
+
+
+def test_histogram_quantile_single_registry():
+    reg = MetricsRegistry()
+    h = reg.histogram("lat", "l")
+    rng = np.random.default_rng(0)
+    samples = rng.lognormal(mean=-5.0, sigma=1.0, size=2000)
+    for s in samples:
+        h.observe(float(s))
+    est = h.quantile(0.5)
+    exact = float(np.quantile(samples, 0.5))
+    # log-spaced buckets are a factor 10^(1/4) ~ 1.78 wide: the interpolated
+    # estimate is always within one bucket of exact
+    assert exact / 1.8 <= est <= exact * 1.8
+
+
+# ---------------------------------------------------------------------------
+# merging across workers
+# ---------------------------------------------------------------------------
+
+def test_merge_sums_distinct_registries_and_dedupes_same():
+    a, b = MetricsRegistry(), MetricsRegistry()
+    a.counter("req_total", "r").inc(3)
+    b.counter("req_total", "r").inc(4)
+    merged = merge_snapshots([a.snapshot(), b.snapshot()])
+    assert merged["families"]["req_total"]["series"][0]["value"] == 7
+    # two scrapes of the SAME registry must not double-count (the in-process
+    # fleet shares one registry across every worker server)
+    merged = merge_snapshots([a.snapshot(), a.snapshot(), b.snapshot()])
+    assert merged["families"]["req_total"]["series"][0]["value"] == 7
+
+
+def test_merged_fleet_quantile_matches_combined_distribution():
+    """The satellite fix: fleet p50 from merged buckets, NOT a mean of
+    per-worker p50s. Construct a skewed fleet where the two differ."""
+    rng = np.random.default_rng(1)
+    fast = rng.lognormal(mean=-7.0, sigma=0.3, size=1900)  # 95% of traffic
+    slow = rng.lognormal(mean=-2.0, sigma=0.3, size=100)   # 5% of traffic
+    a, b = MetricsRegistry(), MetricsRegistry()
+    ha = a.histogram("lat", "l", ("server",)).labels("w0")
+    hb = b.histogram("lat", "l", ("server",)).labels("w1")
+    for s in fast:
+        ha.observe(float(s))
+    for s in slow:
+        hb.observe(float(s))
+    merged = merge_snapshots([a.snapshot(), b.snapshot()])
+    est = histogram_quantile(merged, "lat", 0.5)
+    exact = float(np.quantile(np.concatenate([fast, slow]), 0.5))
+    assert exact / 1.8 <= est <= exact * 1.8
+    # the OLD buggy estimator (mean of per-worker p50s) is ~half the slow
+    # mode's latency — two orders off the true fleet p50; the merged
+    # estimate must not be anywhere near it
+    wrong = np.mean([np.quantile(fast, 0.5), np.quantile(slow, 0.5)])
+    assert est < wrong / 10
+
+    # snapshots survive a JSON round trip (they travel in HTTP replies)
+    rt = json.loads(json.dumps(merged))
+    assert histogram_quantile(rt, "lat", 0.5) == est
+
+
+def test_histogram_quantile_label_filter():
+    reg = MetricsRegistry()
+    h = reg.histogram("lat", "l", ("server",))
+    for _ in range(100):
+        h.labels("w0").observe(1e-3)
+        h.labels("w1").observe(10.0)
+    snap = reg.snapshot()
+    p50_w0 = histogram_quantile(snap, "lat", 0.5,
+                                label_filter={"server": {"w0"}})
+    assert p50_w0 < 0.01
+
+
+# ---------------------------------------------------------------------------
+# Prometheus exposition golden format
+# ---------------------------------------------------------------------------
+
+def test_prometheus_exposition_golden():
+    reg = MetricsRegistry()
+    reg.counter("req_total", "requests", ("server",)).labels("w:1").inc(5)
+    reg.gauge("depth", "queue depth").set(2.5)
+    h = reg.histogram("lat", "latency", buckets=(0.1, 1.0, 10.0))
+    h.observe(0.05)
+    h.observe(0.5)
+    h.observe(100.0)
+    golden = (
+        '# HELP depth queue depth\n'
+        '# TYPE depth gauge\n'
+        'depth 2.5\n'
+        '# HELP lat latency\n'
+        '# TYPE lat histogram\n'
+        'lat_bucket{le="0.1"} 1\n'
+        'lat_bucket{le="1"} 2\n'
+        'lat_bucket{le="10"} 2\n'
+        'lat_bucket{le="+Inf"} 3\n'
+        'lat_sum 100.55\n'
+        'lat_count 3\n'
+        '# HELP req_total requests\n'
+        '# TYPE req_total counter\n'
+        'req_total{server="w:1"} 5\n'
+    )
+    assert render_prometheus(reg.snapshot()) == golden
+
+
+def test_prometheus_label_escaping():
+    reg = MetricsRegistry()
+    reg.counter("c_total", "c", ("p",)).labels('a"b\\c\nd').inc()
+    out = render_prometheus(reg.snapshot())
+    assert 'p="a\\"b\\\\c\\nd"' in out
+
+
+def test_prometheus_nonfinite_values_render_not_crash():
+    """A user-recorded inf/NaN must not break every later scrape."""
+    reg = MetricsRegistry()
+    reg.gauge("cap").set(float("inf"))
+    reg.gauge("neg").set(float("-inf"))
+    h = reg.histogram("lat", "l", buckets=(1.0,))
+    h.observe(float("nan"))  # sum becomes NaN; counts still well-defined
+    out = render_prometheus(reg.snapshot())
+    assert "cap +Inf" in out
+    assert "neg -Inf" in out
+    assert "lat_sum NaN" in out
+
+
+# ---------------------------------------------------------------------------
+# stage spans: registry-wide wiring sweep + functional checks
+# ---------------------------------------------------------------------------
+
+def _import_all_modules():
+    for mod in pkgutil.walk_packages(synapseml_tpu.__path__,
+                                     prefix="synapseml_tpu."):
+        if mod.name == "synapseml_tpu.native._smt_native":
+            continue
+        try:
+            importlib.import_module(mod.name)
+        except Exception:
+            pass
+
+
+@pytest.mark.parametrize("method", ["transform", "fit"])
+def test_every_registered_stage_goes_through_span_wrapper(method):
+    """Registry-wide sweep: no stage overrides the instrumented base
+    ``transform``/``fit``, so every stage's calls produce spans. A stage
+    that needs its own wrapper must re-implement the span contract and be
+    exempted here with a reason (none currently)."""
+    _import_all_modules()
+    assert len(STAGE_REGISTRY) >= 140
+    base = {"transform": Transformer.transform, "fit": Estimator.fit}[method]
+    kind = {"transform": Transformer, "fit": Estimator}[method]
+    offenders = [name for name, cls in STAGE_REGISTRY.items()
+                 if issubclass(cls, kind) and
+                 getattr(cls, method) is not base]
+    assert offenders == [], (
+        f"stages overriding {method}() bypass span instrumentation: "
+        f"{offenders}")
+
+
+class _SpanProbe(Transformer):  # _ prefix: not registry-registered
+    def _transform(self, table):
+        return table.take(np.arange(min(2, len(table))))
+
+
+class _SpanProbeEstimator(Estimator):
+    def _fit(self, table):
+        return _SpanProbeModel()
+
+
+class _SpanProbeModel(Model):
+    def _transform(self, table):
+        return table
+
+
+def test_transform_and_fit_emit_spans(fresh_registry):
+    t = Table({"x": np.arange(5.0)})
+    stage = _SpanProbe()
+    stage.transform(t)
+    stage.transform(t)
+    model = _SpanProbeEstimator().fit(t)
+    model.transform(t)
+    snap = fresh_registry.snapshot()
+    fams = snap["families"]
+    dur = {tuple(s["labels"]): s
+           for s in fams["smt_stage_duration_seconds"]["series"]}
+    # cold/warm split: first call of the instance is cold, second warm
+    assert dur[("_SpanProbe", "transform", "1")]["count"] == 1
+    assert dur[("_SpanProbe", "transform", "0")]["count"] == 1
+    assert dur[("_SpanProbeEstimator", "fit", "1")]["count"] == 1
+    for s in dur.values():
+        assert s["sum"] >= 0.0
+    rows = {tuple(s["labels"]): s["value"]
+            for s in fams["smt_stage_rows_total"]["series"]}
+    # transform counts OUTPUT rows (the probe truncates 5 -> 2), fit INPUT
+    assert rows[("_SpanProbe", "transform")] == 4.0  # 2 rows x 2 calls
+    assert rows[("_SpanProbeEstimator", "fit")] == 5.0
+    assert rows[("_SpanProbeModel", "transform")] == 5.0
+
+
+def test_copied_stage_gets_its_own_cold_call(fresh_registry):
+    """Params.copy() shallow-copies __dict__; the clone must not inherit
+    the original's warm-set — its first call is genuinely cold (pays any
+    trace/compile for its own config)."""
+    t = Table({"x": np.arange(4.0)})
+    a = _SpanProbe()
+    a.transform(t)          # a: cold
+    b = a.copy()
+    b.transform(t)          # b: must be cold again, not warm via aliasing
+    a.transform(t)          # a: warm (its set must be untouched by b)
+    dur = {tuple(s["labels"]): s["count"] for s in fresh_registry.snapshot()
+           ["families"]["smt_stage_duration_seconds"]["series"]}
+    assert dur[("_SpanProbe", "transform", "1")] == 2
+    assert dur[("_SpanProbe", "transform", "0")] == 1
+
+
+def test_span_records_errors_and_duration_on_raise(fresh_registry):
+    class _Boom(Transformer):
+        def _transform(self, table):
+            raise RuntimeError("boom")
+
+    with pytest.raises(RuntimeError):
+        _Boom().transform(Table({"x": np.arange(3.0)}))
+    fams = fresh_registry.snapshot()["families"]
+    errs = {tuple(s["labels"]): s["value"]
+            for s in fams["smt_stage_errors_total"]["series"]}
+    assert errs[("_Boom", "transform")] == 1.0
+    dur = {tuple(s["labels"]): s["count"]
+           for s in fams["smt_stage_duration_seconds"]["series"]}
+    assert dur[("_Boom", "transform", "1")] == 1
+
+
+def test_disable_makes_spans_noops(fresh_registry):
+    obs.disable()
+    try:
+        _SpanProbe().transform(Table({"x": np.arange(3.0)}))
+    finally:
+        obs.enable()
+    assert "smt_stage_duration_seconds" not in \
+        fresh_registry.snapshot()["families"]
+
+
+def test_disabled_first_call_still_consumes_coldness(fresh_registry):
+    """The instance's real first call (trace+compile) may run inside a
+    disable() window; the next enabled call must record as warm, not
+    masquerade as the compile one."""
+    t = Table({"x": np.arange(3.0)})
+    stage = _SpanProbe()
+    obs.disable()
+    try:
+        stage.transform(t)  # the genuinely cold call, unrecorded
+    finally:
+        obs.enable()
+    stage.transform(t)
+    dur = {tuple(s["labels"]): s["count"] for s in fresh_registry.snapshot()
+           ["families"]["smt_stage_duration_seconds"]["series"]}
+    assert dur.get(("_SpanProbe", "transform", "0")) == 1
+    # the cold series exists (pre-created with its family) but holds nothing
+    assert dur.get(("_SpanProbe", "transform", "1"), 0) == 0
+
+
+# ---------------------------------------------------------------------------
+# serving /metrics endpoints + fleet aggregation
+# ---------------------------------------------------------------------------
+
+class _EchoReply(Transformer):
+    def _transform(self, table):
+        from synapseml_tpu.io.serving import string_to_response
+
+        reqs = table["request"]
+        out = np.empty(len(reqs), dtype=object)
+        for i, r in enumerate(reqs):
+            out[i] = string_to_response((r.entity or b"").decode())
+        return table.with_column("reply", out)
+
+
+def _post(addr, body=b"x"):
+    req = urllib.request.Request(addr + "/", data=body, method="POST")
+    with urllib.request.urlopen(req, timeout=15) as r:
+        assert r.status == 200
+
+
+def test_serving_server_metrics_endpoint():
+    from synapseml_tpu.io.serving_v2 import serve_continuous
+
+    eng = serve_continuous(_EchoReply())
+    try:
+        for _ in range(5):
+            _post(eng.server.address)
+        text = urllib.request.urlopen(eng.server.address + "/metrics",
+                                      timeout=15).read().decode()
+        label = eng.server.server_label
+        assert f'smt_serving_requests_total{{server="{label}"}} 5' in text
+        assert "smt_serving_latency_seconds_bucket" in text
+        assert "smt_stage_duration_seconds" in text  # spans in the same scrape
+        snap = json.loads(urllib.request.urlopen(
+            eng.server.address + "/metrics?format=json",
+            timeout=15).read().decode())
+        assert snap["registry_id"] == obs.get_registry().registry_id
+    finally:
+        eng.stop()
+
+
+def test_fleet_front_door_merges_and_p50_is_from_combined_buckets():
+    from synapseml_tpu.io.serving_v2 import DistributedServingEngine
+
+    eng = DistributedServingEngine(_EchoReply(), n_workers=2)
+    try:
+        for i in range(24):
+            _post(eng.address, b"x%d" % i)
+        text = urllib.request.urlopen(eng.address + "/metrics",
+                                      timeout=15).read().decode()
+        for needle in ("smt_serving_requests_total",
+                       "smt_serving_latency_seconds_bucket",
+                       "smt_routing_requests_total",
+                       "smt_stage_duration_seconds"):
+            assert needle in text, needle
+        # fleet p50 from merged buckets tracks the exact combined quantile
+        samples = [s for w in eng.workers for s in w.server._latencies]
+        assert len(samples) == 24
+        exact = float(np.quantile(samples, 0.5))
+        p50 = eng.latency_p50()
+        assert p50 is not None and exact / 1.9 <= p50 <= exact * 1.9
+    finally:
+        eng.stop()
+
+
+def test_server_close_retires_its_series_and_collector():
+    """A churning process (ephemeral ports) must not grow the registry
+    without bound: close()/stop() removes the component's series."""
+    from synapseml_tpu.io.serving_v2 import serve_continuous
+
+    eng = serve_continuous(_EchoReply())
+    label = eng.server.server_label
+    _post(eng.server.address)
+    snap = obs.get_registry().snapshot()
+    labels = [s["labels"] for s in
+              snap["families"]["smt_serving_requests_total"]["series"]]
+    assert [label] in labels
+    eng.stop()
+    snap = obs.get_registry().snapshot()
+    for fam in ("smt_serving_requests_total", "smt_serving_latency_seconds",
+                "smt_serving_batches_total"):
+        series = snap["families"].get(fam, {}).get("series", [])
+        assert all(s["labels"][0] != label for s in series), fam
+
+
+# ---------------------------------------------------------------------------
+# telemetry satellites: drain + capacity + monotonic durations
+# ---------------------------------------------------------------------------
+
+def test_drain_events_is_atomic_snapshot_and_clear():
+    from synapseml_tpu.core import telemetry
+
+    telemetry.clear_events()
+    stop = threading.Event()
+
+    def producer():
+        while not stop.is_set():
+            telemetry.log_stage_call(None, "m")
+
+    threads = [threading.Thread(target=producer) for _ in range(4)]
+    for t in threads:
+        t.start()
+    try:
+        drained = []
+        for _ in range(50):
+            drained += telemetry.drain_events()
+    finally:
+        stop.set()
+        for t in threads:
+            t.join()
+    leftover = telemetry.drain_events()
+    # every event is seen exactly once across drains (no loss, no dupes
+    # under the capacity): total == number produced is unknowable, but a
+    # final drain after quiescence must leave nothing behind
+    assert telemetry.recent_events() == []
+    assert all(e["method"] == "m" for e in drained + leftover)
+
+
+def test_event_capacity_configurable():
+    from synapseml_tpu.core import telemetry
+
+    old = telemetry.event_capacity()
+    try:
+        telemetry.set_event_capacity(8)
+        assert telemetry.event_capacity() == 8
+        telemetry.clear_events()
+        for i in range(20):
+            telemetry.log_stage_call(None, "m", i=i)
+        evts = telemetry.recent_events()
+        assert len(evts) == 8 and evts[-1]["i"] == 19  # newest kept
+        with pytest.raises(ValueError):
+            telemetry.set_event_capacity(0)
+    finally:
+        telemetry.set_event_capacity(old)
+        telemetry.clear_events()

@@ -152,15 +152,16 @@ def test_ring_peak_memory_is_blockwise(mesh):
     """The ring never materializes the (S, S) score matrix — the jaxpr of the
     shard-mapped fn must not contain a full-sequence-squared intermediate."""
     from functools import partial
-    from jax import shard_map
     from jax.sharding import PartitionSpec as P
+
+    from synapseml_tpu.runtime.topology import shard_map_compat
 
     b, s, h, d = 1, 512, 4, 8
     q, k, v = _qkv(seed=2, b=b, s=s, h=h, d=d)
     spec = P(None, "seq", None, None)
-    fn = shard_map(partial(ring_attention, axis_name="seq"),
-                   mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
-                   check_vma=False)
+    fn = shard_map_compat(partial(ring_attention, axis_name="seq"),
+                          mesh=mesh, in_specs=(spec, spec, spec),
+                          out_specs=spec, check=False)
     jaxpr = jax.make_jaxpr(fn)(q, k, v)
     s_local = s // 8
     # largest score-shaped buffer is (b, s_local, h, s_local), never (.., s)

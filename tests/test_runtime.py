@@ -72,7 +72,8 @@ def test_psum_over_mesh():
     import jax
     import jax.numpy as jnp
     from jax.sharding import NamedSharding, PartitionSpec as P
-    from jax import shard_map
+
+    from synapseml_tpu.runtime.topology import shard_map_compat
 
     mesh = make_mesh(("data",))
     x = jnp.arange(8.0)
@@ -80,7 +81,8 @@ def test_psum_over_mesh():
     def local_hist(xs):
         return jax.lax.psum(jnp.sum(xs, keepdims=True), "data")
 
-    f = shard_map(local_hist, mesh=mesh, in_specs=P("data"), out_specs=P("data"))
+    f = shard_map_compat(local_hist, mesh=mesh, in_specs=P("data"),
+                         out_specs=P("data"))
     out = f(x)
     np.testing.assert_allclose(np.asarray(out), np.full(8, 28.0))
 

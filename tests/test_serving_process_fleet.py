@@ -9,6 +9,7 @@ service keeps answering — the router evicts the dead worker from the
 routing table and fails the in-flight request over to a live one.
 """
 
+import json
 import os
 import sys
 import urllib.request
@@ -64,6 +65,32 @@ def test_kill_worker_service_keeps_answering(fleet):
     assert dead_addr not in fleet.routing_table()["default"]
     assert len(fleet.routing_table()["default"]) == 2
     assert fleet.router.workers_evicted >= 1
+
+
+def test_front_door_metrics_aggregate_worker_processes(fleet):
+    """Fleet observability across REAL process boundaries: each worker's
+    registry snapshot rides in its /metrics?format=json reply and the front
+    door merges them — request counters sum across distinct registries and
+    the merged latency histogram yields a fleet p50."""
+    n = 9
+    for _ in range(n):
+        _hit(fleet.address)
+    text = urllib.request.urlopen(fleet.address + "/metrics",
+                                  timeout=15).read().decode()
+    assert "smt_serving_latency_seconds_bucket" in text
+    assert "smt_routing_requests_total" in text
+    snap = json.loads(urllib.request.urlopen(
+        fleet.address + "/metrics?format=json", timeout=15).read().decode())
+    req = snap["families"]["smt_serving_requests_total"]["series"]
+    # only THIS fleet's workers (the process-default registry may also carry
+    # servers from other tests in the session): one series per worker
+    # process, and the merged counters sum to the traffic sent
+    worker_labels = {a.removeprefix("http://") for a in fleet.addresses}
+    mine = [s for s in req if s["labels"][0] in worker_labels]
+    assert len(mine) == 3
+    assert sum(s["value"] for s in mine) == n
+    p50 = fleet.latency_p50()
+    assert p50 is not None and p50 > 0
 
 
 def test_kill_all_workers_returns_5xx(fleet):

@@ -66,9 +66,10 @@ import jax, jax.numpy as jnp
 # start; re-assert cpu before the backend initializes (same remedy as
 # __graft_entry__ / tests/conftest.py)
 jax.config.update("jax_platforms", "cpu")
-from jax import lax, shard_map
+from jax import lax
 from jax.sharding import PartitionSpec as P
-from synapseml_tpu.runtime.topology import best_mesh_shape, make_mesh
+from synapseml_tpu.runtime.topology import best_mesh_shape, make_mesh, \
+    shard_map_compat
 
 assert jax.device_count() == 32
 shape = best_mesh_shape(32, 2)
@@ -83,10 +84,10 @@ def reduce_both(xb):
     s = lax.psum(xb.sum(), "ici")
     return lax.psum(s, "dcn")[None]
 
-out = jax.jit(shard_map(reduce_both, mesh=mesh,
-                        in_specs=P(("ici", "dcn"), None),
-                        out_specs=P(("ici", "dcn")),
-                        check_vma=False))(x)
+out = jax.jit(shard_map_compat(reduce_both, mesh=mesh,
+                               in_specs=P(("ici", "dcn"), None),
+                               out_specs=P(("ici", "dcn")),
+                               check=False))(x)
 np.testing.assert_allclose(np.asarray(out)[0], x.sum(), rtol=1e-6)
 
 # distributed GBDT on the 32-device data axis (mesh reshaped flat)
