@@ -494,6 +494,64 @@ def bench_span_overhead(platform):
             "span_overhead_pct": round(span_us / base_us * 100.0, 2)}
 
 
+def bench_tracing_overhead(platform):
+    """Per-transform overhead of the TRACED hot path (request tracing on
+    top of stage spans): same methodology as ``observability_span_overhead``
+    — the bare per-span cost, measured inside an ACTIVE trace (contextvar
+    read + trace-span record + exemplar tag per stage span), against the
+    per-transform baseline of a cheap real stage with spans disabled.
+    Contract: the traced path stays within the same <5% budget as plain
+    spans (docs/observability.md)."""
+    from synapseml_tpu import observability
+    from synapseml_tpu.core import Table, UnaryTransformer
+    from synapseml_tpu.observability import tracing
+    from synapseml_tpu.observability.spans import stage_span
+
+    class _TraceBenchScale(UnaryTransformer):  # _ prefix: not registered
+        def _transform_column(self, col, table):
+            return (col - col.mean()) / (col.std() + 1e-12)
+
+    table = Table({"input": np.random.default_rng(6).normal(size=100_000)})
+    stage = _TraceBenchScale()
+    stage.transform(table)  # warm (cold-span + lazy allocation)
+
+    n_span = 100_000
+    # isolated tracer: sample_rate=0 so the loop measures the record path
+    # without retaining 100k bench traces; span-cap behavior is exercised
+    # (one long-running "request" trace fusing many stage spans)
+    tracer = tracing.Tracer(capacity=64, sample_rate=0.0,
+                            latency_threshold_s=1e9)
+    prev_tracer = tracing.set_tracer(tracer)
+
+    def traced_loop():
+        with tracing.start_span("request", parent=None, tracer=tracer):
+            for _ in range(n_span):
+                with stage_span(stage, "transform") as sp:
+                    sp.set_rows(100_000)
+
+    try:
+        traced_loop()  # untimed warm pass
+        traced_us = _best_of(3, traced_loop) / n_span * 1e6
+    finally:
+        tracing.set_tracer(prev_tracer)
+
+    n = 300
+
+    def run():
+        for _ in range(n):
+            stage.transform(table)
+
+    enabled_before = observability.is_enabled()
+    try:
+        observability.disable()
+        base_us = _best_of(5, run) / n * 1e6
+    finally:
+        (observability.enable if enabled_before else observability.disable)()
+    return {"per_transform_base_us": round(base_us, 2),
+            "traced_span_cost_us": round(traced_us, 3),
+            "tracing_overhead_pct": round(traced_us / base_us * 100.0, 2)}
+
+
 def _balanced_json_at(s: str, start: int):
     """Parse the balanced ``{...}`` object starting at ``s[start]`` (which
     must be ``{``); None if unterminated or invalid."""
@@ -616,6 +674,75 @@ def _load_prev_round(here=None):
     return None
 
 
+# ---------------------------------------------------------------------------
+# regression ratchet: committed rounds must not carry an unwaived per-lane
+# regression (tests/test_bench_ratchet.py turns this into a FAILING test —
+# round 5 proved the advisory-JSON-only guard lets a 20% regression ship)
+# ---------------------------------------------------------------------------
+
+RATCHET_THRESHOLD = 0.95  # vs_prev_round per-lane ratio below this fails CI
+
+
+def load_waivers(path=None):
+    """Parse ``BENCH_ACKS.md`` waiver rows -> {(round, config)}.
+
+    The waiver file is a markdown table — a human-readable, reviewed
+    artifact (a waiver is a DECISION with a reason, not a config knob):
+
+        | round | config | ratio | reason |
+        |---|---|---|---|
+        | 5 | flash_attention_32k | 0.803 | two confounds changed ... |
+    """
+    import os
+    import re
+
+    if path is None:
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "BENCH_ACKS.md")
+    waivers = set()
+    if not os.path.exists(path):
+        return waivers
+    with open(path) as f:
+        for line in f:
+            m = re.match(r"\s*\|\s*(\d+)\s*\|\s*([A-Za-z0-9_]+)\s*\|", line)
+            if m:
+                waivers.add((int(m.group(1)), m.group(2)))
+    return waivers
+
+
+def unwaived_regressions(here=None, threshold=RATCHET_THRESHOLD,
+                         waivers=None):
+    """Scan every committed ``BENCH_r{N}.json`` (armored loader — damaged
+    artifacts recover what they can) for per-lane ``vs_prev_round`` ratios
+    below ``threshold`` without a ``BENCH_ACKS.md`` waiver. Returns
+    ``[(round, config, ratio), ...]`` — empty means the ratchet holds."""
+    import glob
+    import os
+    import re
+
+    if here is None:
+        here = os.path.dirname(os.path.abspath(__file__))
+    if waivers is None:
+        waivers = load_waivers(os.path.join(here, "BENCH_ACKS.md"))
+    offenders = []
+    for path in sorted(glob.glob(os.path.join(here, "BENCH_r*.json"))):
+        m = re.search(r"BENCH_r(\d+)\.json$", path)
+        if not m:
+            continue
+        rnd = int(m.group(1))
+        got = _load_round_file(path, rnd)
+        if got is None:
+            continue
+        _, _, extra = got
+        vpr = extra.get("vs_prev_round") or {}
+        for config, ratio in (vpr.get("per_config") or {}).items():
+            if not isinstance(ratio, (int, float)):
+                continue
+            if ratio < threshold and (rnd, config) not in waivers:
+                offenders.append((rnd, config, ratio))
+    return offenders
+
+
 # per-config primary metric (higher is better) used for round-over-round deltas
 _PRIMARY = {
     "resnet50_onnx": "images_per_sec_per_chip",
@@ -665,6 +792,7 @@ def main() -> None:
         ("flash_attention_32k", lambda: bench_flash_attention(platform, peak)),
         ("serving_latency", lambda: bench_serving(platform)),
         ("observability_span_overhead", lambda: bench_span_overhead(platform)),
+        ("tracing_overhead", lambda: bench_tracing_overhead(platform)),
     ]:
         try:
             extra[key] = fn()

@@ -12,9 +12,10 @@ from __future__ import annotations
 import collections
 import json
 import logging
+import sys
 import threading
 import time
-from typing import Any, Dict, List
+from typing import Any, Dict, List, Optional
 
 __all__ = ["log_stage_call", "recent_events", "clear_events", "drain_events",
            "get_logger", "set_event_capacity", "event_capacity",
@@ -49,6 +50,20 @@ def event_capacity() -> int:
         return _events.maxlen
 
 
+def _active_trace_id() -> Optional[str]:
+    """Trace id of the active request trace, or None. Looked up through
+    ``sys.modules`` rather than imported: core must not depend on the
+    observability package, and if tracing was never imported there cannot
+    be an active trace to report."""
+    tr = sys.modules.get("synapseml_tpu.observability.tracing")
+    if tr is None:
+        return None
+    try:
+        return tr.current_trace_id() if tr.is_enabled() else None
+    except Exception:
+        return None
+
+
 def log_stage_call(stage, method: str, **extra) -> None:
     """Record one structured stage-call event.
 
@@ -56,7 +71,9 @@ def log_stage_call(stage, method: str, **extra) -> None:
     in ``extra`` must be measured with the monotonic clock
     (``core.clock.StopWatch``) — wall-clock deltas jump under NTP slew.
     Aggregate timings live in ``synapseml_tpu.observability`` spans; this
-    event stream is the per-call view.
+    event stream is the per-call view. Events emitted while a request
+    trace is active carry its ``trace_id`` so the per-call view joins
+    against ``/traces``.
     """
     evt = {
         "uid": getattr(stage, "uid", "?"),
@@ -66,6 +83,9 @@ def log_stage_call(stage, method: str, **extra) -> None:
         "ts": time.time(),
         **extra,
     }
+    tid = _active_trace_id()
+    if tid is not None:
+        evt.setdefault("trace_id", tid)
     with _lock:
         _events.append(evt)
     if _logger.isEnabledFor(logging.DEBUG):
@@ -80,7 +100,11 @@ def profile_trace(trace_dir: str):
 
     The device trace shows per-HLO time, fusion boundaries, and HBM traffic
     — the data the engine's perf plateaus get debugged with. A telemetry
-    event records the capture so traces are discoverable after the fact.
+    event records the capture so traces are discoverable after the fact;
+    when a request trace is active (a traced serving path triggered the
+    capture), the event AND a ``profile_trace`` span carry its trace id, so
+    the XLA capture is discoverable straight from the ``/traces`` entry of
+    the request that paid for it.
 
     >>> from synapseml_tpu.core.telemetry import profile_trace
     >>> with profile_trace("/tmp/trace"):   # doctest: +SKIP
@@ -97,6 +121,9 @@ def profile_trace(trace_dir: str):
         evt = {"method": "profile_trace", "trace_dir": trace_dir,
                "className": "profiler", "uid": "profiler",
                "buildVersion": BUILD_VERSION, "ts": time.time()}
+        tid = _active_trace_id()
+        if tid is not None:
+            evt["trace_id"] = tid
         with _lock:
             _events.append(evt)
         # duration via the MONOTONIC clock (wall-clock deltas jump under NTP
@@ -107,6 +134,15 @@ def profile_trace(trace_dir: str):
                 yield trace_dir
         finally:
             evt["duration_s"] = sw.elapsed_s
+            if tid is not None:
+                tr = sys.modules.get("synapseml_tpu.observability.tracing")
+                if tr is not None:
+                    try:
+                        tr.get_tracer().record(
+                            "profile_trace", duration_s=sw.elapsed_s,
+                            attributes={"trace_dir": trace_dir})
+                    except Exception:
+                        pass  # tracing must never break a capture
 
     return _ctx()
 

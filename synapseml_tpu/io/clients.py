@@ -17,6 +17,7 @@ from concurrent.futures import ThreadPoolExecutor
 from typing import Iterable, Iterator, List, Optional, Sequence
 
 from ..core.telemetry import get_logger
+from ..observability import tracing
 from .http_schema import HTTPRequestData, HTTPResponseData
 
 __all__ = ["send_request", "send_with_retries", "AsyncHTTPClient"]
@@ -27,40 +28,73 @@ DEFAULT_BACKOFFS_MS = (100, 500, 1000)  # HandlingUtils default backoffs
 RETRY_CODES = frozenset({429, 500, 502, 503, 504})
 
 
-def send_request(req: HTTPRequestData, timeout: float = 60.0) -> HTTPResponseData:
-    """One HTTP exchange; HTTP errors come back as responses, not exceptions."""
-    r = urllib.request.Request(
-        req.url, data=req.entity, method=req.method,
-        headers=dict(req.headers),
-    )
+def send_request(req: HTTPRequestData, timeout: float = 60.0,
+                 trace_parent=None) -> HTTPResponseData:
+    """One HTTP exchange; HTTP errors come back as responses, not exceptions.
+
+    When a trace is active (an HTTP transformer running inside a traced
+    pipeline), the outbound request carries the W3C ``traceparent`` and the
+    exchange is recorded as an ``http.client`` child span, so downstream
+    service latency shows up inside the request's span tree.
+    ``trace_parent`` overrides the ambient context — pool threads don't
+    inherit contextvars, so :class:`AsyncHTTPClient` captures the caller's
+    span once and passes it here explicitly."""
+    headers = dict(req.headers)
+    span = None
+    if tracing.is_enabled():
+        parent = trace_parent if trace_parent is not None \
+            else tracing.current_span()
+        if parent is not None and not any(
+                k.lower() == tracing.TRACEPARENT_HEADER for k in headers):
+            span = parent.tracer.begin_span(
+                "http.client", parent=parent,
+                attributes={"url": req.url, "method": req.method})
+            tracing.inject_headers(headers, span)
     try:
+        r = urllib.request.Request(
+            req.url, data=req.entity, method=req.method, headers=headers,
+        )
         with urllib.request.urlopen(r, timeout=timeout) as resp:
-            return HTTPResponseData(
+            out = HTTPResponseData(
                 status_code=resp.status, reason=resp.reason or "",
                 headers=dict(resp.headers.items()), entity=resp.read(),
             )
     except urllib.error.HTTPError as e:
-        return HTTPResponseData(
+        out = HTTPResponseData(
             status_code=e.code, reason=str(e.reason),
             headers=dict(e.headers.items()) if e.headers else {},
             entity=e.read() if hasattr(e, "read") else None,
         )
     except (urllib.error.URLError, OSError) as e:
+        if span is not None:
+            span.end(error=e)
         return HTTPResponseData(status_code=0, reason=f"connection error: {e}")
+    except BaseException as e:
+        # unexpected (e.g. ValueError from a malformed URL): the span must
+        # not leak an open fragment in the tracer while the error surfaces
+        if span is not None:
+            span.end(error=e)
+        raise
+    if span is not None:
+        span.set_attribute("status", out.status_code)
+        span.end(error=f"HTTP {out.status_code}"
+                 if (out.status_code or 0) >= 500 else None)
+    return out
 
 
 def send_with_retries(req: HTTPRequestData, timeout: float = 60.0,
-                      backoffs_ms: Sequence[int] = DEFAULT_BACKOFFS_MS) -> HTTPResponseData:
+                      backoffs_ms: Sequence[int] = DEFAULT_BACKOFFS_MS,
+                      trace_parent=None) -> HTTPResponseData:
     """Retry retryable statuses through the backoff schedule
     (reference ``HandlingUtils.sendWithRetries``)."""
-    resp = send_request(req, timeout)
+    resp = send_request(req, timeout, trace_parent=trace_parent)
     for backoff in backoffs_ms:
         if resp.status_code not in RETRY_CODES and resp.status_code != 0:
             return resp
         _logger.info("retrying %s after status %s (%sms backoff)",
                      req.url, resp.status_code, backoff)
         time.sleep(backoff / 1000.0)
-        resp = send_request(req, timeout)
+        resp = send_request(req, timeout, trace_parent=trace_parent)
     return resp
 
 
@@ -81,10 +115,22 @@ class AsyncHTTPClient:
 
     def send(self, requests: Iterable[Optional[HTTPRequestData]]
              ) -> Iterator[Optional[HTTPResponseData]]:
+        # capture the caller's trace context HERE, at call time — the body
+        # below is a generator, which would otherwise defer the capture to
+        # the first next() (possibly after the caller's span ended, or in
+        # another thread); pool worker threads don't inherit contextvars,
+        # so each exchange parents explicitly
+        trace_parent = tracing.current_span() if tracing.is_enabled() \
+            else None
+        return self._send_iter(requests, trace_parent)
+
+    def _send_iter(self, requests, trace_parent
+                   ) -> Iterator[Optional[HTTPResponseData]]:
         def one(req):
             if req is None:
                 return None
-            return send_with_retries(req, self.timeout, self.backoffs_ms)
+            return send_with_retries(req, self.timeout, self.backoffs_ms,
+                                     trace_parent=trace_parent)
 
         with ThreadPoolExecutor(max_workers=self.concurrency) as pool:
             # buffered await: submit up to `concurrency` ahead, yield in order

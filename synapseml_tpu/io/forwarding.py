@@ -19,6 +19,8 @@ import subprocess
 import threading
 from typing import List, Optional, Tuple
 
+from ..observability import tracing
+
 __all__ = ["TcpForwarder", "forward_port_to_remote"]
 
 
@@ -70,17 +72,51 @@ class TcpForwarder:
                 client.close()
                 continue
             self.connections_forwarded += 1
+            # a TCP relay cannot inject HTTP headers (it never parses the
+            # stream), so each connection records as its own single-span
+            # trace in the flight recorder: target, lifetime, and bytes
+            # piped — enough to see which backend a slow connection hit
+            span = None
+            if tracing.is_enabled():
+                span = tracing.get_tracer().begin_span(
+                    "tcp.relay", parent=None,
+                    attributes={"target": f"{host}:{port}",
+                                "listen_port": self.port})
+                # connection LIFETIME, not latency: a long-lived healthy
+                # tunnel must not be tail-retained as a "slow" trace
+                span.slow_exempt = True
+            done = self._relay_closer(span)
             for a, b in ((client, upstream), (upstream, client)):
-                threading.Thread(target=self._pipe, args=(a, b),
+                threading.Thread(target=self._pipe, args=(a, b, done),
                                  daemon=True).start()
 
     @staticmethod
-    def _pipe(src: socket.socket, dst: socket.socket):
+    def _relay_closer(span):
+        """Both pipe directions report here; the last one to close ends
+        the connection span with the total bytes relayed."""
+        state = {"open": 2, "bytes": 0}
+        lock = threading.Lock()
+
+        def done(n_bytes: int) -> None:
+            with lock:
+                state["bytes"] += n_bytes
+                state["open"] -= 1
+                last = state["open"] == 0
+            if last and span is not None:
+                span.set_attribute("bytes", state["bytes"])
+                span.end()
+
+        return done
+
+    @staticmethod
+    def _pipe(src: socket.socket, dst: socket.socket, done=None):
+        n = 0
         try:
             while True:
                 data = src.recv(65536)
                 if not data:
                     break
+                n += len(data)
                 dst.sendall(data)
         except OSError:
             pass
@@ -89,6 +125,8 @@ class TcpForwarder:
                 dst.shutdown(socket.SHUT_WR)
             except OSError:
                 pass
+            if done is not None:
+                done(n)
 
     def stop(self) -> None:
         self._stop.set()

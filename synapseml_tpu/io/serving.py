@@ -17,6 +17,7 @@ one-liner.
 
 from __future__ import annotations
 
+import contextlib
 import json
 import threading
 import time
@@ -29,25 +30,32 @@ import numpy as np
 from ..core import Param, Table, Transformer
 from ..core.telemetry import get_logger
 from ..observability import CONTENT_TYPE as _PROM_CONTENT_TYPE
-from ..observability import get_registry, render_prometheus
+from ..observability import OPENMETRICS_CONTENT_TYPE as \
+    _OPENMETRICS_CONTENT_TYPE
+from ..observability import (get_registry, render_openmetrics,
+                             render_prometheus, tracing)
 from ..runtime.shared import shared_singleton
 from .http_schema import HTTPRequestData, HTTPResponseData
 
 __all__ = ["ServingServer", "MicroBatchServingEngine", "serve",
-           "serve_metrics_exposition",
+           "serve_metrics_exposition", "serve_traces_exposition",
            "request_to_string", "string_to_response"]
 
 _logger = get_logger("io.serving")
 
 
 class _Pending:
-    __slots__ = ("request", "response", "event", "t_enqueue")
+    __slots__ = ("request", "response", "event", "t_enqueue", "trace")
 
     def __init__(self, request: HTTPRequestData):
         self.request = request
         self.response: Optional[HTTPResponseData] = None
         self.event = threading.Event()
         self.t_enqueue = time.perf_counter()
+        # server-side request span (enqueue -> reply); begun in the handler
+        # thread, ended in respond() — continues the client's traceparent
+        # when one arrived, else roots a fresh trace
+        self.trace: Optional[tracing.TraceSpan] = None
 
 
 class ServingServer:
@@ -71,12 +79,17 @@ class ServingServer:
 
         class Handler(BaseHTTPRequestHandler):
             def _handle(self, method: str):
-                if method == "GET" and \
-                        self.path.partition("?")[0] == "/metrics":
+                op_path = self.path.partition("?")[0]
+                if method == "GET" and op_path == "/metrics":
                     # answered by the SERVER, not the pipeline: scrapes must
                     # work even when the engine is wedged, and must never
                     # occupy a micro-batch slot
                     serve_metrics_exposition(self)
+                    return
+                if method == "GET" and op_path == "/traces":
+                    # same rule for the flight recorder: reading traces of
+                    # a wedged engine is exactly when you need them
+                    serve_traces_exposition(self)
                     return
                 length = int(self.headers.get("Content-Length") or 0)
                 body = self.rfile.read(length) if length else None
@@ -85,21 +98,42 @@ class ServingServer:
                     headers=dict(self.headers.items()), entity=body)
                 rid = uuid.uuid4().hex
                 slot = _Pending(req)
+                if tracing.is_enabled():
+                    slot.trace = tracing.get_tracer().begin_span(
+                        "request",
+                        parent=tracing.extract_context(req.headers),
+                        attributes={"server": outer.server_label,
+                                    "method": method, "path": self.path})
                 with outer._lock:
                     outer._pending[rid] = slot
                     outer._queue.append(rid)
                     outer.requests_received += 1
                 outer._on_enqueue()
                 if not slot.event.wait(outer.reply_timeout):
-                    # raced reply? the engine may have set the response between
-                    # the timeout firing and this line — prefer the real reply
-                    if slot.response is None:
-                        with outer._lock:
-                            outer._pending.pop(rid, None)
+                    # the pop decides the race: whoever removes the slot
+                    # (this handler or a concurrent respond()) owns its
+                    # finalization — both ending the trace span would let
+                    # a request that was really answered 200 get recorded
+                    # in /traces as a 504 error trace
+                    with outer._lock:
+                        won = outer._pending.pop(rid, None) is not None
+                    if won:
+                        if slot.trace is not None:
+                            slot.trace.set_attribute("status", 504)
+                            slot.trace.end(error="serving engine timed out")
                         try:
                             self.send_error(504, "serving engine timed out")
                         except OSError:
                             pass  # client already gone
+                        return
+                    # respond() won the slot between the timeout firing and
+                    # the pop: the real reply is landing — wait it out
+                    slot.event.wait(5.0)
+                    if slot.response is None:  # respond() died mid-flight
+                        try:
+                            self.send_error(504, "serving engine timed out")
+                        except OSError:
+                            pass
                         return
                 resp = slot.response
                 try:
@@ -187,6 +221,13 @@ class ServingServer:
             del self._queue[:len(take)]
         return out
 
+    def _trace_slots(self, rids) -> List[_Pending]:
+        """The still-pending slots for a drained batch (trace plumbing —
+        ``get_requests`` pops the queue but keeps slots until reply)."""
+        with self._lock:
+            return [self._pending[rid] for rid in rids
+                    if rid in self._pending]
+
     def respond(self, rid: str, response: HTTPResponseData) -> None:
         with self._lock:
             slot = self._pending.pop(rid, None)
@@ -197,9 +238,24 @@ class ServingServer:
         slot.event.set()
         lat = time.perf_counter() - slot.t_enqueue
         self._latencies.append(lat)
+        exemplar = None
+        tr = slot.trace
+        if tr is not None:
+            status = response.status_code or 200
+            tr.set_attribute("status", status)
+            # a 5xx reply marks the trace as an ERROR trace (tail sampling
+            # always retains it); the span still measures enqueue->reply
+            tr.end(error=f"HTTP {status}" if status >= 500 else None)
+            # only point /metrics at a trace the tail sampler KEPT — the
+            # root just ended, so the retention decision is known here,
+            # and a dangling exemplar is worse than none
+            if tr.tracer.is_retained(tr.trace_id):
+                exemplar = tr.trace_id
         # same sample into the MERGEABLE histogram: fleet quantiles come
-        # from these buckets combined across workers (merge.py)
-        self._m_latency.observe(lat)
+        # from these buckets combined across workers (merge.py). The
+        # exemplar is passed explicitly — respond() runs after the
+        # pipeline span closed, so there is no ambient trace here.
+        self._m_latency.observe(lat, exemplar=exemplar)
 
     def latency_quantile(self, q: float = 0.5) -> Optional[float]:
         """Enqueue->reply latency quantile in seconds over recent requests."""
@@ -216,6 +272,9 @@ class ServingServer:
         for _rid, slot in pending:
             slot.response = HTTPResponseData(503, "server shutting down")
             slot.event.set()
+            if slot.trace is not None:
+                slot.trace.set_attribute("status", 503)
+                slot.trace.end(error="server shutting down")
         self._httpd.shutdown()
         self._httpd.server_close()
         # retire this server's series + collector: ephemeral ports mean a
@@ -245,10 +304,14 @@ def engine_metrics(reg, server_label: str, engine: str):
 def serve_metrics_exposition(handler, snapshot: Optional[dict] = None) -> None:
     """Answer a ``/metrics`` GET on ``handler`` (a BaseHTTPRequestHandler).
 
-    Default: Prometheus text format of ``snapshot`` (the process-default
-    registry when omitted). ``?format=json`` returns the raw registry
-    snapshot — the machine-readable side the routing front door scrapes and
-    merges (snapshots ride in ordinary worker replies; no side channel).
+    Content negotiation: an ``Accept`` header naming
+    ``application/openmetrics-text`` gets the OpenMetrics rendering WITH
+    per-bucket trace-id exemplars (exemplar syntax is OpenMetrics-only — a
+    0.0.4 parser would fail the whole scrape on it, so the plain text
+    default stays exemplar-free). ``?format=json`` returns the raw registry
+    snapshot (exemplars included) — the machine-readable side the routing
+    front door scrapes and merges (snapshots ride in ordinary worker
+    replies; no side channel).
     """
     if snapshot is None:
         snapshot = get_registry().snapshot()
@@ -256,6 +319,9 @@ def serve_metrics_exposition(handler, snapshot: Optional[dict] = None) -> None:
     if "format=json" in query.split("&"):
         body = json.dumps(snapshot).encode()
         ctype = "application/json"
+    elif "openmetrics-text" in (handler.headers.get("Accept") or ""):
+        body = render_openmetrics(snapshot).encode()
+        ctype = _OPENMETRICS_CONTENT_TYPE
     else:
         body = render_prometheus(snapshot).encode()
         ctype = _PROM_CONTENT_TYPE
@@ -267,6 +333,62 @@ def serve_metrics_exposition(handler, snapshot: Optional[dict] = None) -> None:
         handler.wfile.write(body)
     except OSError:
         pass  # scraper went away
+
+
+def serve_traces_exposition(handler, payload: Optional[dict] = None) -> None:
+    """Answer a ``/traces`` GET on ``handler``: the tail-sampled flight
+    recorder as JSON (``payload`` overrides — the routing front door passes
+    its stitched fleet view). Always JSON; ``tools/trace_dump.py`` renders
+    the waterfall client-side."""
+    if payload is None:
+        payload = tracing.get_tracer().snapshot()
+    body = json.dumps(payload).encode()
+    try:
+        handler.send_response(200)
+        handler.send_header("Content-Type", "application/json")
+        handler.send_header("Content-Length", str(len(body)))
+        handler.end_headers()
+        handler.wfile.write(body)
+    except OSError:
+        pass  # reader went away
+
+
+@contextlib.contextmanager
+def traced_batch(server: ServingServer, rids, engine: str):
+    """Per-batch trace plumbing shared by the micro-batch and continuous
+    engines: closes each traced request's ``queue_wait`` span (enqueue ->
+    drain) and runs the pipeline under ONE ``pipeline`` span parented to
+    the first traced request, ACTIVATED in this thread so stage spans
+    attach as children. Micro-batch fusion gives N requests one pipeline
+    execution — a span tree is single-parent, so the batch leader owns the
+    pipeline subtree and the other fused requests' spans carry the
+    leader's trace id as ``fused_with``."""
+    if not tracing.is_enabled():
+        yield
+        return
+    traced = [s for s in server._trace_slots(rids) if s.trace is not None]
+    if not traced:
+        yield
+        return
+    now = time.perf_counter()
+    tracer = traced[0].trace.tracer
+    for s in traced:
+        tracer.record("queue_wait", parent=s.trace,
+                      duration_s=max(0.0, now - s.t_enqueue))
+    leader = traced[0].trace
+    for s in traced[1:]:
+        s.trace.set_attribute("fused_with", leader.trace_id)
+    pipeline_span = tracer.begin_span(
+        "pipeline", parent=leader,
+        attributes={"engine": engine, "batch_size": len(rids)})
+    try:
+        with tracing.use_span(pipeline_span):
+            yield
+    except BaseException as e:
+        pipeline_span.end(error=e)
+        raise
+    else:
+        pipeline_span.end()
 
 
 class MicroBatchServingEngine:
@@ -323,9 +445,13 @@ class MicroBatchServingEngine:
             reqs[:] = [r for _, r in batch]
             table = Table({"id": np.array(ids, dtype=object), "request": reqs})
             try:
-                out = self.pipeline.transform(table)
-                replies = out[self.reply_col]
-                out_ids = out["id"]
+                with traced_batch(self.server, ids, "microbatch"):
+                    out = self.pipeline.transform(table)
+                    replies = out[self.reply_col]
+                    out_ids = out["id"]
+                    # observed INSIDE the batch trace so the bucket gets
+                    # the leader request's exemplar
+                    self._m_batch_size.observe(len(batch))
             except Exception as e:  # reply 500s rather than hanging clients
                 _logger.exception("serving pipeline failed")
                 for rid in ids:
@@ -336,7 +462,6 @@ class MicroBatchServingEngine:
                 continue
             respond_batch(self.server, ids, out_ids, replies)
             self.batches_processed += 1
-            self._m_batch_size.observe(len(batch))
 
     def stop(self) -> None:
         self._stop.set()

@@ -36,10 +36,12 @@ import numpy as np
 
 from ..core import Table, Transformer
 from ..core.telemetry import get_logger
-from ..observability import get_registry, histogram_quantile, merge_snapshots
+from ..observability import (get_registry, histogram_quantile,
+                             merge_snapshots, merge_traces, tracing)
 from .http_schema import HTTPResponseData
 from .serving import (MicroBatchServingEngine, ServingServer, engine_metrics,
-                      respond_batch, serve_metrics_exposition)
+                      respond_batch, serve_metrics_exposition,
+                      serve_traces_exposition, traced_batch)
 
 __all__ = ["ContinuousServingEngine", "DistributedServingEngine",
            "ProcessServingFleet", "ServiceRegistry", "RoutingServer",
@@ -96,8 +98,12 @@ class ContinuousServingEngine:
         reqs[:] = [r for _, r in batch]
         table = Table({"id": np.array(ids, dtype=object), "request": reqs})
         try:
-            out = self.pipeline.transform(table)
-            replies, out_ids = out[self.reply_col], out["id"]
+            with traced_batch(self.server, ids, "continuous"):
+                out = self.pipeline.transform(table)
+                replies, out_ids = out[self.reply_col], out["id"]
+                # inside the batch trace: the bucket gets the leader
+                # request's exemplar
+                self._m_batch_size.observe(len(batch))
         except Exception as e:
             _logger.exception("continuous serving pipeline failed")
             for rid in ids:
@@ -109,7 +115,6 @@ class ContinuousServingEngine:
         respond_batch(self.server, ids, out_ids, replies)
         self.batches_processed += 1
         self.requests_processed += len(batch)
-        self._m_batch_size.observe(len(batch))
 
     def latency_p50(self) -> Optional[float]:
         return self.server.latency_quantile(0.5)
@@ -170,14 +175,19 @@ class RoutingServer:
             def _forward(self, method: str):
                 import socket as _socket
 
-                if method == "GET" and \
-                        self.path.partition("?")[0] == "/metrics":
+                op_path = self.path.partition("?")[0]
+                if method == "GET" and op_path == "/metrics":
                     # the FLEET view: this front door scrapes every worker's
                     # /metrics?format=json reply (the snapshot rides in the
                     # ordinary HTTP reply — no side channel) and merges.
                     # Worker histograms share the fixed bucket layout, so
                     # fleet quantiles come from the combined distribution.
                     serve_metrics_exposition(self, outer.fleet_snapshot())
+                    return
+                if method == "GET" and op_path == "/traces":
+                    # stitched fleet traces: worker fragments merge into
+                    # the routed trace by trace id (merge.merge_traces)
+                    serve_traces_exposition(self, outer.fleet_traces())
                     return
                 targets = outer.registry.lookup(outer.service)
                 if not targets:
@@ -186,6 +196,17 @@ class RoutingServer:
                 length = int(self.headers.get("Content-Length") or 0)
                 body = self.rfile.read(length) if length else None
                 start = next(outer._rr)
+                # the ROUTED trace's root (or, when the client sent its own
+                # traceparent, the local root continuing the client trace):
+                # every worker-side span hangs off this via the header the
+                # forward loop injects
+                route_span = None
+                if tracing.is_enabled():
+                    route_span = tracing.get_tracer().begin_span(
+                        "route",
+                        parent=tracing.extract_context(self.headers),
+                        attributes={"server": f"{outer.host}:{outer.port}",
+                                    "method": method, "path": self.path})
                 # FAILOVER: a DEAD worker (connection refused/reset) is
                 # dropped from the routing table and the request retries the
                 # next one — a worker death mid-stream must not surface to
@@ -209,30 +230,55 @@ class RoutingServer:
                 idempotent = method in ("GET", "HEAD")
                 timed_out = False
                 reply = None  # (status, content_type, entity)
+                # hop-by-hop-ish headers the ROUTER owns. When tracing is
+                # ON, traceparent is replaced with the per-attempt forward
+                # span's context so the worker's spans nest under THIS hop;
+                # when tracing is OFF the client's own traceparent passes
+                # through untouched — a disabled router must not sever the
+                # client->worker trace.
+                drop = {"host", "content-length"}
+                if route_span is not None:
+                    drop.add("traceparent")
+                fwd_headers = {k: v for k, v in self.headers.items()
+                               if k.lower() not in drop}
                 for k in range(len(targets)):
                     target = targets[(start + k) % len(targets)]
+                    fwd_span = None
+                    if route_span is not None:
+                        fwd_span = route_span.tracer.begin_span(
+                            "forward", parent=route_span,
+                            attributes={"target": target, "attempt": k})
+                        tracing.inject_headers(fwd_headers, fwd_span)
                     fwd = urllib.request.Request(
                         target + self.path, data=body, method=method,
-                        headers={k: v for k, v in self.headers.items()
-                                 if k.lower() not in ("host",
-                                                      "content-length")})
+                        headers=dict(fwd_headers))
                     try:
                         with urllib.request.urlopen(
                                 fwd, timeout=outer.timeout) as r:
                             reply = (r.status,
                                      r.headers.get("Content-Type"), r.read())
+                        if fwd_span is not None:
+                            fwd_span.set_attribute("status", reply[0])
+                            fwd_span.end()
                         break
                     except urllib.error.HTTPError as e:
                         # the worker ANSWERED (an application error): relay
                         # it, this is not a routing fault
                         reply = (e.code, None, e.read())
+                        if fwd_span is not None:
+                            fwd_span.set_attribute("status", e.code)
+                            fwd_span.end()
                         break
-                    except (TimeoutError, _socket.timeout):
+                    except (TimeoutError, _socket.timeout) as e:
+                        if fwd_span is not None:
+                            fwd_span.end(error=e)
                         if not idempotent:
                             timed_out = True
                             break
                         continue  # alive but slow: fail over, keep it
                     except urllib.error.URLError as e:
+                        if fwd_span is not None:
+                            fwd_span.end(error=e)
                         if isinstance(e.reason, (TimeoutError,
                                                  _socket.timeout)):
                             if not idempotent:
@@ -244,12 +290,25 @@ class RoutingServer:
                         _logger.warning("evicted unreachable worker %s",
                                         target)
                         continue
-                    except OSError:
+                    except OSError as e:
+                        if fwd_span is not None:
+                            fwd_span.end(error=e)
                         outer.registry.unregister(outer.service, target)
                         outer.workers_evicted += 1
                         _logger.warning("evicted unreachable worker %s",
                                         target)
                         continue
+                if route_span is not None:
+                    if reply is None:
+                        route_span.set_attribute(
+                            "status", 504 if timed_out else 502)
+                        route_span.end(
+                            error="worker timed out (not retried)"
+                            if timed_out else "no reachable workers")
+                    else:
+                        route_span.set_attribute("status", reply[0])
+                        route_span.end(error=f"HTTP {reply[0]}"
+                                       if reply[0] >= 500 else None)
                 # client write OUTSIDE the failover loop: a client that
                 # hung up must not evict a healthy worker or re-send the
                 # request (duplicate side effects)
@@ -308,6 +367,27 @@ class RoutingServer:
     def address(self) -> str:
         return f"http://{self.host}:{self.port}"
 
+    def _scrape_workers(self, path: str) -> List[dict]:
+        """Fetch ``path`` as JSON from every registered worker,
+        concurrently (one wedged worker costs its own timeout, not
+        timeout x fleet size serialized inside the handler thread);
+        unreachable workers are skipped — a scrape must not fail because
+        one worker died."""
+        from ..core.clock import buffered_map
+
+        def scrape(target):
+            try:
+                with urllib.request.urlopen(
+                        target + path,
+                        timeout=min(self.timeout, 5.0)) as r:
+                    return json.loads(r.read().decode())
+            except Exception:
+                return None
+
+        return [p for p in buffered_map(
+            scrape, self.registry.lookup(self.service), concurrency=8)
+            if p is not None]
+
     def fleet_snapshot(self) -> dict:
         """Merged registry snapshot: this process's registry + every
         registered worker's ``/metrics?format=json`` reply.
@@ -315,26 +395,19 @@ class RoutingServer:
         In-process fleets share the process-default registry, so the scraped
         snapshots carry the SAME ``registry_id`` and dedupe instead of
         double-counting; cross-process workers have distinct ids and sum
-        (``observability.merge``). Unreachable workers are skipped — a
-        scrape must not fail because one worker died."""
-        from ..core.clock import buffered_map
+        (``observability.merge``)."""
+        return merge_snapshots([get_registry().snapshot()]
+                               + self._scrape_workers("/metrics?format=json"))
 
-        def scrape(target):
-            try:
-                with urllib.request.urlopen(
-                        target + "/metrics?format=json",
-                        timeout=min(self.timeout, 5.0)) as r:
-                    return json.loads(r.read().decode())
-            except Exception:
-                return None
-
-        # concurrent scrape: one wedged worker costs its own timeout, not
-        # timeout x fleet size serialized inside the handler thread
-        snaps = [get_registry().snapshot()]
-        snaps += [s for s in buffered_map(scrape,
-                                          self.registry.lookup(self.service),
-                                          concurrency=8) if s is not None]
-        return merge_snapshots(snaps)
+    def fleet_traces(self) -> dict:
+        """Stitched fleet trace view: this process's flight recorder plus
+        every registered worker's ``/traces`` reply, merged BY TRACE ID
+        (``observability.merge_traces``) — a routed request's ``route``/
+        ``forward`` spans (recorded here) and its ``request``/``pipeline``/
+        stage spans (recorded in the worker process) reassemble into one
+        span tree because the forward hop carried the ``traceparent``."""
+        return merge_traces([tracing.get_tracer().snapshot()]
+                            + self._scrape_workers("/traces"))
 
     def close(self) -> None:
         self._httpd.shutdown()
@@ -415,7 +488,8 @@ class ProcessServingFleet:
                  service: str = "default", host: str = "127.0.0.1",
                  mode: str = "continuous", reply_timeout: float = 30.0,
                  startup_timeout: float = 60.0,
-                 import_modules: Optional[List[str]] = None):
+                 import_modules: Optional[List[str]] = None,
+                 trace_knobs: Optional[Dict[str, float]] = None):
         import os
         import subprocess
         import sys
@@ -438,6 +512,15 @@ class ProcessServingFleet:
                stage_path, "--host", host, "--mode", mode]
         for mod in (import_modules or []):
             cmd += ["--import-module", mod]
+        # tail-sampling knobs for the worker processes' flight recorders
+        # (keys: sample_rate, slow_ms, capacity); unset keys keep the
+        # worker's env/default configuration
+        for key, flag, conv in (("sample_rate", "--trace-sample-rate", str),
+                                ("slow_ms", "--trace-slow-ms", str),
+                                ("capacity", "--trace-capacity",
+                                 lambda v: str(int(v)))):
+            if trace_knobs and trace_knobs.get(key) is not None:
+                cmd += [flag, conv(trace_knobs[key])]
         import select
         import shutil
         import time
@@ -511,6 +594,12 @@ class ProcessServingFleet:
         """Merged fleet snapshot (router + every live worker PROCESS — each
         worker's registry rides in its ``/metrics?format=json`` reply)."""
         return self.router.fleet_snapshot()
+
+    def traces_snapshot(self) -> dict:
+        """Stitched fleet traces: router fragments + worker-process
+        fragments merged by trace id (what ``GET /traces`` on the front
+        door serves)."""
+        return self.router.fleet_traces()
 
     def latency_p50(self) -> Optional[float]:
         """Fleet p50 across worker processes, from merged histogram buckets

@@ -32,6 +32,16 @@ def main(argv=None) -> int:
     ap.add_argument("--import-module", action="append", default=[],
                     help="module(s) to import before loading the stage "
                          "(registers user-defined stage classes)")
+    # flight-recorder (tail-sampling) knobs; defaults come from the
+    # SMT_TRACE_* environment, so only pass these to override per worker
+    ap.add_argument("--trace-sample-rate", type=float, default=None,
+                    help="probability of keeping a fast, error-free trace")
+    ap.add_argument("--trace-slow-ms", type=float, default=None,
+                    help="latency above which a trace is always retained")
+    # float-tolerant (a launcher passing 256.0 must not kill the worker at
+    # argparse time); the Tracer constructor truncates to int
+    ap.add_argument("--trace-capacity", type=float, default=None,
+                    help="total traces kept in the ring")
     args = ap.parse_args(argv)
 
     import importlib
@@ -40,8 +50,18 @@ def main(argv=None) -> int:
         importlib.import_module(mod)
 
     from ..core.serialization import load_stage
+    from ..observability import tracing
     from .serving import MicroBatchServingEngine, ServingServer
     from .serving_v2 import ContinuousServingEngine
+
+    if (args.trace_sample_rate is not None or args.trace_slow_ms is not None
+            or args.trace_capacity is not None):
+        tracing.set_tracer(tracing.Tracer(
+            capacity=args.trace_capacity,
+            sample_rate=args.trace_sample_rate,
+            latency_threshold_s=(args.trace_slow_ms / 1e3
+                                 if args.trace_slow_ms is not None
+                                 else None)))
 
     pipeline = load_stage(args.stage_path)
     server = ServingServer(args.host, args.port)

@@ -1,46 +1,77 @@
-"""Observability subsystem: metrics registry, stage spans, fleet exposition.
+"""Observability subsystem: metrics registry, stage spans, request tracing,
+fleet exposition.
 
 What the reference covers with ``BasicLogging`` + ``StopWatch`` phase
-timing, rebuilt as first-class metrics (docs/observability.md):
+timing, rebuilt as first-class telemetry (docs/observability.md):
 
 - :mod:`.metrics` — thread-safe ``Counter``/``Gauge``/``Histogram``
   families in a :class:`MetricsRegistry`; histograms share one fixed
-  log-spaced bucket layout so they merge exactly across workers.
+  log-spaced bucket layout so they merge exactly across workers, and
+  buckets carry trace-id **exemplars** while a trace is active.
 - :mod:`.spans` — ``span(...)`` / per-stage instrumentation wired through
   ``core/stage.py`` (wall time, row counts, cold/warm compile split);
   ``enable()``/``disable()`` gate SPAN recording specifically. Serving and
   GBDT engine metrics are not gated: they are per-reply/per-iteration (not
   per-row), and the fleet latency quantiles depend on them.
-- :mod:`.exposition` — hand-rolled Prometheus text format for the
-  ``/metrics`` endpoints on the serving servers (``io/serving*.py``).
+- :mod:`.tracing` — distributed request tracing: W3C ``traceparent``
+  propagation over HTTP, span trees through a contextvar, and a bounded
+  tail-sampled flight recorder exposed at ``/traces`` on every serving
+  server (``tracing.enable()``/``tracing.disable()`` gate it).
+- :mod:`.exposition` — hand-rolled Prometheus text format (incl.
+  OpenMetrics exemplar syntax) for the ``/metrics`` endpoints on the
+  serving servers (``io/serving*.py``).
 - :mod:`.merge` — snapshot merging + ``histogram_quantile`` so fleet
   quantiles come from combined bucket counts, not averaged per-worker
-  quantiles.
+  quantiles; ``merge_traces`` stitches worker trace fragments into the
+  routed trace by trace id.
 
 Stdlib-only; never imports jax (the no-jax-at-import gate covers this
 package — ``tests/test_import_hygiene.py``).
 """
 
-from .exposition import CONTENT_TYPE, render_prometheus
-from .merge import histogram_quantile, merge_snapshots
+from . import tracing
+from .exposition import (CONTENT_TYPE, OPENMETRICS_CONTENT_TYPE,
+                         render_openmetrics, render_prometheus)
+from .merge import histogram_quantile, merge_snapshots, merge_traces
 from .metrics import (DEFAULT_BUCKETS, MetricFamily, MetricsRegistry,
                       get_registry, set_registry)
 from .spans import Span, disable, enable, is_enabled, span, stage_span
+from .tracing import (SpanContext, Tracer, TraceSpan, current_span,
+                      current_trace_id, extract_context, format_traceparent,
+                      get_tracer, inject_headers, parse_traceparent,
+                      set_tracer, start_span, use_span)
 
 __all__ = [
     "CONTENT_TYPE",
     "DEFAULT_BUCKETS",
     "MetricFamily",
     "MetricsRegistry",
+    "OPENMETRICS_CONTENT_TYPE",
     "Span",
+    "SpanContext",
+    "TraceSpan",
+    "Tracer",
+    "current_span",
+    "current_trace_id",
     "disable",
     "enable",
+    "extract_context",
+    "format_traceparent",
     "get_registry",
+    "get_tracer",
     "histogram_quantile",
+    "inject_headers",
     "is_enabled",
     "merge_snapshots",
+    "merge_traces",
+    "parse_traceparent",
+    "render_openmetrics",
     "render_prometheus",
     "set_registry",
+    "set_tracer",
     "span",
     "stage_span",
+    "start_span",
+    "tracing",
+    "use_span",
 ]

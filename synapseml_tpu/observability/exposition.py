@@ -10,9 +10,12 @@ from __future__ import annotations
 
 from typing import Any, Dict
 
-__all__ = ["render_prometheus", "CONTENT_TYPE"]
+__all__ = ["render_prometheus", "render_openmetrics", "CONTENT_TYPE",
+           "OPENMETRICS_CONTENT_TYPE"]
 
 CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+OPENMETRICS_CONTENT_TYPE = \
+    "application/openmetrics-text; version=1.0.0; charset=utf-8"
 
 
 def _escape_help(s: str) -> str:
@@ -49,30 +52,72 @@ def _labelstr(labelnames, labelvalues, extra: str = "") -> str:
     return "{" + ",".join(parts) + "}" if parts else ""
 
 
-def render_prometheus(snapshot: Dict[str, Any]) -> str:
-    """Snapshot -> Prometheus text format. Histogram buckets render
+def _exemplar_suffix(s: Dict[str, Any], i: int) -> str:
+    """OpenMetrics exemplar for bucket index ``i``:
+    `` # {trace_id="…"} <value> <ts>`` — the link from a histogram bucket
+    to a concrete trace in ``/traces``. Empty when the bucket has none."""
+    ex = (s.get("exemplars") or {}).get(str(i))
+    if not ex:
+        return ""
+    tid, v, ts = ex[0], ex[1], ex[2]
+    return (f' # {{trace_id="{_escape_label(str(tid))}"}} '
+            f"{_fmt(v)} {format(float(ts), '.3f')}")
+
+
+def render_openmetrics(snapshot: Dict[str, Any]) -> str:
+    """Snapshot -> OpenMetrics text (exemplars included, ``# EOF``
+    terminated, counter metadata named without the ``_total`` suffix as
+    the OM spec requires). This is what ``/metrics`` serves to scrapers
+    whose ``Accept`` header asks for ``application/openmetrics-text`` —
+    which standard Prometheus sends by default, so this rendering must be
+    SPEC-VALID OpenMetrics, not just 0.0.4-plus-exemplars: an OM parser
+    rejects a counter family named ``*_total`` and fails the whole
+    scrape."""
+    return render_prometheus(snapshot, exemplars=True,
+                             _openmetrics=True) + "# EOF\n"
+
+
+def render_prometheus(snapshot: Dict[str, Any],
+                      exemplars: bool = False,
+                      _openmetrics: bool = False) -> str:
+    """Snapshot -> Prometheus 0.0.4 text format. Histogram buckets render
     cumulatively with the ``le`` label plus ``_sum``/``_count``, per the
-    exposition spec."""
+    exposition spec. ``exemplars=True`` appends each bucket's exemplar (a
+    traced request that landed there) in OpenMetrics exemplar syntax —
+    only valid when served as OpenMetrics (see :func:`render_openmetrics`);
+    the 0.0.4 default omits them so standard Prometheus scrapes never
+    break."""
     lines = []
     for name in sorted((snapshot.get("families") or {})):
         fam = snapshot["families"][name]
         typ = fam["type"]
         labelnames = fam.get("labelnames", [])
-        lines.append(f"# HELP {name} {_escape_help(fam.get('help', ''))}")
-        lines.append(f"# TYPE {name} {typ}")
+        # OpenMetrics names counter FAMILIES without the _total suffix
+        # (samples keep it); every counter here follows the *_total
+        # convention, so this is a pure metadata rename
+        meta = name[:-len("_total")] if (_openmetrics and typ == "counter"
+                                         and name.endswith("_total")) \
+            else name
+        lines.append(f"# HELP {meta} {_escape_help(fam.get('help', ''))}")
+        lines.append(f"# TYPE {meta} {typ}")
         for s in fam.get("series", []):
             lv = s["labels"]
             if typ == "histogram":
                 cum = 0
-                for b, c in zip(fam["buckets"], s["counts"]):
+                for i, (b, c) in enumerate(zip(fam["buckets"], s["counts"])):
                     cum += c
                     le = 'le="' + _fmt(b) + '"'
+                    ex = _exemplar_suffix(s, i) if exemplars else ""
                     lines.append(
-                        f"{name}_bucket{_labelstr(labelnames, lv, le)} {cum}")
-                cum += s["counts"][len(fam["buckets"])]
+                        f"{name}_bucket{_labelstr(labelnames, lv, le)} "
+                        f"{cum}{ex}")
+                n_finite = len(fam["buckets"])
+                cum += s["counts"][n_finite]
                 inf = 'le="+Inf"'
+                ex = _exemplar_suffix(s, n_finite) if exemplars else ""
                 lines.append(
-                    f"{name}_bucket{_labelstr(labelnames, lv, inf)} {cum}")
+                    f"{name}_bucket{_labelstr(labelnames, lv, inf)} "
+                    f"{cum}{ex}")
                 lines.append(f"{name}_sum{_labelstr(labelnames, lv)} "
                              f"{_fmt(s['sum'])}")
                 lines.append(f"{name}_count{_labelstr(labelnames, lv)} "

@@ -18,8 +18,33 @@ from __future__ import annotations
 from typing import Any, Dict, Iterable, List, Optional, Sequence
 
 from .metrics import bucket_quantile
+from .tracing import _merge_trace_entries
 
-__all__ = ["merge_snapshots", "histogram_quantile"]
+__all__ = ["merge_snapshots", "histogram_quantile", "merge_traces"]
+
+
+def _copy_series(s: Dict[str, Any]) -> Dict[str, Any]:
+    out = dict(s, labels=list(s["labels"]))
+    if "counts" in s:
+        out["counts"] = list(s["counts"])
+    if s.get("exemplars"):
+        out["exemplars"] = {k: list(v) for k, v in s["exemplars"].items()}
+    return out
+
+
+def _merge_exemplars(tgt: Dict[str, Any], src: Dict[str, Any]) -> None:
+    """Per-bucket exemplar merge: the most recent wall-clock observation
+    wins (a fleet exemplar should point at the freshest traced request
+    that landed in the bucket, whichever worker served it)."""
+    se = src.get("exemplars")
+    if not se:
+        return
+    te = tgt.setdefault("exemplars", {})
+    for k, ex in se.items():
+        old = te.get(k)
+        if old is None or (ex[2] if len(ex) > 2 else 0) >= \
+                (old[2] if len(old) > 2 else 0):
+            te[k] = list(ex)
 
 
 def merge_snapshots(snapshots: Sequence[Dict[str, Any]]) -> Dict[str, Any]:
@@ -47,9 +72,7 @@ def merge_snapshots(snapshots: Sequence[Dict[str, Any]]) -> Dict[str, Any]:
                 merged_fams[name] = {
                     "type": fam["type"], "help": fam.get("help", ""),
                     "labelnames": list(fam.get("labelnames", [])),
-                    "series": [dict(s, labels=list(s["labels"]),
-                                    **({"counts": list(s["counts"])}
-                                       if "counts" in s else {}))
+                    "series": [_copy_series(s)
                                for s in fam.get("series", [])],
                     **({"buckets": list(fam["buckets"])}
                        if fam.get("buckets") else {}),
@@ -65,9 +88,7 @@ def merge_snapshots(snapshots: Sequence[Dict[str, Any]]) -> Dict[str, Any]:
                 key = tuple(s["labels"])
                 tgt = index.get(key)
                 if tgt is None:
-                    tgt = dict(s, labels=list(s["labels"]))
-                    if "counts" in s:
-                        tgt["counts"] = list(s["counts"])
+                    tgt = _copy_series(s)
                     out["series"].append(tgt)
                     index[key] = tgt
                 elif fam["type"] == "histogram":
@@ -75,12 +96,33 @@ def merge_snapshots(snapshots: Sequence[Dict[str, Any]]) -> Dict[str, Any]:
                                                            s["counts"])]
                     tgt["sum"] += s["sum"]
                     tgt["count"] += s["count"]
+                    _merge_exemplars(tgt, s)
                 else:  # counters AND gauges sum across workers (a fleet
                     # gauge like in-flight requests is additive)
                     tgt["value"] += s["value"]
     # no registry_id: a merged snapshot is an aggregate, not a scrape of one
     # registry, so a second-level merger must treat it as anonymous (sum)
     return {"registry_id": None, "families": merged_fams}
+
+
+def merge_traces(payloads: Sequence[Dict[str, Any]]) -> Dict[str, Any]:
+    """Stitch ``/traces`` payloads from several servers into one fleet
+    view: entries with the same trace id merge (a routed request leaves
+    one fragment at the front door and one per worker it touched — same
+    trace id, carried by the ``traceparent`` header), spans dedupe by span
+    id and sort by start time, and the outermost fragment's root/duration
+    wins. ``stats`` (dropped counts etc.) sum across servers."""
+    entries: List[Dict[str, Any]] = []
+    stats: Dict[str, Any] = {}
+    for p in payloads:
+        if not isinstance(p, dict):
+            continue
+        entries.extend(t for t in (p.get("traces") or [])
+                       if isinstance(t, dict))
+        for k, v in (p.get("stats") or {}).items():
+            if k in ("dropped", "active"):
+                stats[k] = stats.get(k, 0) + (v or 0)
+    return {"traces": _merge_trace_entries(entries), "stats": stats}
 
 
 def histogram_quantile(snapshot: Dict[str, Any], name: str, q: float,

@@ -26,6 +26,7 @@ Design constraints:
 from __future__ import annotations
 
 import threading
+import time
 import uuid
 from bisect import bisect_left
 from typing import Any, Dict, List, Optional, Sequence, Tuple
@@ -46,12 +47,21 @@ __all__ = [
 DEFAULT_BUCKETS: Tuple[float, ...] = tuple(10.0 ** (k / 4.0)
                                            for k in range(-24, 33))
 
+# Exemplar hook: a zero-arg callable returning the active trace id (or
+# None). Installed by ``observability.tracing`` at import; kept as a hook
+# so this module stays stdlib-pure and importable on its own. When set,
+# every histogram ``observe`` inside an active trace tags its bucket with
+# the trace id — the OpenMetrics-exemplar link from a fleet quantile to a
+# concrete request in ``/traces``.
+_exemplar_source = None
+
 
 class _Series:
     """One labeled time series inside a family (or the family's sole series
     when it has no labels). Mutations lock the owning family."""
 
-    __slots__ = ("_family", "labelvalues", "value", "counts", "sum", "count")
+    __slots__ = ("_family", "labelvalues", "value", "counts", "sum", "count",
+                 "exemplars")
 
     def __init__(self, family: "MetricFamily", labelvalues: Tuple[str, ...]):
         self._family = family
@@ -60,6 +70,9 @@ class _Series:
             self.counts = [0] * (len(family.buckets) + 1)  # + the +Inf bucket
             self.sum = 0.0
             self.count = 0
+            # bucket index -> (trace_id, observed value, wall ts); last
+            # write wins — "the most recent traced request in this bucket"
+            self.exemplars: Dict[int, Tuple[str, float, float]] = {}
         else:
             self.value = 0.0
 
@@ -90,15 +103,24 @@ class _Series:
             self.value = float(v)
 
     # histogram -----------------------------------------------------------
-    def observe(self, v: float) -> None:
+    def observe(self, v: float, exemplar: Optional[str] = None) -> None:
+        """Record one sample. ``exemplar`` optionally names the trace id
+        to tag the bucket with; when omitted, the active trace (if any —
+        the ``_exemplar_source`` hook) is used. Callers that finish a
+        request OUTSIDE its trace context (serving ``respond`` runs after
+        the pipeline span closed) pass the id explicitly."""
         fam = self._family
         if fam.type != "histogram":
             raise ValueError("observe() is histogram-only")
         i = bisect_left(fam.buckets, v)  # first bucket with upper >= v
+        if exemplar is None and _exemplar_source is not None:
+            exemplar = _exemplar_source()
         with fam._lock:
             self.counts[i] += 1
             self.sum += v
             self.count += 1
+            if exemplar is not None:
+                self.exemplars[i] = (exemplar, v, time.time())
 
     def quantile(self, q: float) -> Optional[float]:
         """Estimated q-quantile by linear interpolation inside the bucket
@@ -187,8 +209,8 @@ class MetricFamily:
     def set(self, v: float) -> None:
         self._default.set(v)
 
-    def observe(self, v: float) -> None:
-        self._default.observe(v)
+    def observe(self, v: float, exemplar: Optional[str] = None) -> None:
+        self._default.observe(v, exemplar)
 
     def quantile(self, q: float) -> Optional[float]:
         return self._default.quantile(q)
@@ -198,9 +220,15 @@ class MetricFamily:
             series: List[Dict[str, Any]] = []
             for key, s in sorted(self._series.items()):
                 if self.type == "histogram":
-                    series.append({"labels": list(key),
-                                   "counts": list(s.counts),
-                                   "sum": s.sum, "count": s.count})
+                    entry = {"labels": list(key),
+                             "counts": list(s.counts),
+                             "sum": s.sum, "count": s.count}
+                    if s.exemplars:
+                        # str keys: the snapshot must survive a JSON round
+                        # trip (it travels inside worker HTTP replies)
+                        entry["exemplars"] = {str(i): list(e)
+                                              for i, e in s.exemplars.items()}
+                    series.append(entry)
                 else:
                     series.append({"labels": list(key), "value": s.value})
         out: Dict[str, Any] = {"type": self.type, "help": self.help,

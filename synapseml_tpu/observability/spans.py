@@ -33,6 +33,7 @@ import weakref
 from time import perf_counter_ns as _now_ns  # the clock StopWatch wraps
 from typing import Any, Optional
 
+from . import tracing as _tracing
 from .metrics import MetricsRegistry, get_registry
 
 __all__ = ["span", "stage_span", "enable", "disable", "is_enabled", "Span"]
@@ -94,25 +95,41 @@ class Span:
     same monotonic clock ``core.clock.StopWatch`` wraps, read inline to
     keep the hot path at two clock reads + one histogram observe."""
 
-    __slots__ = ("_dur", "_rows_c", "_errors", "_t0", "rows")
+    __slots__ = ("_dur", "_rows_c", "_errors", "_t0", "rows", "_name",
+                 "_trace_parent")
 
-    def __init__(self, series, cold: bool):
+    def __init__(self, series, cold: bool, name=("span", "call")):
         dur_cold, dur_warm, rows_c, errors = series
         self._dur = dur_cold if cold else dur_warm
         self._rows_c = rows_c
         self._errors = errors
+        self._name = name
         self.rows: Optional[int] = None
 
     def set_rows(self, n: Optional[int]) -> None:
         self.rows = n
 
     def __enter__(self) -> "Span":
+        # trace-context attachment: when a trace is active in this thread
+        # (a serving engine activated the batch's pipeline span), this
+        # stage span also lands in the trace as a child. Cost with no
+        # active trace: one module-bool check + one contextvar read.
+        self._trace_parent = (_tracing.current_span()
+                              if _tracing.is_enabled() else None)
         self._t0 = _now_ns()
         return self
 
     def __exit__(self, exc_type, exc, tb) -> bool:
         elapsed_s = (_now_ns() - self._t0) * 1e-9
         self._dur.observe(elapsed_s)
+        tp = self._trace_parent
+        if tp is not None:
+            attrs = {"stage": self._name[0], "method": self._name[1]}
+            if self.rows is not None:
+                attrs["rows"] = self.rows
+            tp.tracer.record(f"{self._name[0]}.{self._name[1]}", parent=tp,
+                             duration_s=elapsed_s, attributes=attrs,
+                             error=exc if exc_type is not None else None)
         if exc_type is not None:
             # rows only count on SUCCESS (a failed fit trained nothing;
             # counting its input would inflate throughput on every retry)
@@ -148,7 +165,8 @@ def span(stage: str, method: str = "call", cold: bool = False,
     """
     if not _enabled:
         return _NOOP
-    return Span(_series_for(registry or get_registry(), stage, method), cold)
+    return Span(_series_for(registry or get_registry(), stage, method), cold,
+                name=(stage, method))
 
 
 def stage_span(stage_obj: Any, method: str):
@@ -174,7 +192,8 @@ def stage_span(stage_obj: Any, method: str):
         if not _enabled:
             return _NOOP
         return Span(_series_for(get_registry(),
-                                type(stage_obj).__name__, method), False)
+                                type(stage_obj).__name__, method), False,
+                    name=(type(stage_obj).__name__, method))
     warm_set = marker[1]
     cold = method not in warm_set
     if cold:
@@ -190,4 +209,4 @@ def stage_span(stage_obj: Any, method: str):
         marker[2][method] = (reg, series)
     else:
         series = cached[1]
-    return Span(series, cold)
+    return Span(series, cold, name=(type(stage_obj).__name__, method))

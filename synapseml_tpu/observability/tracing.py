@@ -1,0 +1,623 @@
+"""Distributed request tracing: trace-context propagation + span trees +
+a tail-sampled flight recorder.
+
+The metrics half of this subsystem answers *"what is the fleet p99"*; this
+module answers *"which request was slow and where did it spend its time"*.
+Every request through the serving stack yields ONE span tree: the routing
+front door starts (or continues, when the client sent a ``traceparent``)
+a ``route`` span, injects W3C trace context into the forwarded request,
+the worker's ``request`` span parents a per-batch ``pipeline`` span, and
+pipeline stage spans (``synapseml_tpu.observability.spans``) attach as
+children through a contextvar — across REAL process boundaries, because
+the context travels in the ordinary HTTP headers (no side channel, same
+design rule as the metrics snapshots).
+
+Design (Dapper-style tail sampling; stdlib-only like the rest of the
+subsystem — the no-jax-at-import gate covers this module):
+
+- **128-bit trace ids / 64-bit span ids**, propagated in the W3C
+  ``traceparent`` header (``00-<trace>-<span>-<flags>``).
+- **Tail-based sampling**: the keep/drop decision happens when a trace's
+  local root span *finishes*, so error traces and traces slower than
+  ``latency_threshold_s`` are ALWAYS retained; the rest pass a
+  probabilistic ``sample_rate``. Retained traces live in a bounded ring
+  (a flight recorder, not a firehose): under load, fast-and-boring traces
+  churn out while the interesting ones survive in their own ring.
+- **Exemplars**: while a trace is active, every histogram ``observe()``
+  tags its bucket with the trace id (installed as the
+  ``metrics._exemplar_source`` hook), so a ``/metrics`` quantile links
+  directly to a concrete request in ``/traces``.
+
+The hot path stays within the stage-span <5% budget (benched by
+``bench.py tracing_overhead``): with no active trace the added cost is one
+module-bool check plus one contextvar read; with an active trace each
+stage span appends one small dict to the trace fragment under a lock.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import os
+import random
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Mapping, Optional, Sequence
+
+from . import metrics as _metrics
+
+__all__ = [
+    "TRACEPARENT_HEADER",
+    "SpanContext",
+    "TraceSpan",
+    "Tracer",
+    "current_span",
+    "current_trace_id",
+    "enable",
+    "disable",
+    "is_enabled",
+    "extract_context",
+    "format_traceparent",
+    "get_tracer",
+    "inject_headers",
+    "new_span_id",
+    "new_trace_id",
+    "parse_traceparent",
+    "set_tracer",
+    "start_span",
+    "use_span",
+]
+
+TRACEPARENT_HEADER = "traceparent"
+
+_enabled = True
+
+
+def enable() -> None:
+    """Turn tracing on (the default)."""
+    global _enabled
+    _enabled = True
+
+
+def disable() -> None:
+    """Turn trace recording into no-ops (servers stop opening request
+    spans; stage spans stop attaching; exemplars stop tagging)."""
+    global _enabled
+    _enabled = False
+
+
+def is_enabled() -> bool:
+    return _enabled
+
+
+def new_trace_id() -> str:
+    """128-bit random trace id, 32 lowercase hex chars (W3C format)."""
+    return os.urandom(16).hex()
+
+
+# span ids need per-process uniqueness, not unpredictability: a random
+# 64-bit base XOR a process-wide counter avoids an os.urandom syscall per
+# span (it was the bulk of the per-span cost on the traced hot path) while
+# keeping cross-process ids disjoint. itertools.count.__next__ is atomic
+# under the GIL.
+from itertools import count as _count  # noqa: E402
+
+_SPAN_ID_BASE = int.from_bytes(os.urandom(8), "big")
+_span_counter = _count()
+
+
+def new_span_id() -> str:
+    """Unique-in-process 64-bit span id, 16 lowercase hex chars."""
+    return f"{(_SPAN_ID_BASE ^ next(_span_counter)) & (2**64 - 1):016x}"
+
+
+class SpanContext:
+    """A remote parent: the (trace_id, span_id) pair parsed from an
+    incoming ``traceparent``. Starting a span from one marks the new span
+    as a LOCAL ROOT — when it finishes, the local trace fragment is
+    complete and goes through the tail-sampling decision."""
+
+    __slots__ = ("trace_id", "span_id", "sampled")
+
+    def __init__(self, trace_id: str, span_id: str, sampled: bool = True):
+        self.trace_id = trace_id
+        self.span_id = span_id
+        self.sampled = sampled
+
+    def __repr__(self):
+        return f"SpanContext({self.trace_id}, {self.span_id})"
+
+
+def format_traceparent(span_or_ctx) -> str:
+    """W3C ``traceparent``: ``00-<32hex trace>-<16hex span>-<2hex flags>``.
+    Flag bit 0 (``01``) marks the trace as recorded."""
+    return f"00-{span_or_ctx.trace_id}-{span_or_ctx.span_id}-01"
+
+
+_HEX = set("0123456789abcdefABCDEF")
+
+
+def _is_hex(s: str) -> bool:
+    # not int(s, 16): that accepts "+"/"0x" prefixes a header must not have
+    return bool(s) and all(c in _HEX for c in s)
+
+
+def parse_traceparent(value: str) -> Optional[SpanContext]:
+    """Parse a ``traceparent`` header; None on anything malformed (a bad
+    header must degrade to "start a fresh trace", never to an error)."""
+    if not isinstance(value, str):
+        return None
+    parts = value.strip().split("-")
+    if len(parts) < 4:
+        return None
+    version, trace_id, span_id, flags = parts[0], parts[1], parts[2], parts[3]
+    if len(version) != 2 or not _is_hex(version) or version == "ff":
+        return None
+    if len(trace_id) != 32 or not _is_hex(trace_id) \
+            or trace_id == "0" * 32:
+        return None
+    if len(span_id) != 16 or not _is_hex(span_id) or span_id == "0" * 16:
+        return None
+    if len(flags) != 2 or not _is_hex(flags):
+        return None
+    return SpanContext(trace_id.lower(), span_id.lower(),
+                       bool(int(flags, 16) & 1))
+
+
+def extract_context(headers: Mapping[str, str]) -> Optional[SpanContext]:
+    """Pull trace context out of HTTP headers (case-insensitive lookup —
+    proxies routinely re-case headers)."""
+    if headers is None:
+        return None
+    for k in (TRACEPARENT_HEADER, "Traceparent", "TraceParent",
+              "TRACEPARENT"):
+        v = headers.get(k)
+        if v is not None:
+            return parse_traceparent(v)
+    for k, v in headers.items():  # arbitrary casing: one linear fallback
+        if k.lower() == TRACEPARENT_HEADER:
+            return parse_traceparent(v)
+    return None
+
+
+def inject_headers(headers: Dict[str, str], span=None) -> Dict[str, str]:
+    """Set ``traceparent`` from ``span`` (the current span when omitted);
+    returns ``headers`` for chaining. No-op when there is nothing active."""
+    sp = span if span is not None else current_span()
+    if sp is not None:
+        headers[TRACEPARENT_HEADER] = format_traceparent(sp)
+    return headers
+
+
+# the active span for THIS task/thread; engine loops activate the batch's
+# pipeline span around pipeline.transform so stage spans nest under it
+_current: "contextvars.ContextVar[Optional[TraceSpan]]" = \
+    contextvars.ContextVar("smt_trace_span", default=None)
+
+_USE_CURRENT = object()  # sentinel: "parent = whatever is active"
+
+
+def current_span() -> Optional["TraceSpan"]:
+    return _current.get()
+
+
+def current_trace_id() -> Optional[str]:
+    """Trace id of the active span (the exemplar hook — see metrics.py)."""
+    sp = _current.get()
+    return sp.trace_id if sp is not None else None
+
+
+@contextlib.contextmanager
+def use_span(span: "TraceSpan"):
+    """Activate an already-begun span in THIS thread (engine loops use it
+    around ``pipeline.transform`` so stage spans attach as children). Does
+    not end the span — ownership stays with the caller."""
+    token = _current.set(span)
+    try:
+        yield span
+    finally:
+        _current.reset(token)
+
+
+class TraceSpan:
+    """One timed operation inside a trace.
+
+    Begun via :meth:`Tracer.begin_span` (manual ``end()``, usable across
+    threads — serving request spans begin in the handler thread and end in
+    ``respond``) or :func:`start_span` (context manager that also
+    activates the span). ``start_ts`` is wall-clock for cross-process
+    alignment; duration is measured with the monotonic clock."""
+
+    __slots__ = ("tracer", "name", "trace_id", "span_id", "parent_id",
+                 "start_ts", "duration_s", "status", "attributes",
+                 "slow_exempt", "_t0", "_local_root", "_ended", "_token")
+
+    def __init__(self, tracer: "Tracer", name: str, trace_id: str,
+                 parent_id: Optional[str], local_root: bool,
+                 attributes: Optional[Dict[str, Any]] = None):
+        self.tracer = tracer
+        self.name = name
+        self.trace_id = trace_id
+        self.span_id = new_span_id()
+        self.parent_id = parent_id
+        self.attributes = dict(attributes) if attributes else {}
+        self.status = "OK"
+        self.duration_s: Optional[float] = None
+        # True for spans whose duration is a LIFETIME, not a latency
+        # (e.g. TCP relay connections): they never qualify as "slow" —
+        # an hours-long healthy tunnel must not churn real slow/error
+        # request traces out of the retained ring
+        self.slow_exempt = False
+        self._local_root = local_root
+        self._ended = False
+        self._token = None
+        self.start_ts = time.time()
+        self._t0 = time.perf_counter_ns()
+
+    def set_attribute(self, key: str, value: Any) -> None:
+        self.attributes[key] = value
+
+    def end(self, error: Any = None) -> None:
+        """Finish the span (idempotent). ``error`` marks the span — and
+        therefore the trace — as retained-on-error."""
+        if self._ended:
+            return
+        self._ended = True
+        self.duration_s = (time.perf_counter_ns() - self._t0) * 1e-9
+        if error is not None:
+            self.status = "ERROR"
+            self.attributes.setdefault(
+                "error", f"{type(error).__name__}: {error}"
+                if isinstance(error, BaseException) else str(error))
+        self.tracer._finish(self)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"trace_id": self.trace_id, "span_id": self.span_id,
+                "parent_id": self.parent_id, "name": self.name,
+                "start_ts": self.start_ts, "duration_s": self.duration_s,
+                "status": self.status, "attributes": self.attributes}
+
+    # context-manager sugar: activates in this thread and ends on exit
+    def __enter__(self) -> "TraceSpan":
+        self._token = _current.set(self)
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        if self._token is not None:
+            _current.reset(self._token)
+            self._token = None
+        self.end(error=exc)
+        return False
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, default))
+    except (TypeError, ValueError):
+        return default
+
+
+class Tracer:
+    """Bounded flight recorder with tail-based sampling.
+
+    Finished spans accumulate per trace id until the trace's LOCAL ROOT
+    span (no parent, or a remote parent from ``traceparent``) finishes;
+    then the fragment is complete and the retention decision runs:
+
+    - any span errored              -> retained (``error`` ring)
+    - root duration >= threshold    -> retained (``slow`` ring)
+    - else                          -> kept with prob. ``sample_rate``
+
+    Retained (error/slow) traces live in their own ring so a flood of
+    fast traces cannot churn out the interesting ones. ``capacity`` bounds
+    TOTAL kept traces (half interesting, half sampled); traces longer than
+    ``max_spans_per_trace`` truncate (span count recorded) rather than
+    growing without bound.
+
+    Defaults read the environment so worker processes are configurable
+    from the fleet launcher: ``SMT_TRACE_CAPACITY`` (256),
+    ``SMT_TRACE_SAMPLE_RATE`` (1.0 — keep everything, the ring is the
+    bound; production fleets turn this down), ``SMT_TRACE_SLOW_MS`` (250).
+    """
+
+    def __init__(self, capacity: Optional[int] = None,
+                 sample_rate: Optional[float] = None,
+                 latency_threshold_s: Optional[float] = None,
+                 max_spans_per_trace: int = 512,
+                 seed: Optional[int] = None):
+        if capacity is None:
+            capacity = int(_env_float("SMT_TRACE_CAPACITY", 256))
+        if sample_rate is None:
+            sample_rate = _env_float("SMT_TRACE_SAMPLE_RATE", 1.0)
+        if latency_threshold_s is None:
+            latency_threshold_s = _env_float("SMT_TRACE_SLOW_MS", 250.0) / 1e3
+        capacity = max(2, int(capacity))
+        self.capacity = capacity
+        self.sample_rate = float(sample_rate)
+        self.latency_threshold_s = float(latency_threshold_s)
+        self.max_spans_per_trace = int(max_spans_per_trace)
+        self._lock = threading.Lock()
+        # trace_id -> (finished span dicts, error seen, truncated count)
+        self._active: Dict[str, List[Any]] = {}
+        self._retained: "deque" = deque(maxlen=max(1, capacity // 2))
+        self._sampled: "deque" = deque(maxlen=max(1, capacity -
+                                                  capacity // 2))
+        # trace_id -> ring entry, for LATE spans (a request that 504'd out
+        # finalizes while its pipeline is still running; the pipeline and
+        # stage spans must still land in the retained trace — that trace
+        # is the one that explains the timeout); pruned on ring eviction
+        self._entry_index: Dict[str, Dict[str, Any]] = {}
+        # recently tail-dropped trace ids: late spans for those are
+        # swallowed instead of leaking an orphan fragment no root will
+        # ever complete (insertion-ordered dict, trimmed to the cap)
+        self._dropped_ids: Dict[str, None] = {}
+        self._rng = random.Random(seed)
+        self.dropped = 0
+        # a span leak (a root that never ends) must not grow _active
+        # without bound; beyond the cap the oldest fragment is abandoned
+        self._active_cap = 4 * capacity
+
+    # -- span creation ----------------------------------------------------
+    def begin_span(self, name: str, parent: Any = _USE_CURRENT,
+                   attributes: Optional[Dict[str, Any]] = None
+                   ) -> TraceSpan:
+        """Start a span. ``parent`` may be a :class:`TraceSpan` (local
+        child), a :class:`SpanContext` (continuing a remote trace — the
+        new span is the local root), or ``None`` (a brand-new trace).
+        Default: the thread's current span, falling back to a new trace."""
+        if parent is _USE_CURRENT:
+            parent = _current.get()
+        if isinstance(parent, TraceSpan):
+            return TraceSpan(self, name, parent.trace_id, parent.span_id,
+                             local_root=False, attributes=attributes)
+        if isinstance(parent, SpanContext):
+            return TraceSpan(self, name, parent.trace_id, parent.span_id,
+                             local_root=True, attributes=attributes)
+        return TraceSpan(self, name, new_trace_id(), None,
+                         local_root=True, attributes=attributes)
+
+    def record(self, name: str, parent: Any = _USE_CURRENT,
+               duration_s: float = 0.0,
+               attributes: Optional[Dict[str, Any]] = None,
+               error: Any = None,
+               start_ts: Optional[float] = None) -> Optional[str]:
+        """Attach an already-measured span (stage spans, queue waits,
+        client calls measure themselves and report here). Returns the new
+        span id. With no parent the span is its own single-span trace."""
+        if parent is _USE_CURRENT:
+            parent = _current.get()
+        span = self.begin_span(name, parent, attributes)
+        if start_ts is not None:
+            span.start_ts = start_ts
+        else:
+            span.start_ts = time.time() - duration_s
+        span._ended = True  # bypass the clock: duration is caller-supplied
+        span.duration_s = float(duration_s)
+        if error is not None:
+            span.status = "ERROR"
+            span.attributes.setdefault(
+                "error", f"{type(error).__name__}: {error}"
+                if isinstance(error, BaseException) else str(error))
+        self._finish(span)
+        return span.span_id
+
+    # -- collection -------------------------------------------------------
+    def _finish(self, span: TraceSpan) -> None:
+        d = span.to_dict()
+        is_err = span.status == "ERROR"
+        slow = (not span.slow_exempt
+                and (span.duration_s or 0.0) >= self.latency_threshold_s)
+        with self._lock:
+            frag = self._active.get(span.trace_id)
+            if frag is None:
+                entry = self._entry_index.get(span.trace_id)
+                if entry is not None:
+                    # the trace already finalized: a LATE span (the root
+                    # 504'd out while the pipeline ran on), or a SECOND
+                    # local root of the same trace (in-process router +
+                    # worker share one tracer). Join the existing entry —
+                    # re-running the retention decision would half-stitch
+                    # the trace or double-sample it.
+                    if len(entry["spans"]) <= self.max_spans_per_trace:
+                        entry["spans"].append(d)
+                    else:
+                        entry["truncated_spans"] = \
+                            entry.get("truncated_spans", 0) + 1
+                    if span._local_root:
+                        # outermost root owns the headline; a stronger
+                        # retention reason upgrades the label AND moves
+                        # the entry into the protected ring — an error
+                        # trace left in the sampled ring would still be
+                        # churned out by fast traces
+                        if (span.duration_s or 0.0) > \
+                                (entry.get("duration_s") or 0.0):
+                            entry["root"] = span.name
+                            entry["duration_s"] = span.duration_s
+                        if is_err or (slow and
+                                      entry.get("retained") == "sampled"):
+                            entry["retained"] = "error" if is_err else "slow"
+                            try:
+                                self._sampled.remove(entry)
+                            except ValueError:
+                                pass  # already in the retained ring
+                            else:
+                                self._ring_append(self._retained, entry)
+                    return
+                if span.trace_id in self._dropped_ids:
+                    # the first local root sampled this trace OUT. A late
+                    # child vanishes with it; a second root resurrects the
+                    # trace only when itself retention-worthy — a
+                    # probabilistic re-flip would bias the sample rate up
+                    if not (span._local_root and (is_err or slow)):
+                        return
+                if len(self._active) >= self._active_cap:
+                    # abandon the oldest leaked fragment (insertion order)
+                    leaked = next(iter(self._active))
+                    del self._active[leaked]
+                    self.dropped += 1
+                frag = self._active[span.trace_id] = [[], False, 0]
+            spans, had_err, truncated = frag
+            if len(spans) >= self.max_spans_per_trace and \
+                    not span._local_root:
+                frag[2] = truncated + 1
+                frag[1] = had_err or is_err
+                return
+            spans.append(d)
+            frag[1] = had_err or is_err
+            if not span._local_root:
+                return
+            del self._active[span.trace_id]
+            spans, had_err, truncated = frag
+            entry = {"trace_id": span.trace_id, "spans": spans,
+                     "root": span.name,
+                     "duration_s": span.duration_s}
+            if truncated:
+                entry["truncated_spans"] = truncated
+            if had_err:
+                entry["retained"] = "error"
+                self._ring_append(self._retained, entry)
+            elif slow:
+                entry["retained"] = "slow"
+                self._ring_append(self._retained, entry)
+            elif self._rng.random() < self.sample_rate:
+                entry["retained"] = "sampled"
+                self._ring_append(self._sampled, entry)
+            else:
+                self.dropped += 1
+                self._dropped_ids[span.trace_id] = None
+                while len(self._dropped_ids) > self._active_cap:
+                    del self._dropped_ids[next(iter(self._dropped_ids))]
+
+    def _ring_append(self, ring: "deque", entry: Dict[str, Any]) -> None:
+        """Append under the lock, keeping the late-span index consistent
+        with ring evictions (an evicted trace must not keep collecting)."""
+        if len(ring) == ring.maxlen:
+            old = ring[0]
+            if self._entry_index.get(old["trace_id"]) is old:
+                del self._entry_index[old["trace_id"]]
+        ring.append(entry)
+        self._entry_index[entry["trace_id"]] = entry
+
+    def is_retained(self, trace_id: str) -> bool:
+        """True when ``trace_id`` currently sits in the flight recorder.
+        Exemplar writers that run AFTER a trace's root ended (serving
+        ``respond``) check this so ``/metrics`` never points at a trace the
+        tail sampler dropped — exemplars recorded mid-trace (stage spans)
+        stay best-effort under ``sample_rate < 1``."""
+        with self._lock:
+            return trace_id in self._entry_index
+
+    def snapshot(self) -> Dict[str, Any]:
+        """JSON-able flight-recorder contents: completed traces (entries
+        for the same trace id — e.g. an in-process router + worker sharing
+        this tracer — merge, spans deduped by span id), newest last."""
+        with self._lock:
+            entries = list(self._retained) + list(self._sampled)
+            stats = {"dropped": self.dropped, "active": len(self._active),
+                     "capacity": self.capacity,
+                     "sample_rate": self.sample_rate,
+                     "latency_threshold_s": self.latency_threshold_s}
+        return {"traces": _merge_trace_entries(entries), "stats": stats}
+
+
+_RETAIN_PRIORITY = {"error": 0, "slow": 1, "sampled": 2}
+
+
+def _merge_trace_entries(entries: Sequence[Dict[str, Any]]
+                         ) -> List[Dict[str, Any]]:
+    """Merge trace entries by trace id (spans deduped by span id, ordered
+    by start time); the strongest retention reason wins. Shared by
+    ``Tracer.snapshot`` and ``merge.merge_traces`` (the front-door
+    stitcher)."""
+    by_tid: Dict[str, Dict[str, Any]] = {}
+    order: List[str] = []
+    for e in entries:
+        if not isinstance(e, dict) or not e.get("trace_id"):
+            continue
+        tid = e["trace_id"]
+        tgt = by_tid.get(tid)
+        if tgt is None:
+            tgt = by_tid[tid] = {"trace_id": tid, "spans": [],
+                                 "_seen": set()}
+            order.append(tid)
+        # rank THIS fragment before merging its spans into tgt: the
+        # outermost fragment owns the stitched headline. A fragment whose
+        # own spans include a parentless root (the true front door) beats
+        # any remote-parented fragment regardless of duration — a worker
+        # pipeline outliving a router timeout must not steal the headline
+        has_orphan = any(s.get("parent_id") is None
+                         for s in e.get("spans") or [])
+        rank = (1 if has_orphan else 0, e.get("duration_s") or 0.0)
+        if rank > tgt.get("_rank", (-1, 0.0)):
+            tgt["_rank"] = rank
+            if e.get("root") is not None:
+                tgt["root"] = e["root"]
+            if e.get("duration_s") is not None:
+                tgt["duration_s"] = e["duration_s"]
+        for s in e.get("spans") or []:
+            sid = s.get("span_id")
+            if sid in tgt["_seen"]:
+                continue
+            tgt["_seen"].add(sid)
+            tgt["spans"].append(s)
+        r_new = _RETAIN_PRIORITY.get(e.get("retained"), 3)
+        r_old = _RETAIN_PRIORITY.get(tgt.get("retained"), 4)
+        if r_new < r_old:
+            tgt["retained"] = e.get("retained")
+        if e.get("truncated_spans"):
+            tgt["truncated_spans"] = (tgt.get("truncated_spans", 0)
+                                      + e["truncated_spans"])
+    out = []
+    for tid in order:
+        t = by_tid[tid]
+        t.pop("_seen", None)
+        t.pop("_rank", None)
+        t["spans"].sort(key=lambda s: (s.get("start_ts") or 0.0))
+        out.append(t)
+    return out
+
+
+_default_tracer = Tracer()
+_default_lock = threading.Lock()
+
+
+def get_tracer() -> Tracer:
+    """The process-default tracer (what the serving stack records into and
+    what ``/traces`` exposes)."""
+    return _default_tracer
+
+
+def set_tracer(tracer: Tracer) -> Tracer:
+    """Swap the process-default tracer; returns the previous one (tests
+    and the bench install isolated tracers)."""
+    global _default_tracer
+    with _default_lock:
+        prev = _default_tracer
+        _default_tracer = tracer
+    return prev
+
+
+def start_span(name: str, parent: Any = _USE_CURRENT,
+               attributes: Optional[Dict[str, Any]] = None,
+               tracer: Optional[Tracer] = None) -> TraceSpan:
+    """Begin a span on the process-default tracer and return it as a
+    context manager that activates it in this thread:
+
+    >>> with start_span("ingest") as sp:
+    ...     sp.set_attribute("shard", 3)
+    """
+    return (tracer or get_tracer()).begin_span(name, parent, attributes)
+
+
+# exemplar hook: while tracing is ENABLED and a trace is active, histogram
+# observes tag their bucket with the trace id (metrics.py calls this if
+# installed; the module stays importable and dependency-free without us)
+def _exemplar_trace_id() -> Optional[str]:
+    if not _enabled:
+        return None
+    sp = _current.get()
+    return sp.trace_id if sp is not None else None
+
+
+_metrics._exemplar_source = _exemplar_trace_id

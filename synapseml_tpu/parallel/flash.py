@@ -118,12 +118,46 @@ def flash_attention(q, k, v, causal: bool = False, block_q: int = None,
         # (and silently all-zero outputs)
         raise ValueError(f"causal flash attention needs s_q <= s_k, got "
                          f"s_q={s_q} > s_k={s_k}")
+    req_q, req_k = block_q, block_k  # the USER's values, pre-clamp
     auto_bq, auto_bk = _pick_blocks(b * h, s_q, s_k)
     block_q = min(block_q or auto_bq, s_q)  # each side auto-fills alone
     block_k = min(block_k or auto_bk, s_k)
     if s_q % block_q or s_k % block_k:
         raise ValueError(f"block sizes ({block_q}, {block_k}) must divide "
                          f"sequence lengths ({s_q}, {s_k})")
+    # Mosaic tile minimum: the (block_q, block_k) score tile needs >= 8
+    # sublanes and >= 128 lanes. Awkward sequence lengths with few
+    # power-of-2 factors (S=1200 -> 16, odd S -> 1) used to auto-pick
+    # sub-tile blocks and die inside Mosaic (or crawl); the Pallas
+    # interpreter has no such minimum, so CPU parity tests keep passing
+    # small explicit blocks with interpret=True.
+    if not interpret and (block_q < 8 or block_k < 128):
+        # raise only for blocks the USER requested below the minimum; a
+        # legal explicit block that a short sequence clamped down
+        # (block_k=1024 at s_k=64) takes the dense fallback like the auto
+        # path — "pass bigger blocks" would be unsatisfiable advice there
+        if (req_q is not None and req_q < 8) or \
+                (req_k is not None and req_k < 128):
+            raise ValueError(
+                f"flash_attention blocks ({block_q}, {block_k}) are below "
+                f"Mosaic's (8, 128) tile minimum; pass blocks that divide "
+                f"the sequence lengths ({s_q}, {s_k}) and meet the minimum, "
+                f"or use interpret=True / dense_attention")
+        # auto-picked sub-tile (the sequence length simply has no legal
+        # block): fall back to dense attention when its score tensor is
+        # affordable — a long ODD sequence would OOM in dense with an
+        # equally opaque error, so that case raises with the fix named
+        if 4 * b * h * s_q * s_k > 2e9:
+            raise ValueError(
+                f"flash_attention cannot tile sequence lengths ({s_q}, "
+                f"{s_k}): the largest power-of-2 block divisors "
+                f"({block_q}, {block_k}) are below Mosaic's (8, 128) tile "
+                f"minimum, and the lengths are too large for the dense "
+                f"fallback. Pad the sequences to a multiple of 128.")
+        if rep != 1:  # dense needs matching head counts: expand GQA K/V
+            k = jnp.repeat(k, rep, axis=2)
+            v = jnp.repeat(v, rep, axis=2)
+        return dense_attention(q, k, v, causal=causal)
 
     # (B, S, H, D) -> (B*H, S, D): batch*head is the embarrassing grid axis.
     # K/V keep their GROUPED head count; the kernel's index map divides.

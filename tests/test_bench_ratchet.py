@@ -129,6 +129,60 @@ def test_load_prev_round_real_r4_artifact():
     assert isinstance(headline, (int, float)) and headline > 0
 
 
+def test_committed_rounds_have_no_unwaived_regressions():
+    """ROADMAP item 5: the ``vs_prev_round`` guard as a FAILING test, not
+    advisory JSON — round 5 shipped a 20% flash regression silently. Any
+    committed round whose per-lane ratio drops below
+    ``bench.RATCHET_THRESHOLD`` (0.95) must carry an explicit waiver row in
+    ``BENCH_ACKS.md`` (a reviewed decision with a reason), or CI fails."""
+    offenders = bench.unwaived_regressions()
+    assert offenders == [], (
+        "unwaived bench regressions (lane ratio < "
+        f"{bench.RATCHET_THRESHOLD}): {offenders}; either recover the "
+        "lane or add a reasoned waiver row to BENCH_ACKS.md")
+
+
+def test_ratchet_flags_unwaived_and_honors_waivers(tmp_path):
+    """The gate itself: a sub-threshold lane fails without a waiver and
+    passes with one; recovered (damaged-artifact) ratios count too."""
+    (tmp_path / "BENCH_r07.json").write_text(json.dumps({
+        "n": 7, "rc": 0, "tail": "", "parsed": {
+            "value": 100.0, "extra": {
+                "resnet50_onnx": {"images_per_sec_per_chip": 100.0},
+                "vs_prev_round": {"round": 6, "per_config": {
+                    "resnet50_onnx": 0.90, "gbdt_adult_scale": 0.96}}}}}))
+    offenders = bench.unwaived_regressions(here=str(tmp_path))
+    assert offenders == [(7, "resnet50_onnx", 0.90)]
+    # 0.96 is above the 0.95 line: not an offender
+    (tmp_path / "BENCH_ACKS.md").write_text(
+        "| round | config | ratio | reason |\n|---|---|---|---|\n"
+        "| 7 | resnet50_onnx | 0.90 | known driver change |\n")
+    assert bench.unwaived_regressions(here=str(tmp_path)) == []
+    # a waiver for a DIFFERENT round does not leak
+    assert bench.unwaived_regressions(
+        here=str(tmp_path), waivers={(6, "resnet50_onnx")}) == \
+        [(7, "resnet50_onnx", 0.90)]
+
+
+def test_ratchet_sees_through_damaged_artifacts(tmp_path):
+    """A damaged round (parsed: null) whose vs_prev_round survived in the
+    tail still participates in the ratchet — recovery must not grant
+    amnesty."""
+    _write_rounds(tmp_path)  # r4 damaged, flash ratio 1.608 in the tail
+    tail = _DAMAGED_TAIL.replace('"flash_attention_32k": 1.608',
+                                 '"flash_attention_32k": 0.5')
+    (tmp_path / "BENCH_r04.json").write_text(json.dumps(
+        {"n": 4, "rc": 0, "tail": tail, "parsed": None}))
+    offenders = bench.unwaived_regressions(here=str(tmp_path))
+    assert (4, "flash_attention_32k", 0.5) in offenders
+
+
+def test_committed_waiver_file_parses():
+    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    waivers = bench.load_waivers(os.path.join(here, "BENCH_ACKS.md"))
+    assert (5, "flash_attention_32k") in waivers
+
+
 def test_error_strings_capped():
     """bench.main caps recorded errors at 300 chars (source-level pin)."""
     with open(os.path.join(os.path.dirname(os.path.dirname(

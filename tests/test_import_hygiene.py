@@ -9,6 +9,7 @@ so the test is immune to whatever the surrounding pytest session already
 imported (conftest.py imports jax eagerly).
 """
 
+import os
 import subprocess
 import sys
 
@@ -25,17 +26,27 @@ _GATED_MODULES = [
     "synapseml_tpu.observability.merge",
     "synapseml_tpu.observability.metrics",
     "synapseml_tpu.observability.spans",
+    "synapseml_tpu.observability.tracing",
     "synapseml_tpu.io.serving",
     "synapseml_tpu.io.serving_v2",
     "synapseml_tpu.io.serving_worker",
     "synapseml_tpu.gbdt.boost",
 ]
 
+_TOOLS_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tools")
+
+# standalone CLI tools a human points at PRODUCTION endpoints; they must
+# stay jax-free (tools/ is not a package — imported via a path entry)
+_GATED_TOOLS = ["trace_dump"]
+
 
 def test_no_jax_at_import():
     code = "\n".join(
         ["import sys"]
         + [f"import {m}" for m in _GATED_MODULES]
+        + [f"sys.path.insert(0, {_TOOLS_DIR!r})"]
+        + [f"import {m}" for m in _GATED_TOOLS]
         + ["bad = sorted(m for m in sys.modules if m == 'jax' "
            "or m.startswith('jax.'))",
            "assert not bad, f'jax imported at module import time: {bad[:5]}'"]

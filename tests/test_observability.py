@@ -190,6 +190,81 @@ def test_prometheus_exposition_golden():
     assert render_prometheus(reg.snapshot()) == golden
 
 
+def test_prometheus_exemplar_syntax_and_content_negotiation():
+    """Exemplars render in OpenMetrics exemplar syntax
+    (`` # {trace_id="…"} value ts`` + ``# EOF``) — OpenMetrics-ONLY: the
+    0.0.4 rendering stays exemplar-free (a 0.0.4 parser fails the whole
+    scrape on the ``#`` suffix), so the pre-exemplar golden above keeps
+    holding for every plain scrape, traced or not."""
+    from synapseml_tpu.observability import render_openmetrics
+
+    reg = MetricsRegistry()
+    h = reg.histogram("lat", "latency", buckets=(0.1, 1.0, 10.0))
+    h.observe(0.05)                      # no exemplar on this bucket
+    h.observe(0.5, exemplar="ab" * 16)   # traced request in bucket le=1
+    h.observe(100.0, exemplar="cd" * 16)  # and one in +Inf
+    snap = reg.snapshot()
+    ts1 = snap["families"]["lat"]["series"][0]["exemplars"]["1"][2]
+    ts3 = snap["families"]["lat"]["series"][0]["exemplars"]["3"][2]
+    golden = (
+        '# HELP lat latency\n'
+        '# TYPE lat histogram\n'
+        'lat_bucket{le="0.1"} 1\n'
+        f'lat_bucket{{le="1"}} 2 # {{trace_id="{"ab" * 16}"}} 0.5 {ts1:.3f}\n'
+        'lat_bucket{le="10"} 2\n'
+        f'lat_bucket{{le="+Inf"}} 3 # {{trace_id="{"cd" * 16}"}} '
+        f'100 {ts3:.3f}\n'
+        'lat_sum 100.55\n'
+        'lat_count 3\n'
+        '# EOF\n'
+    )
+    assert render_openmetrics(snap) == golden
+    # the 0.0.4 default: no exemplar suffixes anywhere, even when recorded
+    plain = render_prometheus(snap)
+    assert "trace_id" not in plain and "#" not in plain.replace(
+        "# HELP", "").replace("# TYPE", "")
+    # and the snapshot JSON round-trips with exemplars intact
+    rt = json.loads(json.dumps(snap))
+    assert render_openmetrics(rt) == golden
+
+
+def test_metrics_endpoint_negotiates_openmetrics():
+    """GET /metrics: plain scrape -> 0.0.4 without exemplars; an Accept
+    header naming openmetrics-text -> exemplars + # EOF."""
+    from synapseml_tpu.io.serving_v2 import serve_continuous
+    from synapseml_tpu.observability import tracing
+
+    eng = serve_continuous(_EchoReply())
+    try:
+        tid = tracing.new_trace_id()
+        req = urllib.request.Request(
+            eng.server.address + "/", data=b"x", method="POST",
+            headers={"traceparent": f"00-{tid}-{'9' * 16}-01"})
+        with urllib.request.urlopen(req, timeout=15) as r:
+            assert r.status == 200
+        plain = urllib.request.urlopen(
+            eng.server.address + "/metrics", timeout=15)
+        body = plain.read().decode()
+        assert "version=0.0.4" in plain.headers["Content-Type"]
+        assert "trace_id" not in body
+        om = urllib.request.urlopen(urllib.request.Request(
+            eng.server.address + "/metrics",
+            headers={"Accept": "application/openmetrics-text"}), timeout=15)
+        om_body = om.read().decode()
+        assert "openmetrics-text" in om.headers["Content-Type"]
+        assert f'# {{trace_id="{tid}"}}' in om_body
+        assert om_body.endswith("# EOF\n")
+        # SPEC-valid OpenMetrics: counter family metadata drops the _total
+        # suffix (samples keep it) — a real Prometheus server negotiates
+        # OpenMetrics by default, and its OM parser rejects a counter
+        # family named *_total, failing the whole scrape
+        assert "# TYPE smt_serving_requests counter" in om_body
+        assert "smt_serving_requests_total{" in om_body
+        assert "# TYPE smt_serving_requests_total " not in om_body
+    finally:
+        eng.stop()
+
+
 def test_prometheus_label_escaping():
     reg = MetricsRegistry()
     reg.counter("c_total", "c", ("p",)).labels('a"b\\c\nd').inc()

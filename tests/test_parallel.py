@@ -288,3 +288,58 @@ def test_ulysses_flash_gqa_grouped_in_kernel(mesh):
     kx, vx = np.repeat(k, 4, axis=2), np.repeat(v, 4, axis=2)
     ref = _dense_reference(q, kx, vx, causal=True)
     np.testing.assert_allclose(out, ref, rtol=2e-3, atol=2e-3)
+
+
+def test_flash_subtile_auto_falls_back_to_dense():
+    """ADVICE r5 hazard: sequence lengths with small power-of-2 factors
+    auto-pick sub-(8,128) blocks; instead of an opaque Mosaic failure the
+    compiled path must fall back to dense attention (exact match)."""
+    from synapseml_tpu.parallel import flash_attention
+    from synapseml_tpu.parallel.flash import dense_attention
+
+    rng = np.random.default_rng(11)
+    q = jnp.asarray(rng.normal(size=(1, 300, 2, 64)).astype(np.float32))
+    out = flash_attention(q, q, q, causal=True)  # S=300 -> block 4: no tile
+    ref = dense_attention(q, q, q, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+    # GQA fallback expands K/V before dense
+    q4 = jnp.asarray(rng.normal(size=(1, 300, 4, 64)).astype(np.float32))
+    outg = flash_attention(q4, q, q, causal=True)
+    refg = dense_attention(q4, jnp.repeat(q, 2, axis=2),
+                           jnp.repeat(q, 2, axis=2), causal=True)
+    np.testing.assert_allclose(np.asarray(outg), np.asarray(refg), atol=1e-5)
+
+
+def test_flash_explicit_subtile_blocks_raise_but_clamped_ok():
+    """Blocks the USER requested below Mosaic's (8, 128) minimum raise a
+    clear error (unless interpret=True); a LEGAL explicit block that a
+    short sequence clamps below the minimum takes the dense fallback —
+    'pass bigger blocks' would be unsatisfiable advice at s_k=64."""
+    from synapseml_tpu.parallel import flash_attention
+    from synapseml_tpu.parallel.flash import dense_attention
+
+    rng = np.random.default_rng(12)
+    q = jnp.asarray(rng.normal(size=(1, 256, 2, 64)).astype(np.float32))
+    with pytest.raises(ValueError, match="Mosaic"):
+        flash_attention(q, q, q, block_k=64)
+    with pytest.raises(ValueError, match="Mosaic"):
+        flash_attention(q, q, q, block_q=4)
+    # interpret=True keeps small explicit blocks (CPU parity tests)
+    out = flash_attention(q, q, q, block_q=32, block_k=32, interpret=True)
+    assert out.shape == q.shape
+    # requested 1024 >= minimum, clamped by s=64: dense fallback, no raise
+    qs = jnp.asarray(rng.normal(size=(1, 64, 2, 64)).astype(np.float32))
+    out = flash_attention(qs, qs, qs, block_q=1024, block_k=1024)
+    np.testing.assert_allclose(np.asarray(out),
+                               np.asarray(dense_attention(qs, qs, qs)),
+                               atol=1e-5)
+
+
+def test_flash_untileable_huge_sequence_raises_clearly():
+    """A long ODD sequence can neither tile nor afford the dense score
+    tensor: the error must name the fix (pad to a multiple of 128)."""
+    from synapseml_tpu.parallel import flash_attention
+
+    q = jnp.zeros((1, 100001, 2, 64), jnp.float32)
+    with pytest.raises(ValueError, match="[Pp]ad the sequences"):
+        flash_attention(q, q, q)

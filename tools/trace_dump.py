@@ -1,0 +1,168 @@
+#!/usr/bin/env python
+"""Waterfall / top-N-slow viewer for the ``/traces`` flight recorder.
+
+Reads a live endpoint or a saved JSON payload and renders each trace's
+span tree as an indented waterfall (offset + duration + a proportional
+bar), slowest traces first:
+
+    python tools/trace_dump.py http://127.0.0.1:8888          # live server
+    python tools/trace_dump.py http://127.0.0.1:8888/traces   # same
+    python tools/trace_dump.py captured_traces.json           # saved JSON
+    python tools/trace_dump.py fleet --top 3 --min-ms 50      # filters
+
+Stdlib-only and import-hygiene-gated (``tests/test_import_hygiene.py``):
+pointing it at a production front door must never drag jax into the
+process doing the looking.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Any, Dict, List, Optional
+
+BAR_WIDTH = 28
+
+
+def load_payload(source: str, timeout: float = 10.0) -> Dict[str, Any]:
+    """``/traces`` payload from a URL (``/traces`` appended when the path
+    doesn't already end there) or a local JSON file."""
+    if source.startswith("http://") or source.startswith("https://"):
+        import urllib.request
+
+        url = source
+        if not url.rstrip("/").endswith("/traces"):
+            url = url.rstrip("/") + "/traces"
+        with urllib.request.urlopen(url, timeout=timeout) as r:
+            return json.loads(r.read().decode())
+    with open(source) as f:
+        return json.load(f)
+
+
+def _span_end(s: Dict[str, Any]) -> float:
+    return (s.get("start_ts") or 0.0) + (s.get("duration_s") or 0.0)
+
+
+def trace_bounds(trace: Dict[str, Any]) -> tuple:
+    """(start, duration) of the whole trace from its spans (wall clock;
+    workers and the front door run on the same host or NTP-close ones)."""
+    spans = trace.get("spans") or []
+    if not spans:
+        return 0.0, 0.0
+    t0 = min(s.get("start_ts") or 0.0 for s in spans)
+    t1 = max(_span_end(s) for s in spans)
+    return t0, max(t1 - t0, 0.0)
+
+
+def _children(spans: List[Dict[str, Any]]) -> Dict[Optional[str], List[dict]]:
+    by_parent: Dict[Optional[str], List[dict]] = {}
+    ids = {s.get("span_id") for s in spans}
+    for s in spans:
+        pid = s.get("parent_id")
+        if pid not in ids:
+            pid = None  # remote/unseen parent: render as a root
+        by_parent.setdefault(pid, []).append(s)
+    for v in by_parent.values():
+        v.sort(key=lambda s: (s.get("start_ts") or 0.0))
+    return by_parent
+
+
+def _bar(offset_s: float, dur_s: float, total_s: float) -> str:
+    if total_s <= 0:
+        return " " * BAR_WIDTH
+    lo = int(round(offset_s / total_s * BAR_WIDTH))
+    hi = int(round((offset_s + dur_s) / total_s * BAR_WIDTH))
+    lo = min(max(lo, 0), BAR_WIDTH)
+    hi = min(max(hi, lo + 1), BAR_WIDTH)
+    return " " * lo + "#" * (hi - lo) + " " * (BAR_WIDTH - hi)
+
+
+def _attrs_summary(s: Dict[str, Any]) -> str:
+    attrs = s.get("attributes") or {}
+    keep = []
+    for k in ("stage", "target", "server", "status", "engine", "batch_size",
+              "error", "url", "trace_dir", "bytes"):
+        if k in attrs:
+            v = str(attrs[k])
+            keep.append(f"{k}={v[:60]}")
+    return ("  [" + " ".join(keep) + "]") if keep else ""
+
+
+def render_trace(trace: Dict[str, Any], out=None) -> None:
+    out = out or sys.stdout
+    t0, total = trace_bounds(trace)
+    spans = trace.get("spans") or []
+    header = (f"trace {trace.get('trace_id', '?')}  "
+              f"{total * 1e3:8.2f} ms  spans={len(spans)}")
+    if trace.get("retained"):
+        header += f"  retained={trace['retained']}"
+    if trace.get("truncated_spans"):
+        header += f"  (+{trace['truncated_spans']} spans truncated)"
+    print(header, file=out)
+    by_parent = _children(spans)
+
+    def walk(pid: Optional[str], depth: int) -> None:
+        for s in by_parent.get(pid, []):
+            off = (s.get("start_ts") or 0.0) - t0
+            dur = s.get("duration_s") or 0.0
+            mark = "!" if s.get("status") == "ERROR" else " "
+            print(f" {mark}[{_bar(off, dur, total)}] "
+                  f"{off * 1e3:8.2f} +{dur * 1e3:8.2f} ms  "
+                  f"{'  ' * depth}{s.get('name', '?')}"
+                  f"{_attrs_summary(s)}", file=out)
+            walk(s.get("span_id"), depth + 1)
+
+    walk(None, 0)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="waterfall viewer for /traces payloads")
+    ap.add_argument("source", help="endpoint URL (…/traces implied) or a "
+                                   "saved JSON file")
+    ap.add_argument("--top", type=int, default=10,
+                    help="show the N slowest traces (default 10)")
+    ap.add_argument("--trace", default=None,
+                    help="show only this trace id (prefix match)")
+    ap.add_argument("--min-ms", type=float, default=0.0,
+                    help="hide traces faster than this")
+    ap.add_argument("--errors-only", action="store_true",
+                    help="only traces retained for an error")
+    ap.add_argument("--json", action="store_true",
+                    help="dump the selected traces as JSON instead")
+    args = ap.parse_args(argv)
+
+    payload = load_payload(args.source)
+    traces = [t for t in (payload.get("traces") or []) if isinstance(t, dict)]
+    if args.trace:
+        traces = [t for t in traces
+                  if str(t.get("trace_id", "")).startswith(args.trace)]
+    if args.errors_only:
+        traces = [t for t in traces if t.get("retained") == "error"]
+    traces = [t for t in traces
+              if trace_bounds(t)[1] * 1e3 >= args.min_ms]
+    traces.sort(key=lambda t: trace_bounds(t)[1], reverse=True)
+    shown = traces[: args.top]
+
+    if args.json:
+        json.dump({"traces": shown}, sys.stdout, indent=2)
+        print()
+        return 0
+
+    stats = payload.get("stats") or {}
+    if stats:
+        print(f"flight recorder: {len(traces)} traces matched "
+              f"(dropped={stats.get('dropped', 0)}, "
+              f"active={stats.get('active', 0)})")
+    if not shown:
+        print("no traces matched")
+        return 1
+    for t in shown:
+        render_trace(t)
+        print()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
