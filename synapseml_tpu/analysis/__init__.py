@@ -1,0 +1,38 @@
+"""Static analysis: the repo-invariant lint engine and SMT rule pack.
+
+Stdlib-only (``ast``); importable before jax exists, and covered by the
+no-jax-at-import gate itself. See ``docs/analysis.md`` for the rule
+catalog and the waiver workflow (``LINT_ACKS.md``).
+
+Entry points: ``python -m synapseml_tpu.analysis`` / ``tools/lint.py``;
+programmatic: :func:`analyze_paths`.
+"""
+
+from .engine import (  # noqa: F401
+    RULES,
+    Finding,
+    LintConfigError,
+    Module,
+    Rule,
+    Waiver,
+    analyze_paths,
+    apply_waivers,
+    iter_python_files,
+    load_waivers,
+    register,
+)
+from . import rules  # noqa: F401  — populate RULES at import
+
+__all__ = [
+    "RULES",
+    "Finding",
+    "LintConfigError",
+    "Module",
+    "Rule",
+    "Waiver",
+    "analyze_paths",
+    "apply_waivers",
+    "iter_python_files",
+    "load_waivers",
+    "register",
+]

@@ -1,0 +1,8 @@
+"""``python -m synapseml_tpu.analysis`` — run the SMT lint rule pack."""
+
+import sys
+
+from .cli import main
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,135 @@
+"""Lint CLI: ``python -m synapseml_tpu.analysis [paths...]``.
+
+Output formats:
+
+- ``text`` (default): ``path:line:col: CODE message`` per finding plus a
+  summary line — the developer loop.
+- ``json``: the full report (findings, waived, unused waivers, errors) —
+  machine consumers.
+- ``github``: ``::error file=...,line=...`` workflow annotations so CI
+  failures are clickable at the offending line in the PR diff.
+
+Exit codes: 0 clean (waived findings allowed), 1 unwaived findings or
+unparseable files, 2 configuration errors (unknown rule, reasonless
+waiver, missing path).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from typing import List, Optional
+
+from .engine import RULES, Finding, LintConfigError, analyze_paths
+
+__all__ = ["main"]
+
+DEFAULT_PATHS = ["synapseml_tpu", "tools", "bench.py"]
+
+
+def _default_paths() -> List[str]:
+    """The standard lint targets, resolved against the repo root derived
+    from this package's location — so the bare CLI works from any cwd."""
+    root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    paths = [os.path.join(root, p) for p in DEFAULT_PATHS]
+    return [p for p in paths if os.path.exists(p)]
+
+
+def _rule_listing() -> str:
+    from . import rules as _rules  # noqa: F401 — populate the registry
+
+    lines = []
+    for code in sorted(RULES):
+        r = RULES[code]
+        lines.append(f"{code}  {r.name}\n        {r.rationale}")
+    return "\n".join(lines)
+
+
+def _github_escape(s: str) -> str:
+    # github workflow-command data escaping
+    return (s.replace("%", "%25").replace("\r", "%0D").replace("\n", "%0A"))
+
+
+def render_text(report: dict, out) -> None:
+    for f in report["findings"]:
+        print(f"{f.location}: {f.code} {f.message}", file=out)
+    for w in report["unused_waivers"]:
+        print(f"warning: unused waiver {w.rule} for {w.file!r} "
+              f"(LINT_ACKS.md:{w.line}) — delete the stale row", file=out)
+    for e in report["errors"]:
+        print(f"error: {e}", file=out)
+    n, w = len(report["findings"]), len(report["waived"])
+    print(f"{report['n_files']} files checked, {n} finding"
+          f"{'' if n == 1 else 's'}"
+          + (f" ({w} waived)" if w else ""), file=out)
+
+
+def render_json(report: dict, out) -> None:
+    def enc(f: Finding) -> dict:
+        return {"path": f.path, "line": f.line, "col": f.col,
+                "code": f.code, "message": f.message}
+
+    json.dump({
+        "findings": [enc(f) for f in report["findings"]],
+        "waived": [enc(f) for f in report["waived"]],
+        "unused_waivers": [{"rule": w.rule, "file": w.file,
+                            "match": w.match, "line": w.line}
+                           for w in report["unused_waivers"]],
+        "errors": report["errors"],
+        "n_files": report["n_files"],
+        "codes": report["codes"],
+    }, out, indent=2)
+    out.write("\n")
+
+
+def render_github(report: dict, out) -> None:
+    for f in report["findings"]:
+        print(f"::error file={f.path},line={f.line},col={f.col},"
+              f"title={f.code} {RULES[f.code].name}::"
+              f"{_github_escape(f.message)}", file=out)
+    for e in report["errors"]:
+        print(f"::error::{_github_escape(e)}", file=out)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m synapseml_tpu.analysis",
+        description="Repo-invariant lint: the SMT rule pack.")
+    ap.add_argument("paths", nargs="*", default=None,
+                    help=f"files/directories to lint "
+                         f"(default: {' '.join(DEFAULT_PATHS)})")
+    ap.add_argument("--format", choices=["text", "json", "github"],
+                    default="text")
+    ap.add_argument("--select", default=None,
+                    help="comma-separated rule codes (default: all)")
+    ap.add_argument("--acks", default=None,
+                    help="waiver file (default: LINT_ACKS.md found walking "
+                         "up from the first path)")
+    ap.add_argument("--no-acks", action="store_true",
+                    help="ignore waivers (report every finding)")
+    ap.add_argument("--list-rules", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        print(_rule_listing())
+        return 0
+
+    paths = args.paths or _default_paths()
+    select = ([c.strip().upper() for c in args.select.split(",") if c.strip()]
+              if args.select else None)
+    t0 = time.perf_counter()
+    try:
+        report = analyze_paths(paths, select=select, acks_path=args.acks,
+                               use_acks=not args.no_acks)
+    except (LintConfigError, FileNotFoundError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    {"text": render_text, "json": render_json,
+     "github": render_github}[args.format](report, sys.stdout)
+    if args.format == "text":
+        print(f"({time.perf_counter() - t0:.2f}s)", file=sys.stderr)
+    return 1 if (report["findings"] or report["errors"]) else 0

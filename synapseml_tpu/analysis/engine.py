@@ -1,0 +1,398 @@
+"""AST lint engine: file collection, scoped traversal, rule registry, waivers.
+
+The repo's load-bearing invariants (no jax at import, ``shard_map_compat``
+only, one fixed histogram bucket layout, stages never overriding the
+instrumented ``transform``/``fit``, lock discipline in the serving/metrics
+hot paths) were enforced by convention, docs, and a few runtime subprocess
+tests — and drift shipped silently. This engine makes every invariant a
+named, ``file:line``-precise, CI-failing diagnostic (the same move the
+reference makes with machine-readable ``Param`` metadata driving codegen:
+structure you can *check* beats structure you can only describe).
+
+Design constraints:
+
+- **stdlib only** (``ast`` + ``os``): the linter runs in CI and developer
+  loops before jax ever initializes, and is itself covered by the
+  no-jax-at-import gate.
+- **Single parse per file**, rules share the tree; a full-repo run must
+  stay under seconds.
+- **Waivers are reviewed decisions**: ``LINT_ACKS.md`` rows (mirroring
+  ``BENCH_ACKS.md``) carry a mandatory reason; a bare waiver is a config
+  error, not a pass.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+import re
+from typing import Callable, Dict, Iterable, List, Optional, Sequence, Tuple
+
+__all__ = [
+    "Finding",
+    "Module",
+    "Rule",
+    "RULES",
+    "register",
+    "Ctx",
+    "walk_scoped",
+    "dotted_name",
+    "iter_python_files",
+    "Waiver",
+    "load_waivers",
+    "apply_waivers",
+    "analyze_paths",
+    "LintConfigError",
+    "DEFAULT_ACKS_NAME",
+]
+
+DEFAULT_ACKS_NAME = "LINT_ACKS.md"
+
+
+@dataclasses.dataclass(frozen=True, order=True)
+class Finding:
+    """One diagnostic, anchored to a file:line:col."""
+
+    path: str  # repo-relative, posix separators
+    line: int
+    col: int
+    code: str
+    message: str
+
+    @property
+    def location(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}"
+
+
+class Module:
+    """One parsed source file handed to every rule."""
+
+    def __init__(self, path: str, rel: str, source: str, tree: ast.Module):
+        self.path = path          # absolute
+        self.rel = rel            # repo-relative, posix
+        self.source = source
+        self.tree = tree
+
+    @property
+    def is_init(self) -> bool:
+        return os.path.basename(self.path) == "__init__.py"
+
+    @property
+    def dirname(self) -> str:
+        return os.path.dirname(self.path)
+
+    @classmethod
+    def parse(cls, path: str, rel: str) -> "Module":
+        with open(path, encoding="utf-8") as f:
+            source = f.read()
+        return cls(path, rel, source, ast.parse(source, filename=path))
+
+
+class Rule:
+    """One named invariant. Subclasses set ``code``/``name``/``rationale``
+    and implement :meth:`check` yielding findings for one module."""
+
+    code: str = ""
+    name: str = ""
+    rationale: str = ""
+
+    def check(self, module: Module) -> Iterable[Finding]:  # pragma: no cover
+        raise NotImplementedError
+
+    def finding(self, module: Module, node: ast.AST, message: str) -> Finding:
+        return Finding(path=module.rel,
+                       line=getattr(node, "lineno", 1),
+                       col=getattr(node, "col_offset", 0) + 1,
+                       code=self.code, message=message)
+
+
+RULES: Dict[str, Rule] = {}
+
+
+def register(cls):
+    """Class decorator adding a rule (by instance) to the global registry."""
+    inst = cls()
+    if not re.fullmatch(r"[A-Z]{2,8}\d{3}", inst.code):
+        raise ValueError(f"rule code {inst.code!r} must look like SMT001")
+    if inst.code in RULES:
+        raise ValueError(f"duplicate rule code {inst.code}")
+    RULES[inst.code] = inst
+    return cls
+
+
+# ---------------------------------------------------------------------------
+# scoped traversal
+# ---------------------------------------------------------------------------
+
+_LOCKISH = ("lock", "mutex", "cond")
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` for Name/Attribute chains, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _terminal_name(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Call):
+        return _terminal_name(node.func)
+    return None
+
+
+def is_lock_expr(node: ast.AST) -> bool:
+    """Heuristic: a ``with`` context expression that names a lock —
+    ``self._lock``, ``outer._lock``, ``_pool_lock``, ``_key_lock(key)``."""
+    name = _terminal_name(node)
+    return bool(name) and any(p in name.lower() for p in _LOCKISH)
+
+
+@dataclasses.dataclass(frozen=True)
+class Ctx:
+    """Traversal context: enclosing functions/classes and lock nesting."""
+
+    funcs: Tuple[ast.AST, ...] = ()
+    classes: Tuple[ast.AST, ...] = ()
+    lock_depth: int = 0
+
+    @property
+    def in_lock(self) -> bool:
+        return self.lock_depth > 0
+
+    @property
+    def in_function(self) -> bool:
+        return bool(self.funcs)
+
+    @property
+    def in_constructor(self) -> bool:
+        """Directly inside ``__init__``/``__new__`` (construction
+        happens-before publication, so unlocked writes there are safe) —
+        nested functions defined inside a constructor do NOT count: their
+        bodies run later, from arbitrary threads."""
+        return bool(self.funcs) and self.funcs[-1].name in ("__init__",
+                                                           "__new__")
+
+
+def walk_scoped(tree: ast.Module, visit: Callable[[ast.AST, Ctx], None]
+                ) -> None:
+    """Depth-first walk calling ``visit(node, ctx)`` for every node, with
+    ``ctx`` tracking enclosing functions, classes, and with-lock regions.
+    The lock region covers a ``with``'s *body* (not its context
+    expressions)."""
+
+    def rec(node: ast.AST, ctx: Ctx) -> None:
+        visit(node, ctx)
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # a nested function's BODY runs later, when the enclosing
+            # with-lock (if any) has been released — don't carry lock_depth
+            # into it (a callback defined under a lock is not "under" it)
+            inner = dataclasses.replace(ctx, funcs=ctx.funcs + (node,),
+                                        lock_depth=0)
+            for d in node.decorator_list:
+                rec(d, ctx)
+            for child in node.args.defaults + node.args.kw_defaults:
+                if child is not None:
+                    rec(child, ctx)
+            for child in node.body:
+                rec(child, inner)
+            return
+        if isinstance(node, ast.ClassDef):
+            inner = dataclasses.replace(ctx, classes=ctx.classes + (node,))
+            for d in node.decorator_list + node.bases:
+                rec(d, ctx)
+            for child in node.body:
+                rec(child, inner)
+            return
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            locked = any(is_lock_expr(item.context_expr)
+                         for item in node.items)
+            for item in node.items:
+                rec(item.context_expr, ctx)
+                if item.optional_vars is not None:
+                    rec(item.optional_vars, ctx)
+            body_ctx = (dataclasses.replace(ctx, lock_depth=ctx.lock_depth + 1)
+                        if locked else ctx)
+            for child in node.body:
+                rec(child, body_ctx)
+            return
+        for child in ast.iter_child_nodes(node):
+            rec(child, ctx)
+
+    for stmt in tree.body:
+        rec(stmt, Ctx())
+
+
+# ---------------------------------------------------------------------------
+# file collection
+# ---------------------------------------------------------------------------
+
+def iter_python_files(paths: Sequence[str], root: Optional[str] = None
+                      ) -> List[Tuple[str, str]]:
+    """Expand files/directories into sorted (abspath, relpath) pairs.
+    Directories are walked recursively; ``__pycache__``, hidden and
+    egg/build directories are skipped. ``root`` anchors the displayed
+    relative paths (defaults to the common parent of ``paths``)."""
+    out: List[Tuple[str, str]] = []
+    abspaths = [os.path.abspath(p) for p in paths]
+    if root is None:
+        root = os.path.commonpath([p if os.path.isdir(p)
+                                   else os.path.dirname(p) or "."
+                                   for p in abspaths]) if abspaths else "."
+    for p in abspaths:
+        if os.path.isfile(p):
+            out.append(p)
+            continue
+        if not os.path.isdir(p):
+            raise FileNotFoundError(f"no such file or directory: {p}")
+        for dirpath, dirnames, filenames in os.walk(p):
+            dirnames[:] = sorted(d for d in dirnames
+                                 if d != "__pycache__"
+                                 and not d.startswith(".")
+                                 and not d.endswith(".egg-info"))
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    out.append(os.path.join(dirpath, fn))
+    uniq = sorted(set(out))
+    return [(p, os.path.relpath(p, root).replace(os.sep, "/")) for p in uniq]
+
+
+# ---------------------------------------------------------------------------
+# waivers (LINT_ACKS.md)
+# ---------------------------------------------------------------------------
+
+class LintConfigError(ValueError):
+    """The waiver file itself is malformed (e.g. a reasonless row)."""
+
+
+@dataclasses.dataclass(frozen=True)
+class Waiver:
+    rule: str
+    file: str
+    match: str   # substring of the finding message; "" waives any message
+    reason: str
+    line: int    # line in LINT_ACKS.md, for unused-waiver reporting
+
+
+def load_waivers(path: str) -> List[Waiver]:
+    """Parse the ``| rule | file | match | reason |`` table rows of a
+    ``LINT_ACKS.md`` (the ``BENCH_ACKS.md`` pattern). Every row must carry
+    a non-empty reason — a bare waiver is a :class:`LintConfigError`, not
+    a pass."""
+    waivers: List[Waiver] = []
+    with open(path, encoding="utf-8") as f:
+        for lineno, raw in enumerate(f, 1):
+            line = raw.strip()
+            if not line.startswith("|"):
+                continue
+            cells = [c.strip() for c in line.strip("|").split("|")]
+            if len(cells) < 4 or not re.fullmatch(r"[A-Z]{2,8}\d{3}",
+                                                  cells[0]):
+                continue  # header / separator / prose row
+            rule, file_, match, reason = (cells[0], cells[1], cells[2],
+                                          "|".join(cells[3:]).strip())
+            match = "" if match in ("", "-", "*") else match
+            if not reason or set(reason) <= {"-"}:
+                raise LintConfigError(
+                    f"{path}:{lineno}: waiver for {rule} on {file_!r} has "
+                    f"no reason — waivers are reviewed decisions; add one")
+            waivers.append(Waiver(rule=rule, file=file_.strip("`"),
+                                  match=match.strip("`"), reason=reason,
+                                  line=lineno))
+    return waivers
+
+
+def apply_waivers(findings: Sequence[Finding], waivers: Sequence[Waiver]
+                  ) -> Tuple[List[Finding], List[Finding], List[Waiver]]:
+    """Split findings into (unwaived, waived); also return waivers that
+    matched nothing (stale rows worth deleting)."""
+    used = [False] * len(waivers)
+    unwaived: List[Finding] = []
+    waived: List[Finding] = []
+    for f in findings:
+        hit = False
+        for i, w in enumerate(waivers):
+            if (w.rule == f.code and w.file == f.path
+                    and (not w.match or w.match in f.message)):
+                used[i] = True
+                hit = True
+        (waived if hit else unwaived).append(f)
+    unused = [w for i, w in enumerate(waivers) if not used[i]]
+    return unwaived, waived, unused
+
+
+# ---------------------------------------------------------------------------
+# top-level analysis
+# ---------------------------------------------------------------------------
+
+def default_acks_path(paths: Sequence[str]) -> Optional[str]:
+    """Locate ``LINT_ACKS.md`` by walking up from the first scanned path
+    (the repo root holds it, mirroring ``BENCH_ACKS.md``)."""
+    start = os.path.abspath(paths[0]) if paths else os.getcwd()
+    cur = start if os.path.isdir(start) else os.path.dirname(start)
+    while True:
+        cand = os.path.join(cur, DEFAULT_ACKS_NAME)
+        if os.path.isfile(cand):
+            return cand
+        parent = os.path.dirname(cur)
+        if parent == cur:
+            return None
+        cur = parent
+
+
+def analyze_paths(paths: Sequence[str],
+                  select: Optional[Sequence[str]] = None,
+                  acks_path: Optional[str] = None,
+                  use_acks: bool = True,
+                  root: Optional[str] = None) -> Dict[str, object]:
+    """Run the (selected) rule pack over ``paths``.
+
+    Returns a report dict: ``findings`` (unwaived), ``waived``,
+    ``unused_waivers``, ``errors`` (unparseable files), ``n_files``.
+    """
+    # rules register on import of the sibling module; import here so the
+    # engine is usable standalone in tests with a hand-built registry
+    from . import rules as _rules  # noqa: F401
+
+    codes = sorted(RULES) if not select else sorted(select)
+    unknown = [c for c in codes if c not in RULES]
+    if unknown:
+        raise LintConfigError(f"unknown rule code(s): {', '.join(unknown)}; "
+                              f"known: {', '.join(sorted(RULES))}")
+    if use_acks and acks_path is None:
+        acks_path = default_acks_path(list(paths))
+    if root is None and use_acks and acks_path is not None:
+        # anchor displayed (and waiver-matched) paths at the repo root —
+        # the directory holding LINT_ACKS.md — so `analysis synapseml_tpu`
+        # and `analysis synapseml_tpu tools bench.py` report identical
+        # paths and waiver rows match either way
+        root = os.path.dirname(os.path.abspath(acks_path))
+    findings: List[Finding] = []
+    errors: List[str] = []
+    files = iter_python_files(paths, root=root)
+    for path, rel in files:
+        try:
+            module = Module.parse(path, rel)
+        except (SyntaxError, UnicodeDecodeError) as e:
+            errors.append(f"{rel}: {e.__class__.__name__}: {e}")
+            continue
+        for code in codes:
+            findings.extend(RULES[code].check(module))
+    findings.sort()
+    waivers: List[Waiver] = []
+    if use_acks and acks_path is not None:
+        waivers = load_waivers(acks_path)
+    unwaived, waived, unused = apply_waivers(findings, waivers)
+    return {"findings": unwaived, "waived": waived,
+            "unused_waivers": unused, "errors": errors,
+            "n_files": len(files), "acks_path": acks_path,
+            "codes": codes}

@@ -1,0 +1,686 @@
+"""The SMT rule pack: this repo's load-bearing invariants as lint rules.
+
+Each rule names one invariant, says why it is load-bearing, and yields
+``file:line`` findings. Heuristic rules (SMT006/SMT007) are tuned on the
+real lock sites in ``observability/``, ``io/serving*.py`` and ``runtime/``;
+anything they over-flag gets a reasoned ``LINT_ACKS.md`` row, never a
+silent exemption. Fixture-level true-positive/true-negative coverage for
+every rule lives in ``tests/test_lint_clean.py``.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from .engine import (Ctx, Finding, Module, Rule, dotted_name, is_lock_expr,
+                     register, walk_scoped)
+
+__all__ = []  # rules are reached through engine.RULES
+
+
+def _is_jax_module(name: Optional[str]) -> bool:
+    return bool(name) and (name == "jax" or name.startswith("jax."))
+
+
+def _imports_jax(node: ast.AST) -> bool:
+    if isinstance(node, ast.Import):
+        return any(_is_jax_module(a.name) for a in node.names)
+    if isinstance(node, ast.ImportFrom):
+        return _is_jax_module(node.module)
+    return False
+
+
+def _is_type_checking_if(node: ast.AST) -> bool:
+    if not isinstance(node, ast.If):
+        return False
+    t = node.test
+    return (isinstance(t, ast.Name) and t.id == "TYPE_CHECKING") or (
+        isinstance(t, ast.Attribute) and t.attr == "TYPE_CHECKING")
+
+
+@register
+class ModuleLevelJaxImport(Rule):
+    """SMT001 — jax imported at module import time.
+
+    ``import synapseml_tpu`` (and every operational layer a serving worker
+    or CLI tool touches at startup) must never import jax: initialization
+    is slow, environment-sensitive, and grabs accelerator state. The
+    subprocess gate in ``tests/test_import_hygiene.py`` stays the ground
+    truth (it catches *transitive* imports this AST pass cannot); this
+    rule adds the file:line diagnostic per offending statement, over every
+    file instead of a curated module list. Fix: import inside the function
+    that uses it, or use ``core.lazyimport.lazy_import``.
+    """
+
+    code = "SMT001"
+    name = "module-level-jax-import"
+    rationale = ("jax at import time breaks the no-jax-at-import contract "
+                 "every worker/CLI startup relies on")
+
+    def check(self, module: Module) -> Iterable[Finding]:
+        findings: List[Finding] = []
+
+        def rec(node: ast.AST) -> None:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                return  # function bodies run post-import
+            if _is_type_checking_if(node):
+                return  # typing-only imports never execute
+            if _imports_jax(node):
+                what = (", ".join(a.name for a in node.names)
+                        if isinstance(node, ast.Import) else node.module)
+                findings.append(self.finding(
+                    module, node,
+                    f"module-level import of {what!r} runs at import time; "
+                    f"import jax inside the using function or via "
+                    f"core.lazyimport.lazy_import"))
+                return
+            for child in ast.iter_child_nodes(node):
+                rec(child)
+
+        for stmt in module.tree.body:
+            rec(stmt)
+        return findings
+
+
+@register
+class DirectShardMap(Rule):
+    """SMT002 — ``shard_map`` imported/used directly instead of through
+    ``runtime.topology.shard_map_compat``.
+
+    jax moved ``shard_map`` between ``jax.experimental`` (0.4.x,
+    ``check_rep=``) and top level (``check_vma=``); direct imports are
+    exactly the drift that shipped 8 mesh-test ImportErrors in the seed.
+    Every mesh-distributed call site goes through the compat wrapper, which
+    picks the interpreter's spelling at call time.
+    """
+
+    code = "SMT002"
+    name = "direct-shard-map"
+    rationale = ("direct shard_map imports break across jax versions; "
+                 "runtime.topology.shard_map_compat absorbs the drift")
+
+    def check(self, module: Module) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.ImportFrom):
+                mod = node.module or ""
+                names = {a.name for a in node.names}
+                if (mod == "jax.experimental.shard_map"
+                        or (mod in ("jax", "jax.experimental")
+                            and "shard_map" in names)):
+                    findings.append(self.finding(
+                        module, node,
+                        f"direct shard_map import from {mod!r}; use "
+                        f"runtime.topology.shard_map_compat"))
+            elif isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.name.startswith("jax.experimental.shard_map"):
+                        findings.append(self.finding(
+                            module, node,
+                            f"direct import of {a.name!r}; use "
+                            f"runtime.topology.shard_map_compat"))
+            elif isinstance(node, ast.Attribute):
+                dn = dotted_name(node)
+                if dn in ("jax.shard_map",
+                          "jax.experimental.shard_map",
+                          "jax.experimental.shard_map.shard_map"):
+                    findings.append(self.finding(
+                        module, node,
+                        f"direct use of {dn}; use "
+                        f"runtime.topology.shard_map_compat"))
+        return findings
+
+
+def _is_wallclock_call(node: ast.AST, bare_time: bool) -> bool:
+    """A ``time.time()`` call (or bare ``time()`` when imported that way)."""
+    if not isinstance(node, ast.Call):
+        return False
+    dn = dotted_name(node.func)
+    return dn == "time.time" or (bare_time and dn == "time")
+
+
+@register
+class WallClockDelta(Rule):
+    """SMT003 — durations computed from ``time.time()`` deltas.
+
+    Wall-clock deltas jump under NTP slew; every elapsed-time measurement
+    uses ``time.perf_counter()`` / ``core.clock.StopWatch``. Timestamp-only
+    uses of ``time.time()`` (event ``ts`` fields, exemplar ages) are fine —
+    the rule only flags *subtractions* whose both operands trace back to
+    wall-clock reads.
+    """
+
+    code = "SMT003"
+    name = "wall-clock-delta"
+    rationale = ("time.time() deltas jump under NTP slew; durations use "
+                 "perf_counter / core.clock.StopWatch")
+
+    def check(self, module: Module) -> Iterable[Finding]:
+        bare_time = any(
+            isinstance(n, ast.ImportFrom) and n.module == "time"
+            and any(a.name == "time" for a in n.names)
+            for n in ast.walk(module.tree))
+
+        def taint_targets(stmt: ast.AST, names: Set[str],
+                          attrs: Set[str]) -> None:
+            if isinstance(stmt, ast.Assign) and _is_wallclock_call(
+                    stmt.value, bare_time):
+                for t in stmt.targets:
+                    for el in (t.elts if isinstance(t, ast.Tuple) else [t]):
+                        if isinstance(el, ast.Name):
+                            names.add(el.id)
+                        elif isinstance(el, ast.Attribute):
+                            attrs.add(el.attr)
+
+        # attribute taint is module-wide (self._start set in start(), read
+        # in stop()); NAME taint is per function scope — a `t0` holding a
+        # wall timestamp in one function must not poison a `t0` holding a
+        # perf_counter in another
+        attr_tainted: Set[str] = set()
+        for node in ast.walk(module.tree):
+            taint_targets(node, set(), attr_tainted)
+
+        findings: List[Finding] = []
+
+        def process_scope(body, inherited: Set[str]) -> None:
+            tainted = set(inherited)
+            nested: List[ast.AST] = []
+
+            def rec(n: ast.AST, collect_only: bool) -> None:
+                for child in ast.iter_child_nodes(n):
+                    if isinstance(child, (ast.FunctionDef,
+                                          ast.AsyncFunctionDef)):
+                        if collect_only:
+                            nested.append(child)
+                        continue  # separate name scope (closure inherits)
+                    if collect_only:
+                        taint_targets(child, tainted, set())
+                    elif (isinstance(child, ast.BinOp)
+                            and isinstance(child.op, ast.Sub)
+                            and wallclockish(child.left, tainted)
+                            and wallclockish(child.right, tainted)):
+                        findings.append(self.finding(
+                            module, child,
+                            "duration computed as a time.time() delta; use "
+                            "time.perf_counter() or core.clock.StopWatch"))
+                    rec(child, collect_only)
+
+            holder = ast.Module(body=body, type_ignores=[])
+            rec(holder, True)
+            rec(holder, False)
+            for fn in nested:
+                process_scope(fn.body, tainted)
+
+        def wallclockish(node: ast.AST, tainted: Set[str]) -> bool:
+            if _is_wallclock_call(node, bare_time):
+                return True
+            if isinstance(node, ast.Name):
+                return node.id in tainted
+            if isinstance(node, ast.Attribute):
+                return node.attr in attr_tainted
+            return False
+
+        process_scope(module.tree.body, set())
+        return findings
+
+
+@register
+class NonDefaultHistogramBuckets(Rule):
+    """SMT004 — ``Histogram``/``registry.histogram`` constructed with
+    non-default buckets.
+
+    Fleet quantiles come from *bucket-wise merged* worker histograms; the
+    merge is exact only because every histogram in every process shares the
+    single fixed ``DEFAULT_BUCKETS`` layout. One histogram with custom
+    buckets silently breaks exact fleet merge for its family.
+    """
+
+    code = "SMT004"
+    name = "non-default-histogram-buckets"
+    rationale = ("per-worker histograms merge exactly only on the one fixed "
+                 "DEFAULT_BUCKETS layout")
+
+    def check(self, module: Module) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fname = None
+            if isinstance(node.func, ast.Attribute):
+                fname = node.func.attr
+            elif isinstance(node.func, ast.Name):
+                fname = node.func.id
+            if fname not in ("histogram", "Histogram"):
+                continue
+            offending = None
+            for kw in node.keywords:
+                if kw.arg == "buckets":
+                    v = kw.value
+                    vn = dotted_name(v)
+                    if not (vn and vn.split(".")[-1] == "DEFAULT_BUCKETS"):
+                        offending = kw.value
+            # positional buckets only exist on registry.histogram(name,
+            # help, labelnames, buckets) — attribute calls; a bare-name
+            # histogram() is the gbdt kernel, whose 4th arg is a weight
+            if (offending is None and len(node.args) >= 4
+                    and fname == "histogram"
+                    and isinstance(node.func, ast.Attribute)):
+                offending = node.args[3]
+            if offending is not None:
+                findings.append(self.finding(
+                    module, offending,
+                    "histogram constructed with non-default buckets; "
+                    "per-worker merge is exact only on DEFAULT_BUCKETS"))
+        return findings
+
+
+_STAGE_BASES = {"PipelineStage", "Transformer", "Estimator", "Model",
+                "UnaryTransformer", "PipelineModel"}
+_STAGE_SUFFIXES = ("Transformer", "Estimator", "Model", "Stage")
+
+
+@register
+class StageOverridesInstrumentedMethod(Rule):
+    """SMT005 — a registered ``PipelineStage`` subclass overrides base
+    ``transform``/``fit``.
+
+    Span instrumentation (wall time, row counts, cold/warm compile split,
+    trace attachment) lives in the base ``Transformer.transform`` /
+    ``Estimator.fit``; stages implement ``_transform``/``_fit``. An
+    override silently drops the stage out of every ``/metrics`` and
+    ``/traces`` view. Framework bases opt out with ``_abstract_stage =
+    True`` in their own body; ``_``-prefixed classes are never registered.
+    """
+
+    code = "SMT005"
+    name = "stage-overrides-instrumented-method"
+    rationale = ("base transform/fit carry span instrumentation; stages "
+                 "implement _transform/_fit")
+
+    def check(self, module: Module) -> Iterable[Finding]:
+        # local class graph so in-module subclass chains resolve
+        local_bases: Dict[str, Set[str]] = {}
+        classes: List[ast.ClassDef] = [
+            n for n in ast.walk(module.tree) if isinstance(n, ast.ClassDef)]
+        for cls in classes:
+            names = set()
+            for b in cls.bases:
+                dn = dotted_name(b)
+                if dn:
+                    names.add(dn.split(".")[-1])
+            local_bases[cls.name] = names
+
+        def is_stage_base(name: str, seen: Set[str]) -> bool:
+            if name in _STAGE_BASES or name.endswith(_STAGE_SUFFIXES):
+                return True
+            if name in seen or name not in local_bases:
+                return False
+            seen.add(name)
+            return any(is_stage_base(b, seen) for b in local_bases[name])
+
+        findings: List[Finding] = []
+        for cls in classes:
+            if cls.name.startswith("_"):
+                continue  # never registered (test/bench-local stages)
+            abstract = any(
+                isinstance(st, ast.Assign)
+                and any(isinstance(t, ast.Name) and t.id == "_abstract_stage"
+                        for t in st.targets)
+                and isinstance(st.value, ast.Constant) and st.value.value
+                for st in cls.body)
+            if abstract:
+                continue
+            if not any(is_stage_base(b, set()) for b in local_bases[cls.name]):
+                continue
+            for st in cls.body:
+                if (isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef))
+                        and st.name in ("transform", "fit")):
+                    findings.append(self.finding(
+                        module, st,
+                        f"stage {cls.name} overrides instrumented base "
+                        f"method {st.name}(); implement _{st.name}() — the "
+                        f"base carries span instrumentation"))
+        return findings
+
+
+_MUTATORS = {"append", "appendleft", "extend", "extendleft", "insert",
+             "remove", "pop", "popleft", "popitem", "clear", "update",
+             "setdefault", "add", "discard", "rotate"}
+
+
+def _mutations(node: ast.AST) -> List[Tuple[str, str, ast.AST]]:
+    """Shared-state mutations in one AST node: ``[(kind, name, site)]``
+    where kind is 'attr' (``X.name = / X.name[k] = / X.name.append()``)
+    or 'name' (``NAME[k] = / NAME.append() / NAME = `` for globals)."""
+    out: List[Tuple[str, str, ast.AST]] = []
+
+    def target(t: ast.AST) -> None:
+        if isinstance(t, (ast.Tuple, ast.List)):
+            for el in t.elts:
+                target(el)
+        elif isinstance(t, ast.Starred):
+            target(t.value)
+        elif isinstance(t, ast.Attribute):
+            out.append(("attr", t.attr, t))
+        elif isinstance(t, ast.Subscript):
+            if isinstance(t.value, ast.Attribute):
+                out.append(("attr", t.value.attr, t))
+            elif isinstance(t.value, ast.Name):
+                out.append(("name", t.value.id, t))
+        elif isinstance(t, ast.Name):
+            out.append(("name", t.id, t))
+
+    if isinstance(node, ast.Assign):
+        for t in node.targets:
+            target(t)
+    elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+        target(node.target)
+    elif isinstance(node, ast.Delete):
+        for t in node.targets:
+            target(t)
+    elif (isinstance(node, ast.Call)
+          and isinstance(node.func, ast.Attribute)
+          and node.func.attr in _MUTATORS):
+        recv = node.func.value
+        if isinstance(recv, ast.Attribute):
+            out.append(("attr", recv.attr, node))
+        elif isinstance(recv, ast.Name):
+            out.append(("name", recv.id, node))
+    return out
+
+
+def _local_bindings(func: ast.AST) -> Set[str]:
+    """Names a function binds locally (bare assignments / for targets /
+    with-as, excluding nested function bodies): per Python scoping, such a
+    name is local for the WHOLE function unless declared ``global``."""
+    out: Set[str] = set()
+
+    def names_of(t: ast.AST) -> None:
+        if isinstance(t, ast.Name):
+            out.add(t.id)
+        elif isinstance(t, (ast.Tuple, ast.List)):
+            for el in t.elts:
+                names_of(el)
+        elif isinstance(t, ast.Starred):
+            names_of(t.value)
+
+    if isinstance(func, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        a = func.args
+        for arg in (a.posonlyargs + a.args + a.kwonlyargs
+                    + ([a.vararg] if a.vararg else [])
+                    + ([a.kwarg] if a.kwarg else [])):
+            out.add(arg.arg)
+
+    def rec(node: ast.AST) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda, ast.ClassDef)):
+                continue  # separate scope
+            if isinstance(child, ast.Assign):
+                for t in child.targets:
+                    names_of(t)
+            elif isinstance(child, (ast.AugAssign, ast.AnnAssign)):
+                names_of(child.target)
+            elif isinstance(child, (ast.For, ast.AsyncFor)):
+                names_of(child.target)
+            elif isinstance(child, (ast.With, ast.AsyncWith)):
+                for item in child.items:
+                    if item.optional_vars is not None:
+                        names_of(item.optional_vars)
+            rec(child)
+
+    rec(func)
+    return out
+
+
+def _module_level_names(tree: ast.Module) -> Set[str]:
+    names: Set[str] = set()
+    for st in tree.body:
+        if isinstance(st, ast.Assign):
+            for t in st.targets:
+                if isinstance(t, ast.Name):
+                    names.add(t.id)
+        elif isinstance(st, (ast.AnnAssign, ast.AugAssign)):
+            if isinstance(st.target, ast.Name):
+                names.add(st.target.id)
+    return names
+
+
+@register
+class UnlockedSharedWrite(Rule):
+    """SMT006 — lock-protected state written outside the lock.
+
+    Heuristic race check, tuned on the lock sites across ``observability/``,
+    ``io/serving*.py`` and ``runtime/``: an attribute (or module global)
+    that is *ever* mutated inside a ``with <lock>`` block is treated as
+    lock-protected; any mutation of the same attribute outside a lock
+    region is a finding. Constructor bodies (``__init__``/``__new__``) and
+    module top level are exempt — construction happens-before publication.
+    Unlocked *reads* are deliberately not flagged (lock-free fast-path
+    reads are an intentional pattern here, e.g. double-checked
+    ``shared_singleton``).
+    """
+
+    code = "SMT006"
+    name = "unlocked-shared-write"
+    rationale = ("state mutated under a lock in one place and without it in "
+                 "another is a data race the GIL does not excuse")
+
+    def check(self, module: Module) -> Iterable[Finding]:
+        module_globals = _module_level_names(module.tree)
+        protected_attrs: Set[str] = set()
+        protected_globals: Set[str] = set()
+        global_decls: Dict[int, Set[str]] = {}  # id(func) -> declared names
+        locals_cache: Dict[int, Set[str]] = {}
+
+        def _is_global_write(name: str, site: ast.AST, ctx: Ctx) -> bool:
+            """A Name-rooted mutation counts as *shared* only when it can
+            reach module state: a bare ``name = ...`` in a function binds a
+            local unless declared ``global``, and a locally-bound name is
+            local for the whole function scope; subscript/mutator-call
+            sites mutate the object a module-level name refers to."""
+            if not ctx.funcs:
+                # module-level code runs at import (single-threaded)
+                return not isinstance(site, ast.Name) and \
+                    name in module_globals
+            fn = ctx.funcs[-1]
+            if name in global_decls.get(id(fn), ()):
+                return True
+            if isinstance(site, ast.Name):
+                return False  # bare assign without global: binds a local
+            key = id(fn)
+            if key not in locals_cache:
+                locals_cache[key] = _local_bindings(fn)
+            if name in locals_cache[key]:
+                return False  # shadowed: every use in this scope is local
+            return name in module_globals
+
+        def collect(node: ast.AST, ctx: Ctx) -> None:
+            if isinstance(node, ast.Global) and ctx.funcs:
+                global_decls.setdefault(
+                    id(ctx.funcs[-1]), set()).update(node.names)
+            if not ctx.in_lock:
+                return
+            for kind, name, site in _mutations(node):
+                if kind == "attr":
+                    protected_attrs.add(name)
+                elif _is_global_write(name, site, ctx):
+                    protected_globals.add(name)
+
+        walk_scoped(module.tree, collect)
+        if not protected_attrs and not protected_globals:
+            return []
+
+        findings: List[Finding] = []
+
+        def flag(node: ast.AST, ctx: Ctx) -> None:
+            if ctx.in_lock or not ctx.in_function or ctx.in_constructor:
+                return
+            for kind, name, site in _mutations(node):
+                if kind == "attr" and name in protected_attrs:
+                    findings.append(self.finding(
+                        module, site,
+                        f"attribute {name!r} is mutated under a lock "
+                        f"elsewhere in this module but written here without "
+                        f"one"))
+                elif (kind == "name" and name in protected_globals
+                        and _is_global_write(name, site, ctx)):
+                    findings.append(self.finding(
+                        module, site,
+                        f"module global {name!r} is mutated under a lock "
+                        f"elsewhere in this module but written here without "
+                        f"one"))
+
+        walk_scoped(module.tree, flag)
+        return findings
+
+
+_BLOCKING_DOTTED = {
+    "time.sleep", "select.select", "subprocess.run", "subprocess.call",
+    "subprocess.check_call", "subprocess.check_output", "subprocess.Popen",
+    "urllib.request.urlopen", "socket.create_connection", "os.system",
+    "requests.get", "requests.post", "requests.request",
+}
+_BLOCKING_ATTRS = {"recv", "accept", "connect", "sendall", "urlopen",
+                   "wait", "result", "block_until_ready"}
+_JAX_ROOTS = {"jax", "jnp", "lax"}
+
+
+@register
+class BlockingWorkUnderLock(Rule):
+    """SMT007 — blocking I/O or jax dispatch while holding a lock.
+
+    The family locks sit on the serving request hot path; a scrape or
+    request that blocks on the network / a device computation while holding
+    one turns every concurrent observation into queued p99. Flags known
+    blocking calls (sleep, socket/subprocess/urllib, ``.wait()``/
+    ``.result()``) and any jax dispatch (``jax.* / jnp.* / lax.*``,
+    ``.block_until_ready()``) inside ``with <lock>`` bodies.
+    """
+
+    code = "SMT007"
+    name = "blocking-work-under-lock"
+    rationale = ("network / device / sleep work under a lock serializes "
+                 "every concurrent hot-path observation behind it")
+
+    def check(self, module: Module) -> Iterable[Finding]:
+        findings: List[Finding] = []
+
+        def visit(node: ast.AST, ctx: Ctx) -> None:
+            if not ctx.in_lock or not isinstance(node, ast.Call):
+                return
+            dn = dotted_name(node.func)
+            reason = None
+            if dn is not None:
+                root = dn.split(".")[0]
+                if dn in _BLOCKING_DOTTED:
+                    reason = f"blocking call {dn}()"
+                elif root in _JAX_ROOTS:
+                    reason = f"jax dispatch {dn}()"
+            if (reason is None and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _BLOCKING_ATTRS
+                    and not isinstance(node.func.value, ast.Constant)):
+                reason = f"blocking call .{node.func.attr}()"
+            if reason is not None:
+                findings.append(self.finding(
+                    module, node,
+                    f"{reason} while holding a lock; move the blocking "
+                    f"work outside the critical section"))
+
+        walk_scoped(module.tree, visit)
+        return findings
+
+
+# cache of "does this file use jax" verdicts, keyed by absolute path
+_JAX_USING_CACHE: Dict[str, bool] = {}
+
+
+def _file_uses_jax(path: str) -> bool:
+    cached = _JAX_USING_CACHE.get(path)
+    if cached is not None:
+        return cached
+    verdict = False
+    try:
+        with open(path, encoding="utf-8") as f:
+            src = f.read()
+        if "jax" in src:  # cheap pre-filter before parsing
+            for node in ast.walk(ast.parse(src)):
+                if _imports_jax(node):
+                    verdict = True
+                    break
+                if (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Name)
+                        and node.func.id == "lazy_import"
+                        and node.args
+                        and isinstance(node.args[0], ast.Constant)
+                        and isinstance(node.args[0].value, str)
+                        and _is_jax_module(node.args[0].value)):
+                    verdict = True
+                    break
+    except (OSError, SyntaxError):
+        verdict = False
+    _JAX_USING_CACHE[path] = verdict
+    return verdict
+
+
+@register
+class EagerJaxSubpackageInit(Rule):
+    """SMT008 — a package ``__init__`` eagerly imports a jax-using
+    submodule instead of exporting via ``core/lazyimport.py`` (PEP 562).
+
+    ``import synapseml_tpu.gbdt`` must stay cheap and jax-free even though
+    the trainer underneath uses jax everywhere: serving workers, scrapers
+    and tools import packages at startup. The fix is
+    ``lazy_module(__name__, {...})`` — attribute access imports the owning
+    submodule on demand. Direct-submodule depth only (``from .boost import
+    train``); the subprocess hygiene gate remains the transitive ground
+    truth.
+    """
+
+    code = "SMT008"
+    name = "eager-jax-subpackage-init"
+    rationale = ("eager __init__ imports of jax-using submodules make "
+                 "package import pay for the whole trainer")
+
+    def check(self, module: Module) -> Iterable[Finding]:
+        if not module.is_init:
+            return []
+        findings: List[Finding] = []
+        for node in module.tree.body:
+            targets: List[Tuple[str, str]] = []  # (display, abs path base)
+            if isinstance(node, ast.ImportFrom) and node.level >= 1:
+                base = module.dirname
+                for _ in range(node.level - 1):
+                    base = os.path.dirname(base)
+                if node.module is None:
+                    targets = [(a.name, os.path.join(
+                        base, *a.name.split("."))) for a in node.names]
+                else:
+                    targets = [(node.module, os.path.join(
+                        base, *node.module.split(".")))]
+            elif isinstance(node, ast.ImportFrom) and node.module and \
+                    node.module.startswith("synapseml_tpu."):
+                # absolute self-import: find the package root on the
+                # FILESYSTEM (walk up to the 'synapseml_tpu' directory) —
+                # rel-path depth depends on where the scan was rooted
+                top = node.module.split(".")[0]
+                root = module.dirname
+                while (os.path.basename(root) != top
+                       and os.path.dirname(root) != root):
+                    root = os.path.dirname(root)
+                if os.path.basename(root) == top:
+                    targets = [(node.module, os.path.join(
+                        os.path.dirname(root), *node.module.split(".")))]
+            for display, base in targets:
+                for cand in (base + ".py", os.path.join(base, "__init__.py")):
+                    if os.path.isfile(cand) and _file_uses_jax(cand):
+                        findings.append(self.finding(
+                            module, node,
+                            f"eager import of jax-using submodule "
+                            f"{display!r} in package __init__; export via "
+                            f"core.lazyimport.lazy_module (PEP 562)"))
+                        break
+        return findings

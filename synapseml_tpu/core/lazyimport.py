@@ -1,0 +1,131 @@
+"""Lazy import machinery: PEP 562 package exports + lazy module proxies.
+
+Two invariants of this repo meet here:
+
+- **No jax at import**: ``import synapseml_tpu.<anything>`` must never pull
+  in jax (worker processes, scrapers and CLI tools import the package at
+  startup; jax initialization is slow and environment-sensitive). Enforced
+  by ``tests/test_import_hygiene.py`` (subprocess ground truth) and lint
+  rule SMT001 (file:line diagnostics).
+- **Cheap subpackage imports**: a package ``__init__`` that eagerly imports
+  jax-heavy submodules makes ``import synapseml_tpu.gbdt`` pay for the
+  whole trainer even when the caller only wanted one estimator class.
+  Lint rule SMT008 flags eager ``__init__`` imports of jax-using
+  submodules; the fix is :func:`lazy_module`.
+
+Tools:
+
+- :func:`lazy_module` — PEP 562 exports for a package ``__init__``:
+  attribute access imports the owning submodule on demand.
+- :func:`lazy_import` — a module proxy for jax-heavy *leaf* modules
+  (``jnp = lazy_import("jax.numpy")``): hundreds of call sites keep their
+  ``jnp.foo`` spelling while the import happens on first attribute access.
+- :func:`load_all` — force-import every lazy submodule of a package.
+  Importing a module for its *side effects* (``STAGE_REGISTRY``
+  registration) no longer happens implicitly for lazy packages, so code
+  that needs it (``serving_worker --import-module``) calls this.
+"""
+
+from __future__ import annotations
+
+import importlib
+from typing import Callable, Dict, List, Sequence, Tuple
+
+__all__ = ["lazy_module", "lazy_import", "load_all"]
+
+
+def lazy_module(pkg_name: str, submod_attrs: Dict[str, Sequence[str]]
+                ) -> Tuple[Callable, Callable, List[str]]:
+    """PEP 562 lazy exports for a package ``__init__``.
+
+    ``submod_attrs`` maps submodule name -> the attributes it provides.
+    Returns ``(__getattr__, __dir__, __all__)`` for the caller to bind::
+
+        __getattr__, __dir__, __all__ = lazy_module(__name__, {
+            "flash": ["flash_attention", "dense_attention"],
+            "ring": ["ring_attention"],
+        })
+
+    Unknown attributes fall back to a plain submodule import, so
+    ``pkg.submodule`` access works for submodules that export nothing.
+    """
+    attr_to_mod: Dict[str, str] = {}
+    for mod, attrs in submod_attrs.items():
+        for a in attrs:
+            attr_to_mod[a] = mod
+    all_names = sorted(set(attr_to_mod) | set(submod_attrs))
+
+    def __getattr__(name: str):
+        owner = attr_to_mod.get(name)
+        if owner is not None:
+            value = getattr(
+                importlib.import_module(f"{pkg_name}.{owner}"), name)
+        else:
+            try:
+                value = importlib.import_module(f"{pkg_name}.{name}")
+            except ModuleNotFoundError:
+                raise AttributeError(
+                    f"module {pkg_name!r} has no attribute {name!r}"
+                ) from None
+        # cache on the package so later accesses are plain dict lookups
+        # (module __getattr__ is only consulted for missing names)
+        import sys
+
+        setattr(sys.modules[pkg_name], name, value)
+        return value
+
+    def __dir__():
+        return list(all_names)
+
+    # marker consumed by load_all(): which submodules this package defers
+    __getattr__.lazy_submodules = tuple(sorted(submod_attrs))
+    return __getattr__, __dir__, all_names
+
+
+def load_all(module) -> List[str]:
+    """Force-import every deferred submodule of a :func:`lazy_module`
+    package (returns their names; [] for eager modules). This restores the
+    registration side effects an eager ``__init__`` used to provide — e.g.
+    ``PipelineStage`` subclasses entering ``STAGE_REGISTRY`` so
+    ``load_stage`` can resolve them by class name."""
+    getter = getattr(module, "__getattr__", None)
+    subs = list(getattr(getter, "lazy_submodules", ()))
+    for sub in subs:
+        importlib.import_module(f"{module.__name__}.{sub}")
+    return subs
+
+
+class _LazyModuleProxy:
+    """Attribute-forwarding stand-in for a module imported on first use."""
+
+    __slots__ = ("_lazy_name", "_lazy_target")
+
+    def __init__(self, name: str):
+        object.__setattr__(self, "_lazy_name", name)
+        object.__setattr__(self, "_lazy_target", None)
+
+    def __getattr__(self, attr: str):
+        target = object.__getattribute__(self, "_lazy_target")
+        if target is None:
+            target = importlib.import_module(
+                object.__getattribute__(self, "_lazy_name"))
+            object.__setattr__(self, "_lazy_target", target)
+        return getattr(target, attr)
+
+    def __repr__(self) -> str:
+        name = object.__getattribute__(self, "_lazy_name")
+        loaded = object.__getattribute__(self, "_lazy_target") is not None
+        return f"<lazy module {name!r}{' (loaded)' if loaded else ''}>"
+
+
+def lazy_import(name: str) -> _LazyModuleProxy:
+    """A proxy that imports ``name`` on first attribute access.
+
+    For jax-heavy leaf modules whose *call sites* should keep their
+    natural spelling: ``jnp = lazy_import("jax.numpy")`` at module level
+    is import-free, and ``jnp.add(...)`` inside a function resolves (and
+    caches) the real module at call time. Do NOT touch proxy attributes at
+    module level — that resolves the import eagerly and defeats the point
+    (lint rule SMT001's subprocess ground truth still catches it).
+    """
+    return _LazyModuleProxy(name)

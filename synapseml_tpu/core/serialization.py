@@ -156,6 +156,10 @@ def save_stage(stage: Params, path: str) -> None:
             complex_descs[name] = _save_value(value, os.path.join(tmp, name))
         meta = {
             "class": type(stage).__name__,
+            # defining module: lets load_stage self-heal a registry miss
+            # (PEP 562 lazy packages no longer register stages on bare
+            # package import) by importing the module on demand
+            "module": type(stage).__module__,
             "uid": stage.uid,
             "buildVersion": BUILD_VERSION,
             "params": stage.simple_param_values(),
@@ -176,7 +180,19 @@ def load_stage(path: str):
 
     with open(os.path.join(path, "metadata.json")) as f:
         meta = json.load(f)
-    cls = stage_class(meta["class"])
+    try:
+        cls = stage_class(meta["class"])
+    except KeyError:
+        # registry miss: the stage's package may be PEP 562 lazy (importing
+        # it registers nothing until attribute access) — import the saved
+        # defining module and retry; re-raise the registry error for old
+        # artifacts without a module record
+        if not meta.get("module"):
+            raise
+        import importlib
+
+        importlib.import_module(meta["module"])
+        cls = stage_class(meta["class"])
     stage = cls.__new__(cls)
     # Initialize Params plumbing without invoking subclass __init__ conventions.
     object.__setattr__(stage, "_param_values", {})

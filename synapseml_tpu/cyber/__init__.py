@@ -7,20 +7,15 @@ Reference package: ``core/src/main/python/synapse/ml/cyber/`` (1,787 LoC) —
 ``feature/indexers.py``, ``feature/scalers.py``.
 """
 
-from .anomaly import AccessAnomaly, AccessAnomalyModel, ConnectedComponents
-from .complement import ComplementAccessTransformer
-from .indexers import IdIndexer, IdIndexerModel, MultiIndexer, MultiIndexerModel
-from .scalers import (
-    LinearScalarScaler,
-    LinearScalarScalerModel,
-    StandardScalarScaler,
-    StandardScalarScalerModel,
-)
+from ..core.lazyimport import lazy_module
 
-__all__ = [
-    "AccessAnomaly", "AccessAnomalyModel", "ConnectedComponents",
-    "ComplementAccessTransformer",
-    "IdIndexer", "IdIndexerModel", "MultiIndexer", "MultiIndexerModel",
-    "LinearScalarScaler", "LinearScalarScalerModel",
-    "StandardScalarScaler", "StandardScalarScalerModel",
-]
+# PEP 562 lazy exports (lint SMT008): attribute access imports the owning
+# submodule on demand, keeping `import synapseml_tpu.cyber` jax-free
+__getattr__, __dir__, __all__ = lazy_module(__name__, {
+    "anomaly": ["AccessAnomaly", "AccessAnomalyModel", "ConnectedComponents"],
+    "complement": ["ComplementAccessTransformer"],
+    "indexers": ["IdIndexer", "IdIndexerModel", "MultiIndexer",
+                 "MultiIndexerModel"],
+    "scalers": ["LinearScalarScaler", "LinearScalarScalerModel",
+                "StandardScalarScaler", "StandardScalarScalerModel"],
+})

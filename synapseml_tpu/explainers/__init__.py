@@ -6,22 +6,20 @@ sample generation, ONE model call per explainer invocation, and all per-row
 weighted lasso / least-squares fits vmapped into a single JAX kernel.
 """
 
-from .base import KernelSHAPBase, LIMEBase, LocalExplainer
-from .ice import ICECategoricalFeature, ICENumericFeature, ICETransformer
-from .lime import ImageLIME, TabularLIME, TextLIME, VectorLIME
-from .regression import RegressionResult, fit_regression, fit_regression_batch
-from .samplers import effective_num_samples, kernel_shap_coalitions
-from .shap import ImageSHAP, TabularSHAP, TextSHAP, VectorSHAP
-from .stats import ContinuousFeatureStats, DiscreteFeatureStats, collect_feature_stats
-from .superpixel import SuperpixelData, SuperpixelTransformer, mask_image, slic_superpixels
+from ..core.lazyimport import lazy_module
 
-__all__ = [
-    "LocalExplainer", "LIMEBase", "KernelSHAPBase",
-    "TabularLIME", "VectorLIME", "TextLIME", "ImageLIME",
-    "TabularSHAP", "VectorSHAP", "TextSHAP", "ImageSHAP",
-    "ICETransformer", "ICECategoricalFeature", "ICENumericFeature",
-    "SuperpixelTransformer", "SuperpixelData", "slic_superpixels", "mask_image",
-    "RegressionResult", "fit_regression", "fit_regression_batch",
-    "ContinuousFeatureStats", "DiscreteFeatureStats", "collect_feature_stats",
-    "effective_num_samples", "kernel_shap_coalitions",
-]
+# PEP 562 lazy exports (lint SMT008): attribute access imports the owning
+# submodule on demand, keeping `import synapseml_tpu.explainers` jax-free
+__getattr__, __dir__, __all__ = lazy_module(__name__, {
+    "base": ["KernelSHAPBase", "LIMEBase", "LocalExplainer"],
+    "ice": ["ICECategoricalFeature", "ICENumericFeature", "ICETransformer"],
+    "lime": ["ImageLIME", "TabularLIME", "TextLIME", "VectorLIME"],
+    "regression": ["RegressionResult", "fit_regression",
+                   "fit_regression_batch"],
+    "samplers": ["effective_num_samples", "kernel_shap_coalitions"],
+    "shap": ["ImageSHAP", "TabularSHAP", "TextSHAP", "VectorSHAP"],
+    "stats": ["ContinuousFeatureStats", "DiscreteFeatureStats",
+              "collect_feature_stats"],
+    "superpixel": ["SuperpixelData", "SuperpixelTransformer", "mask_image",
+                   "slic_superpixels"],
+})

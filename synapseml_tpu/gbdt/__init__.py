@@ -16,27 +16,15 @@ bootstraps a socket allreduce ring from the driver (``LightGBMBase.scala:399-437
 - estimator stages with reference param names (``estimators.py``).
 """
 
-from .binning import BinMapper
-from .dataset import GBDTDataset
-from .boost import GBDTBooster, train
-from .estimators import (
-    LightGBMClassificationModel,
-    LightGBMClassifier,
-    LightGBMRanker,
-    LightGBMRankerModel,
-    LightGBMRegressionModel,
-    LightGBMRegressor,
-)
+from ..core.lazyimport import lazy_module
 
-__all__ = [
-    "GBDTDataset",
-    "BinMapper",
-    "GBDTBooster",
-    "train",
-    "LightGBMClassifier",
-    "LightGBMClassificationModel",
-    "LightGBMRegressor",
-    "LightGBMRegressionModel",
-    "LightGBMRanker",
-    "LightGBMRankerModel",
-]
+# PEP 562 lazy exports (lint SMT008): attribute access imports the owning
+# submodule on demand, keeping `import synapseml_tpu.gbdt` jax-free
+__getattr__, __dir__, __all__ = lazy_module(__name__, {
+    "binning": ["BinMapper"],
+    "dataset": ["GBDTDataset"],
+    "boost": ["GBDTBooster", "train"],
+    "estimators": ["LightGBMClassificationModel", "LightGBMClassifier",
+                   "LightGBMRanker", "LightGBMRankerModel",
+                   "LightGBMRegressionModel", "LightGBMRegressor"],
+})

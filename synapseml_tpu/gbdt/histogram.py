@@ -92,7 +92,10 @@ def histogram_panel(binned, ghc, n_bins: int, method: str = "auto",
     import jax
 
     if method == "auto":
-        method = "onehot" if jax.default_backend() == "tpu" else "scatter"
+        # any non-cpu backend gets the MXU one-hot path: a tunneled TPU can
+        # register under a plugin backend name (e.g. 'axon'), not 'tpu' —
+        # matching on == "tpu" silently fell back to scatter there
+        method = "onehot" if jax.default_backend() != "cpu" else "scatter"
     if method == "onehot":
         return _hist_onehot(binned, ghc, n_bins, chunk)
     if method == "scatter":

@@ -260,6 +260,7 @@ class SparseBinned:
 
     def __init__(self, rows, bins, ends, starts, zero_bin,
                  d: int, n_bins: int, n: int, max_run: int):
+        _register_pytree()
         self.rows = rows
         self.bins = bins
         self.ends = ends
@@ -287,12 +288,25 @@ def _sb_unflatten(aux, children):
                         d=d, n_bins=n_bins, n=n, max_run=max_run)
 
 
-try:  # register once; safe when jax is absent (host-only usage)
-    import jax as _jax
+_PYTREE_REGISTERED = False
 
-    _jax.tree_util.register_pytree_node(SparseBinned, _sb_flatten, _sb_unflatten)
-except Exception:  # pragma: no cover
-    pass
+
+def _register_pytree() -> None:
+    """Register SparseBinned as a jax pytree on first construction —
+    instances always exist before they can be traced, and deferring keeps
+    this module jax-free at import (SMT001) and safe when jax is absent
+    (host-only usage)."""
+    global _PYTREE_REGISTERED
+    if _PYTREE_REGISTERED:
+        return
+    _PYTREE_REGISTERED = True
+    try:
+        import jax as _jax
+
+        _jax.tree_util.register_pytree_node(SparseBinned, _sb_flatten,
+                                            _sb_unflatten)
+    except Exception:  # pragma: no cover — no jax: nothing will trace it
+        pass
 
 
 def _cell_sum_fn(panel):

@@ -1,7 +1,12 @@
 """Image ops and stages (reference: ``opencv`` module + ``core/.../image/``)."""
 
-from . import ops
-from .stages import (ImageSetAugmenter, ImageTransformer,
-                     ResizeImageTransformer, UnrollBinaryImage, UnrollImage)
+from ..core.lazyimport import lazy_module
 
-__all__ = ["ops", "ImageTransformer", "ResizeImageTransformer", "UnrollImage", "UnrollBinaryImage", "ImageSetAugmenter"]
+# PEP 562 lazy exports (lint SMT008): attribute access imports the owning
+# submodule on demand (`image.ops` resolves as a submodule), keeping
+# `import synapseml_tpu.image` jax-free
+__getattr__, __dir__, __all__ = lazy_module(__name__, {
+    "ops": [],
+    "stages": ["ImageSetAugmenter", "ImageTransformer",
+               "ResizeImageTransformer", "UnrollBinaryImage", "UnrollImage"],
+})

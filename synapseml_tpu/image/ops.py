@@ -16,9 +16,14 @@ import math
 from functools import partial
 from typing import Optional, Sequence, Tuple
 
-import jax
-import jax.numpy as jnp
 import numpy as np
+
+from ..core.lazyimport import lazy_import
+
+# resolved on first attribute access inside a kernel — importing this
+# module (or synapseml_tpu.image) stays jax-free (lint rule SMT001)
+jax = lazy_import("jax")
+jnp = lazy_import("jax.numpy")
 
 __all__ = [
     "resize",

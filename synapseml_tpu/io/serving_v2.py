@@ -166,8 +166,12 @@ class RoutingServer:
         self.registry = registry
         self.service = service
         self.timeout = timeout
+        # handler threads are concurrent (ThreadingHTTPServer): bare += on
+        # these from multiple threads loses updates, so every mutation
+        # takes the lock (lint SMT006 enforces the discipline from here on)
         self.requests_routed = 0
         self.workers_evicted = 0
+        self._lock = threading.Lock()
         self._rr = count()
         outer = self
 
@@ -285,18 +289,12 @@ class RoutingServer:
                                 timed_out = True
                                 break
                             continue
-                        outer.registry.unregister(outer.service, target)
-                        outer.workers_evicted += 1
-                        _logger.warning("evicted unreachable worker %s",
-                                        target)
+                        outer._evict(target)
                         continue
                     except OSError as e:
                         if fwd_span is not None:
                             fwd_span.end(error=e)
-                        outer.registry.unregister(outer.service, target)
-                        outer.workers_evicted += 1
-                        _logger.warning("evicted unreachable worker %s",
-                                        target)
+                        outer._evict(target)
                         continue
                 if route_span is not None:
                     if reply is None:
@@ -329,7 +327,8 @@ class RoutingServer:
                         self.wfile.write(ent)
                 except OSError:
                     pass  # client went away; the reply is simply dropped
-                outer.requests_routed += 1
+                with outer._lock:
+                    outer.requests_routed += 1
 
             def do_GET(self):
                 self._forward("GET")
@@ -358,6 +357,14 @@ class RoutingServer:
         self._thread = threading.Thread(target=self._httpd.serve_forever,
                                         name=f"routing-{self.port}", daemon=True)
         self._thread.start()
+
+    def _evict(self, target: str) -> None:
+        """Drop an unreachable worker from the routing table (called from
+        concurrent handler threads — the counter bump takes the lock)."""
+        self.registry.unregister(self.service, target)
+        with self._lock:
+            self.workers_evicted += 1
+        _logger.warning("evicted unreachable worker %s", target)
 
     def _collect_metrics(self) -> None:
         self._m_routed.sync_total(self.requests_routed)

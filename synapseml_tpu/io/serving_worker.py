@@ -46,8 +46,13 @@ def main(argv=None) -> int:
 
     import importlib
 
+    from ..core.lazyimport import load_all
+
     for mod in args.import_module:
-        importlib.import_module(mod)
+        # --import-module exists for registration side effects
+        # (STAGE_REGISTRY); PEP 562 lazy packages defer those to attribute
+        # access, so force-load their submodules here
+        load_all(importlib.import_module(mod))
 
     from ..core.serialization import load_stage
     from ..observability import tracing

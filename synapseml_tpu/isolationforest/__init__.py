@@ -7,6 +7,9 @@ algorithm itself is implemented: random-split isolation trees built on host
 heap-array traversal (same design as the GBDT device predictor).
 """
 
-from .forest import IsolationForest, IsolationForestModel
+from ..core.lazyimport import lazy_module
 
-__all__ = ["IsolationForest", "IsolationForestModel"]
+# PEP 562 lazy exports (lint SMT008): keeps the package import jax-free
+__getattr__, __dir__, __all__ = lazy_module(__name__, {
+    "forest": ["IsolationForest", "IsolationForestModel"],
+})

@@ -5,6 +5,9 @@ Reference package: ``core/src/main/scala/.../nn/`` (616 LoC —
 ``BoundedPriorityQueue.scala``).
 """
 
-from .knn import KNN, KNNModel, ConditionalKNN, ConditionalKNNModel
+from ..core.lazyimport import lazy_module
 
-__all__ = ["KNN", "KNNModel", "ConditionalKNN", "ConditionalKNNModel"]
+# PEP 562 lazy exports (lint SMT008): keeps the package import jax-free
+__getattr__, __dir__, __all__ = lazy_module(__name__, {
+    "knn": ["KNN", "KNNModel", "ConditionalKNN", "ConditionalKNNModel"],
+})

@@ -1,23 +1,14 @@
 """ONNX engine: wire codec, builder, JAX importer/executor, ONNXModel transformer."""
 
-from .builder import constant_node, make_graph, make_model, node, save_model, value_info
-from .importer import OnnxFunction, load_model
-from .model import ONNXModel
-from .wire import DataType, ModelProto, parse_model, serialize_model, tensor_to_numpy
+from ..core.lazyimport import lazy_module
 
-__all__ = [
-    "OnnxFunction",
-    "load_model",
-    "ONNXModel",
-    "DataType",
-    "ModelProto",
-    "parse_model",
-    "serialize_model",
-    "tensor_to_numpy",
-    "node",
-    "make_graph",
-    "make_model",
-    "value_info",
-    "constant_node",
-    "save_model",
-]
+# PEP 562 lazy exports (lint SMT008): attribute access imports the owning
+# submodule on demand, keeping `import synapseml_tpu.onnx` jax-free
+__getattr__, __dir__, __all__ = lazy_module(__name__, {
+    "builder": ["constant_node", "make_graph", "make_model", "node",
+                "save_model", "value_info"],
+    "importer": ["OnnxFunction", "load_model"],
+    "model": ["ONNXModel"],
+    "wire": ["DataType", "ModelProto", "parse_model", "serialize_model",
+             "tensor_to_numpy"],
+})

@@ -24,12 +24,33 @@ from __future__ import annotations
 import functools
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
-import jax
-import jax.numpy as jnp
 import numpy as np
-from jax import lax
+
+from ..core.lazyimport import lazy_import
+
+# resolved on first attribute access inside an op body — importing the
+# 123-op registry (or synapseml_tpu.onnx) stays jax-free (lint SMT001)
+jax = lazy_import("jax")
+jnp = lazy_import("jax.numpy")
+lax = lazy_import("jax.lax")
 
 OPS: Dict[str, Callable] = {}
+
+
+def _lazy_fn(spec: str) -> Callable:
+    """Resolve a dotted ``jnp.add`` / ``jax.nn.relu`` spec at *call* time
+    (attribute access on the lazy proxies), so building the op tables
+    below never imports jax."""
+    root, _, rest = spec.partition(".")
+    base = {"jax": jax, "jnp": jnp, "lax": lax}[root]
+
+    def call(*args, **kw):
+        fn = base
+        for part in rest.split("."):
+            fn = getattr(fn, part)
+        return fn(*args, **kw)
+
+    return call
 
 
 def op(*names: str):
@@ -71,26 +92,35 @@ def _axis_list(attrs, inputs, idx, what, default=None):
 # ---------------------------------------------------------------------------------
 
 _BINOPS = {
-    "Add": jnp.add, "Sub": jnp.subtract, "Mul": jnp.multiply, "Div": jnp.divide,
-    "Pow": jnp.power, "Mod": jnp.mod, "PRelu": lambda x, s: jnp.where(x >= 0, x, x * s),
-    "And": jnp.logical_and, "Or": jnp.logical_or, "Xor": jnp.logical_xor,
-    "BitwiseAnd": jnp.bitwise_and, "BitwiseOr": jnp.bitwise_or, "BitwiseXor": jnp.bitwise_xor,
+    "Add": "jnp.add", "Sub": "jnp.subtract", "Mul": "jnp.multiply",
+    "Div": "jnp.divide",
+    "Pow": "jnp.power", "Mod": "jnp.mod",
+    "PRelu": lambda x, s: jnp.where(x >= 0, x, x * s),
+    "And": "jnp.logical_and", "Or": "jnp.logical_or", "Xor": "jnp.logical_xor",
+    "BitwiseAnd": "jnp.bitwise_and", "BitwiseOr": "jnp.bitwise_or",
+    "BitwiseXor": "jnp.bitwise_xor",
 }
 for _name, _fn in _BINOPS.items():
+    _fn = _fn if callable(_fn) else _lazy_fn(_fn)
     OPS[_name] = (lambda f: lambda inputs, attrs, ctx: f(inputs[0], inputs[1]))(_fn)
 
 _UNOPS = {
-    "Sqrt": jnp.sqrt, "Exp": jnp.exp, "Log": jnp.log, "Abs": jnp.abs, "Neg": jnp.negative,
-    "Floor": jnp.floor, "Ceil": jnp.ceil, "Reciprocal": lambda x: 1.0 / x,
-    "Sign": jnp.sign, "Erf": jax.scipy.special.erf, "Not": jnp.logical_not,
-    "Relu": jax.nn.relu, "Sigmoid": jax.nn.sigmoid, "Tanh": jnp.tanh,
-    "Softplus": jax.nn.softplus, "Softsign": jax.nn.soft_sign, "Identity": lambda x: x,
-    "IsNaN": jnp.isnan, "Sin": jnp.sin, "Cos": jnp.cos, "Tan": jnp.tan,
-    "Asin": jnp.arcsin, "Acos": jnp.arccos, "Atan": jnp.arctan,
-    "Sinh": jnp.sinh, "Cosh": jnp.cosh, "Asinh": jnp.arcsinh, "Acosh": jnp.arccosh,
-    "Atanh": jnp.arctanh, "BitwiseNot": jnp.bitwise_not,
+    "Sqrt": "jnp.sqrt", "Exp": "jnp.exp", "Log": "jnp.log", "Abs": "jnp.abs",
+    "Neg": "jnp.negative",
+    "Floor": "jnp.floor", "Ceil": "jnp.ceil", "Reciprocal": lambda x: 1.0 / x,
+    "Sign": "jnp.sign", "Erf": "jax.scipy.special.erf",
+    "Not": "jnp.logical_not",
+    "Relu": "jax.nn.relu", "Sigmoid": "jax.nn.sigmoid", "Tanh": "jnp.tanh",
+    "Softplus": "jax.nn.softplus", "Softsign": "jax.nn.soft_sign",
+    "Identity": lambda x: x,
+    "IsNaN": "jnp.isnan", "Sin": "jnp.sin", "Cos": "jnp.cos", "Tan": "jnp.tan",
+    "Asin": "jnp.arcsin", "Acos": "jnp.arccos", "Atan": "jnp.arctan",
+    "Sinh": "jnp.sinh", "Cosh": "jnp.cosh", "Asinh": "jnp.arcsinh",
+    "Acosh": "jnp.arccosh",
+    "Atanh": "jnp.arctanh", "BitwiseNot": "jnp.bitwise_not",
 }
 for _name, _fn in _UNOPS.items():
+    _fn = _fn if callable(_fn) else _lazy_fn(_fn)
     OPS[_name] = (lambda f: lambda inputs, attrs, ctx: f(inputs[0]))(_fn)
 
 
@@ -99,11 +129,15 @@ def _round(inputs, attrs, ctx):
     return jnp.round(inputs[0])  # banker's rounding matches ONNX spec
 
 
+_COMPARE = {"Equal": _lazy_fn("jnp.equal"), "Greater": _lazy_fn("jnp.greater"),
+            "GreaterOrEqual": _lazy_fn("jnp.greater_equal"),
+            "Less": _lazy_fn("jnp.less"),
+            "LessOrEqual": _lazy_fn("jnp.less_equal")}
+
+
 @op("Equal", "Greater", "GreaterOrEqual", "Less", "LessOrEqual")
-def _compare(inputs, attrs, ctx, _fns={"Equal": jnp.equal, "Greater": jnp.greater,
-                                       "GreaterOrEqual": jnp.greater_equal,
-                                       "Less": jnp.less, "LessOrEqual": jnp.less_equal}):
-    return _fns[ctx["op_type"]](inputs[0], inputs[1])
+def _compare(inputs, attrs, ctx):
+    return _COMPARE[ctx["op_type"]](inputs[0], inputs[1])
 
 
 @op("Min", "Max", "Sum", "Mean")
@@ -836,11 +870,11 @@ def _reduce(fn_np, fn_jnp, axes_from_input_opset: int):
     return impl
 
 
-OPS["ReduceSum"] = _reduce(np.sum, jnp.sum, 13)
-OPS["ReduceMean"] = _reduce(np.mean, jnp.mean, 18)
-OPS["ReduceMax"] = _reduce(np.max, jnp.max, 18)
-OPS["ReduceMin"] = _reduce(np.min, jnp.min, 18)
-OPS["ReduceProd"] = _reduce(np.prod, jnp.prod, 18)
+OPS["ReduceSum"] = _reduce(np.sum, _lazy_fn("jnp.sum"), 13)
+OPS["ReduceMean"] = _reduce(np.mean, _lazy_fn("jnp.mean"), 18)
+OPS["ReduceMax"] = _reduce(np.max, _lazy_fn("jnp.max"), 18)
+OPS["ReduceMin"] = _reduce(np.min, _lazy_fn("jnp.min"), 18)
+OPS["ReduceProd"] = _reduce(np.prod, _lazy_fn("jnp.prod"), 18)
 OPS["ReduceL1"] = _reduce(lambda x, axis, keepdims: np.sum(np.abs(x), axis=axis, keepdims=keepdims),
                           lambda x, axis, keepdims: jnp.sum(jnp.abs(x), axis=axis, keepdims=keepdims), 18)
 OPS["ReduceL2"] = _reduce(lambda x, axis, keepdims: np.sqrt(np.sum(x * x, axis=axis, keepdims=keepdims)),

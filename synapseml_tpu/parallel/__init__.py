@@ -8,13 +8,12 @@ with XLA collectives (``ppermute`` ring / ``all_to_all`` head exchange)
 doing the communication.
 """
 
-from .flash import dense_attention, flash_attention
-from .ring import (
-    ring_attention,
-    sequence_sharded_attention,
-    ulysses_attention,
-)
+from ..core.lazyimport import lazy_module
 
-__all__ = ["ring_attention", "ulysses_attention",
-           "sequence_sharded_attention",
-           "flash_attention", "dense_attention"]
+# PEP 562 lazy exports (lint SMT008): attribute access imports the owning
+# submodule on demand, keeping `import synapseml_tpu.parallel` jax-free
+__getattr__, __dir__, __all__ = lazy_module(__name__, {
+    "flash": ["dense_attention", "flash_attention"],
+    "ring": ["ring_attention", "sequence_sharded_attention",
+             "ulysses_attention"],
+})

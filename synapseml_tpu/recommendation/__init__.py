@@ -6,23 +6,15 @@ Reference package: ``core/src/main/scala/.../recommendation/`` (1,283 LoC —
 ``RecommendationIndexer.scala``).
 """
 
-from .sar import SAR, SARModel
-from .ranking import (
-    AdvancedRankingMetrics,
-    RankingAdapter,
-    RankingAdapterModel,
-    RankingEvaluator,
-    RankingTrainValidationSplit,
-    RankingTrainValidationSplitModel,
-    RecommendationIndexer,
-    RecommendationIndexerModel,
-)
+from ..core.lazyimport import lazy_module
 
-__all__ = [
-    "SAR", "SARModel",
-    "AdvancedRankingMetrics",
-    "RankingAdapter", "RankingAdapterModel",
-    "RankingEvaluator",
-    "RankingTrainValidationSplit", "RankingTrainValidationSplitModel",
-    "RecommendationIndexer", "RecommendationIndexerModel",
-]
+# PEP 562 lazy exports (lint SMT008): attribute access imports the owning
+# submodule on demand, keeping the package import jax-free
+__getattr__, __dir__, __all__ = lazy_module(__name__, {
+    "sar": ["SAR", "SARModel"],
+    "ranking": ["AdvancedRankingMetrics", "RankingAdapter",
+                "RankingAdapterModel", "RankingEvaluator",
+                "RankingTrainValidationSplit",
+                "RankingTrainValidationSplitModel",
+                "RecommendationIndexer", "RecommendationIndexerModel"],
+})

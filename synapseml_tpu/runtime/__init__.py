@@ -1,25 +1,12 @@
 """Runtime: topology discovery, shared per-host state, distributed bring-up."""
 
-from .shared import SharedVariable, clear_shared_pool, shared_singleton
-from .topology import (
-    ClusterInfo,
-    best_mesh_shape,
-    cluster_info,
-    device_kind,
-    initialize_distributed,
-    is_tpu,
-    make_mesh,
-)
+from ..core.lazyimport import lazy_module
 
-__all__ = [
-    "SharedVariable",
-    "shared_singleton",
-    "clear_shared_pool",
-    "ClusterInfo",
-    "cluster_info",
-    "make_mesh",
-    "best_mesh_shape",
-    "initialize_distributed",
-    "device_kind",
-    "is_tpu",
-]
+# PEP 562 lazy exports (lint SMT008): attribute access imports the owning
+# submodule on demand, keeping `import synapseml_tpu.runtime` jax-free
+__getattr__, __dir__, __all__ = lazy_module(__name__, {
+    "shared": ["SharedVariable", "clear_shared_pool", "shared_singleton"],
+    "topology": ["ClusterInfo", "best_mesh_shape", "cluster_info",
+                 "device_kind", "initialize_distributed", "is_tpu",
+                 "make_mesh", "shard_map_compat"],
+})

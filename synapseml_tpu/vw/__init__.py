@@ -15,28 +15,17 @@ rates, spanning-tree AllReduce weight averaging at pass boundaries
   semantics without the rendezvous server.
 """
 
-from .estimators import (
-    VowpalWabbitClassificationModel,
-    VowpalWabbitClassifier,
-    VowpalWabbitContextualBandit,
-    VowpalWabbitContextualBanditModel,
-    VowpalWabbitRegressionModel,
-    VowpalWabbitRegressor,
-)
-from .featurizer import (VectorZipper, VowpalWabbitFeaturizer,
-                         VowpalWabbitInteractions)
-from .learner import LinearLearnerState, train_linear
+from ..core.lazyimport import lazy_module
 
-__all__ = [
-    "VowpalWabbitFeaturizer",
-    "VowpalWabbitInteractions",
-    "VectorZipper",
-    "VowpalWabbitClassifier",
-    "VowpalWabbitClassificationModel",
-    "VowpalWabbitRegressor",
-    "VowpalWabbitRegressionModel",
-    "VowpalWabbitContextualBandit",
-    "VowpalWabbitContextualBanditModel",
-    "LinearLearnerState",
-    "train_linear",
-]
+# PEP 562 lazy exports (lint SMT008): attribute access imports the owning
+# submodule on demand, keeping `import synapseml_tpu.vw` jax-free
+__getattr__, __dir__, __all__ = lazy_module(__name__, {
+    "estimators": ["VowpalWabbitClassificationModel",
+                   "VowpalWabbitClassifier",
+                   "VowpalWabbitContextualBandit",
+                   "VowpalWabbitContextualBanditModel",
+                   "VowpalWabbitRegressionModel", "VowpalWabbitRegressor"],
+    "featurizer": ["VectorZipper", "VowpalWabbitFeaturizer",
+                   "VowpalWabbitInteractions"],
+    "learner": ["LinearLearnerState", "train_linear"],
+})

@@ -18,7 +18,10 @@ import sys
 # stdlib-only by design and must stay that way)
 _GATED_MODULES = [
     "synapseml_tpu",
+    "synapseml_tpu.analysis",  # the linter itself runs pre-accelerator
+    "synapseml_tpu.analysis.cli",
     "synapseml_tpu.core.clock",
+    "synapseml_tpu.core.lazyimport",
     "synapseml_tpu.core.stage",
     "synapseml_tpu.core.telemetry",
     "synapseml_tpu.observability",
@@ -31,6 +34,24 @@ _GATED_MODULES = [
     "synapseml_tpu.io.serving_v2",
     "synapseml_tpu.io.serving_worker",
     "synapseml_tpu.gbdt.boost",
+    # PEP 562 lazy packages (core/lazyimport.py): the package import must
+    # stay jax-free even though the submodules underneath use jax
+    # everywhere — lint rule SMT008 enforces the __init__ shape, this gate
+    # proves the transitive result
+    "synapseml_tpu.cyber",
+    "synapseml_tpu.explainers",
+    "synapseml_tpu.gbdt",
+    "synapseml_tpu.image",
+    "synapseml_tpu.isolationforest",
+    "synapseml_tpu.nn",
+    "synapseml_tpu.onnx",
+    "synapseml_tpu.onnx.ops",
+    "synapseml_tpu.image.ops",
+    "synapseml_tpu.gbdt.sparse",
+    "synapseml_tpu.parallel",
+    "synapseml_tpu.recommendation",
+    "synapseml_tpu.runtime",
+    "synapseml_tpu.vw",
 ]
 
 _TOOLS_DIR = os.path.join(
@@ -38,7 +59,7 @@ _TOOLS_DIR = os.path.join(
 
 # standalone CLI tools a human points at PRODUCTION endpoints; they must
 # stay jax-free (tools/ is not a package — imported via a path entry)
-_GATED_TOOLS = ["trace_dump"]
+_GATED_TOOLS = ["trace_dump", "lint"]
 
 
 def test_no_jax_at_import():

@@ -1,0 +1,517 @@
+"""The repo-invariant lint: per-rule fixtures + the zero-unwaived gate.
+
+Two layers:
+
+- **Fixture tests** — one true-positive and one true-negative snippet per
+  SMT rule, run through the engine on temp files. These pin each rule's
+  detection shape so a refactor of the engine can't silently hollow a
+  rule out.
+- **The gate** — a full run over ``synapseml_tpu/``, ``tools/`` and
+  ``bench.py`` with the committed ``LINT_ACKS.md`` must produce ZERO
+  unwaived findings (and no stale waivers, and every waiver must carry a
+  reason). This is the CI teeth: an invariant regression fails here with
+  a file:line, not in a far-away runtime test.
+"""
+
+import os
+import subprocess
+import sys
+import textwrap
+import time
+
+import pytest
+
+from synapseml_tpu.analysis import (LintConfigError, analyze_paths,
+                                    load_waivers)
+from synapseml_tpu.analysis.cli import main as lint_main
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+GATE_PATHS = [os.path.join(REPO_ROOT, "synapseml_tpu"),
+              os.path.join(REPO_ROOT, "tools"),
+              os.path.join(REPO_ROOT, "bench.py")]
+ACKS = os.path.join(REPO_ROOT, "LINT_ACKS.md")
+
+
+def run_rule(tmp_path, code, source, filename="mod.py"):
+    p = tmp_path / filename
+    p.write_text(textwrap.dedent(source))
+    report = analyze_paths([str(tmp_path)], select=[code], use_acks=False)
+    assert not report["errors"], report["errors"]
+    return report["findings"]
+
+
+# ---------------------------------------------------------------------------
+# SMT001 — module-level jax import
+# ---------------------------------------------------------------------------
+
+def test_smt001_true_positive(tmp_path):
+    findings = run_rule(tmp_path, "SMT001", """\
+        import os
+        import jax.numpy as jnp
+
+        def f(x):
+            return jnp.sum(x)
+        """)
+    assert [f.line for f in findings] == [2]
+    assert findings[0].code == "SMT001"
+
+
+def test_smt001_true_negative(tmp_path):
+    findings = run_rule(tmp_path, "SMT001", """\
+        from typing import TYPE_CHECKING
+
+        if TYPE_CHECKING:
+            import jax  # typing-only: never executes
+
+        def f(x):
+            import jax.numpy as jnp
+            return jnp.sum(x)
+        """)
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# SMT002 — direct shard_map
+# ---------------------------------------------------------------------------
+
+def test_smt002_true_positive(tmp_path):
+    findings = run_rule(tmp_path, "SMT002", """\
+        def distributed(f, mesh, specs):
+            from jax.experimental.shard_map import shard_map
+            return shard_map(f, mesh=mesh, in_specs=specs, out_specs=specs)
+
+        def also_bad(f, mesh):
+            import jax
+            return jax.shard_map(f, mesh=mesh)
+        """)
+    assert [f.line for f in findings] == [2, 7]
+
+
+def test_smt002_true_negative(tmp_path):
+    findings = run_rule(tmp_path, "SMT002", """\
+        def distributed(f, mesh, specs):
+            # shard_map in a comment/string is fine; the call site goes
+            # through the compat wrapper
+            from synapseml_tpu.runtime.topology import shard_map_compat
+            return shard_map_compat(f, mesh, specs, specs)
+        """)
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# SMT003 — wall-clock deltas
+# ---------------------------------------------------------------------------
+
+def test_smt003_true_positive(tmp_path):
+    findings = run_rule(tmp_path, "SMT003", """\
+        import time
+
+        class T:
+            def start(self):
+                self._t0 = time.time()
+
+            def stop(self):
+                return time.time() - self._t0
+
+        def f():
+            t0 = time.time()
+            work()
+            return time.time() - t0
+        """)
+    assert len(findings) == 2
+    assert {f.line for f in findings} == {8, 13}
+
+
+def test_smt003_name_taint_is_scoped_per_function(tmp_path):
+    # a time.time() timestamp named t0 in one function must not poison a
+    # perf_counter t0 in another
+    findings = run_rule(tmp_path, "SMT003", """\
+        import time
+
+        def stamp_pair():
+            t0 = time.time()
+            t1 = time.time()
+            return t0, t1
+
+        def elapsed():
+            t0 = time.perf_counter()
+            t1 = time.perf_counter()
+            return t1 - t0
+        """)
+    assert findings == []
+
+
+def test_smt003_true_negative(tmp_path):
+    findings = run_rule(tmp_path, "SMT003", """\
+        import time
+
+        def event():
+            # timestamp-only use: allowed
+            return {"ts": time.time()}
+
+        def backdate(duration_s):
+            # wall timestamp arithmetic with a non-wall operand: allowed
+            return time.time() - duration_s
+
+        def elapsed():
+            t0 = time.perf_counter()
+            work()
+            return time.perf_counter() - t0
+        """)
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# SMT004 — non-default histogram buckets
+# ---------------------------------------------------------------------------
+
+def test_smt004_true_positive(tmp_path):
+    findings = run_rule(tmp_path, "SMT004", """\
+        def make(reg):
+            return reg.histogram("lat", "help", (), buckets=(0.1, 1.0, 10.0))
+        """)
+    assert [f.line for f in findings] == [2]
+
+
+def test_smt004_true_negative(tmp_path):
+    findings = run_rule(tmp_path, "SMT004", """\
+        from synapseml_tpu.observability.metrics import DEFAULT_BUCKETS
+
+        def make(reg):
+            a = reg.histogram("lat", "help", ())
+            b = reg.histogram("rows", "help", (), buckets=DEFAULT_BUCKETS)
+            return a, b
+
+        def gbdt_kernel(binned, grad, hess, weight, n_bins):
+            # the gbdt histogram() takes 4+ positional args and is not a
+            # metrics histogram
+            return histogram(binned, grad, hess, weight, n_bins)
+        """)
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# SMT005 — stage overriding instrumented transform/fit
+# ---------------------------------------------------------------------------
+
+def test_smt005_true_positive(tmp_path):
+    findings = run_rule(tmp_path, "SMT005", """\
+        from synapseml_tpu.core import Transformer
+
+        class BadStage(Transformer):
+            def transform(self, table):
+                return table
+        """)
+    assert [f.line for f in findings] == [4]
+    assert "_transform" in findings[0].message
+
+
+def test_smt005_true_negative(tmp_path):
+    findings = run_rule(tmp_path, "SMT005", """\
+        from synapseml_tpu.core import Estimator, Transformer
+
+        class GoodStage(Transformer):
+            def _transform(self, table):
+                return table
+
+        class FrameworkBase(Estimator):
+            _abstract_stage = True
+
+            def fit(self, table):  # bases may re-instrument
+                return super().fit(table)
+
+        class _BenchLocal(Transformer):
+            def transform(self, table):  # _-prefixed: never registered
+                return table
+        """)
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# SMT006 — lock-protected state written outside the lock
+# ---------------------------------------------------------------------------
+
+def test_smt006_true_positive(tmp_path):
+    findings = run_rule(tmp_path, "SMT006", """\
+        import threading
+
+        class Box:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._items = []
+                self.count = 0
+
+            def add(self, x):
+                with self._lock:
+                    self._items.append(x)
+                    self.count += 1
+
+            def reset(self):
+                self._items.clear()
+                self.count = 0
+        """)
+    assert [f.line for f in findings] == [15, 16]
+
+
+def test_smt006_true_negative(tmp_path):
+    findings = run_rule(tmp_path, "SMT006", """\
+        import threading
+
+        class Box:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._items = []  # constructor: happens-before publication
+
+            def add(self, x):
+                with self._lock:
+                    self._items.append(x)
+
+            def peek(self):
+                return len(self._items)  # unlocked READS are allowed
+
+            def unrelated(self):
+                self.other = 1  # never lock-protected anywhere
+        """)
+    assert findings == []
+
+
+def test_smt006_local_shadow_of_protected_global_not_flagged(tmp_path):
+    findings = run_rule(tmp_path, "SMT006", """\
+        import threading
+
+        _lock = threading.Lock()
+        _cache = {}
+
+        def put(k, v):
+            with _lock:
+                _cache[k] = v
+
+        def swap():
+            global _cache
+            with _lock:
+                _cache = {}
+
+        def local_shadow():
+            _cache = {}  # binds a LOCAL: not a shared write
+            _cache["x"] = 1
+            return _cache
+        """)
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# SMT007 — blocking work under a lock
+# ---------------------------------------------------------------------------
+
+def test_smt007_true_positive(tmp_path):
+    findings = run_rule(tmp_path, "SMT007", """\
+        import threading
+        import time
+
+        _lock = threading.Lock()
+
+        def slow():
+            with _lock:
+                time.sleep(0.1)
+
+        def device(x):
+            import jax.numpy as jnp
+            with _lock:
+                return jnp.sum(x)
+        """)
+    assert [f.line for f in findings] == [8, 13]
+
+
+def test_smt007_true_negative(tmp_path):
+    findings = run_rule(tmp_path, "SMT007", """\
+        import threading
+        import time
+
+        _lock = threading.Lock()
+
+        def fine():
+            with _lock:
+                snapshot = list(range(3))
+            time.sleep(0.1)  # blocking AFTER the lock released
+            return snapshot
+        """)
+    assert findings == []
+
+
+def test_smt007_callback_defined_under_lock_not_flagged(tmp_path):
+    # a function DEFINED while a lock is held runs later, without it
+    findings = run_rule(tmp_path, "SMT007", """\
+        import threading
+        import time
+
+        _lock = threading.Lock()
+        _callbacks = []
+
+        def register():
+            with _lock:
+                def flush():
+                    time.sleep(1.0)  # runs post-release
+                _callbacks.append(flush)
+        """)
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# SMT008 — eager jax-using imports in a package __init__
+# ---------------------------------------------------------------------------
+
+def _make_pkg(tmp_path, init_src, heavy_uses_jax=True):
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    heavy = ("def f(x):\n    import jax\n    return jax.numpy.sum(x)\n"
+             if heavy_uses_jax else "def f(x):\n    return x\n")
+    (pkg / "heavy.py").write_text(heavy)
+    (pkg / "__init__.py").write_text(textwrap.dedent(init_src))
+    return pkg
+
+
+def test_smt008_true_positive(tmp_path):
+    _make_pkg(tmp_path, "from .heavy import f\n")
+    report = analyze_paths([str(tmp_path)], select=["SMT008"],
+                           use_acks=False)
+    assert len(report["findings"]) == 1
+    assert "heavy" in report["findings"][0].message
+
+
+def test_smt008_true_negative(tmp_path):
+    _make_pkg(tmp_path, """\
+        from synapseml_tpu.core.lazyimport import lazy_module
+
+        __getattr__, __dir__, __all__ = lazy_module(__name__, {
+            "heavy": ["f"],
+        })
+        """)
+    report = analyze_paths([str(tmp_path)], select=["SMT008"],
+                           use_acks=False)
+    assert report["findings"] == []
+
+
+def test_smt008_clean_submodule_is_fine(tmp_path):
+    _make_pkg(tmp_path, "from .heavy import f\n", heavy_uses_jax=False)
+    report = analyze_paths([str(tmp_path)], select=["SMT008"],
+                           use_acks=False)
+    assert report["findings"] == []
+
+
+def test_smt008_absolute_self_import_resolved_from_filesystem(tmp_path):
+    # `from synapseml_tpu.sub.heavy import f` in an __init__ must resolve
+    # the target via the directory layout (walking up to the package
+    # root), independent of where the scan was rooted
+    top = tmp_path / "synapseml_tpu"
+    sub = top / "sub"
+    sub.mkdir(parents=True)
+    (top / "__init__.py").write_text("")
+    (sub / "heavy.py").write_text("def f(x):\n    import jax\n    return x\n")
+    (sub / "__init__.py").write_text(
+        "from synapseml_tpu.sub.heavy import f\n")
+    # scan the SUBTREE only — rel paths are shallower than the real layout
+    report = analyze_paths([str(sub)], select=["SMT008"], use_acks=False)
+    assert len(report["findings"]) == 1
+    assert "synapseml_tpu.sub.heavy" in report["findings"][0].message
+
+
+# ---------------------------------------------------------------------------
+# waivers
+# ---------------------------------------------------------------------------
+
+def test_waiver_requires_reason(tmp_path):
+    acks = tmp_path / "LINT_ACKS.md"
+    acks.write_text("| rule | file | match | reason |\n|---|---|---|---|\n"
+                    "| SMT001 | mod.py | - |  |\n")
+    with pytest.raises(LintConfigError):
+        load_waivers(str(acks))
+
+
+def test_waiver_suppresses_matching_finding(tmp_path):
+    (tmp_path / "mod.py").write_text("import jax\n")
+    acks = tmp_path / "LINT_ACKS.md"
+    acks.write_text("| rule | file | match | reason |\n|---|---|---|---|\n"
+                    "| SMT001 | mod.py | - | known, tracked elsewhere |\n")
+    report = analyze_paths([str(tmp_path)], select=["SMT001"],
+                           acks_path=str(acks))
+    assert report["findings"] == []
+    assert len(report["waived"]) == 1
+    assert report["unused_waivers"] == []
+
+
+def test_stale_waiver_reported(tmp_path):
+    (tmp_path / "mod.py").write_text("x = 1\n")
+    acks = tmp_path / "LINT_ACKS.md"
+    acks.write_text("| rule | file | match | reason |\n|---|---|---|---|\n"
+                    "| SMT001 | gone.py | - | file was deleted |\n")
+    report = analyze_paths([str(tmp_path)], select=["SMT001"],
+                           acks_path=str(acks))
+    assert len(report["unused_waivers"]) == 1
+
+
+def test_committed_acks_rows_all_carry_reasons():
+    for w in load_waivers(ACKS):  # raises LintConfigError on a bare row
+        assert w.reason.strip()
+
+
+# ---------------------------------------------------------------------------
+# CLI output formats
+# ---------------------------------------------------------------------------
+
+def test_cli_github_format_annotations(tmp_path, capsys):
+    (tmp_path / "mod.py").write_text("import jax\n")
+    rc = lint_main([str(tmp_path), "--select", "SMT001", "--no-acks",
+                    "--format", "github"])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "::error file=" in out
+    assert "line=1" in out and "SMT001" in out
+
+
+def test_cli_exit_codes(tmp_path, capsys):
+    (tmp_path / "clean.py").write_text("x = 1\n")
+    assert lint_main([str(tmp_path), "--no-acks"]) == 0
+    assert lint_main([str(tmp_path), "--select", "NOPE01"]) == 2
+
+
+# ---------------------------------------------------------------------------
+# the gate
+# ---------------------------------------------------------------------------
+
+def test_subtree_invocation_matches_waivers():
+    """`analysis synapseml_tpu` (one path) must anchor finding paths at
+    the repo root so LINT_ACKS.md rows still match — a subtree run must
+    not resurrect waived findings under shortened paths."""
+    report = analyze_paths([os.path.join(REPO_ROOT, "synapseml_tpu")],
+                           acks_path=ACKS)
+    assert report["findings"] == [], [
+        f"{f.location}: {f.code}" for f in report["findings"]]
+    assert [f.path for f in report["waived"]] == \
+        ["synapseml_tpu/runtime/topology.py"]
+
+
+def test_full_repo_zero_unwaived_findings():
+    t0 = time.perf_counter()
+    report = analyze_paths(GATE_PATHS, acks_path=ACKS, root=REPO_ROOT)
+    elapsed = time.perf_counter() - t0
+    assert report["errors"] == []
+    assert report["findings"] == [], [
+        f"{f.location}: {f.code} {f.message}" for f in report["findings"]]
+    # stale waivers rot into blanket suppressions; fail them here too
+    assert report["unused_waivers"] == [], report["unused_waivers"]
+    # acceptance: full repo in seconds (generous bound for a loaded box)
+    assert elapsed < 20.0, f"lint took {elapsed:.1f}s"
+
+
+def test_cli_runs_jax_free():
+    """`python -m synapseml_tpu.analysis` must not import jax (it runs in
+    CI before any accelerator exists) — subprocess ground truth."""
+    code = ("import sys\n"
+            "from synapseml_tpu.analysis.cli import main\n"
+            "rc = main(['--list-rules'])\n"
+            "bad = [m for m in sys.modules if m == 'jax' "
+            "or m.startswith('jax.')]\n"
+            "assert rc == 0 and not bad, (rc, bad[:3])\n")
+    proc = subprocess.run([sys.executable, "-c", code], cwd=REPO_ROOT,
+                          capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stderr

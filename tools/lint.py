@@ -1,0 +1,28 @@
+#!/usr/bin/env python3
+"""Repo lint entry point — ``python tools/lint.py [paths...]``.
+
+Thin wrapper over ``python -m synapseml_tpu.analysis`` so the linter runs
+from a checkout without installing the package: it only puts the repo
+root on ``sys.path``. Relative path arguments stay caller-relative; with
+no paths the CLI lints the whole repo (defaults resolve against the
+package location, not the cwd). Same flags, same exit codes (0 clean, 1
+findings, 2 config error); stays jax-free (enforced by
+``tests/test_import_hygiene.py``).
+"""
+
+import os
+import sys
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def main(argv=None) -> int:
+    if _REPO_ROOT not in sys.path:
+        sys.path.insert(0, _REPO_ROOT)
+    from synapseml_tpu.analysis.cli import main as lint_main
+
+    return lint_main(argv)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
