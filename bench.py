@@ -25,13 +25,9 @@ import time
 
 import numpy as np
 
-# bf16 peak FLOPs by TPU generation (public figures); None -> MFU not reported
-PEAK_FLOPS = {
-    "v5litepod": 197e12, "v5lite": 197e12, "v5e": 197e12,
-    "v5p": 459e12, "v5": 459e12,
-    "v6e": 918e12, "v6lite": 918e12,
-    "v4": 275e12, "v3": 123e12, "v2": 45e12,
-}
+# bf16 peak FLOPs by TPU generation: one source of truth, shared with the
+# per-stage MFU accounting (observability/profiling.py; stdlib-only import)
+from synapseml_tpu.observability.profiling import PEAK_BF16_FLOPS as PEAK_FLOPS
 
 
 def _peak_flops(dev) -> float | None:
@@ -40,6 +36,32 @@ def _peak_flops(dev) -> float | None:
         if k in kind:
             return v
     return None
+
+
+# operand-passing mode of _timed_device_loop: large device operands ride as
+# jit ARGUMENTS (closed-over arrays embed as program constants and blow the
+# remote-compile payload limit). Stamped into every lane's provenance so a
+# harness-side change of this mode can never again confound a kernel
+# regression silently (the r4->r5 flash lesson).
+OPERAND_MODE = "jit-args"
+
+
+def _provenance(dev, platform) -> dict:
+    """Per-artifact provenance: everything that changed under the r5 flash
+    regression without being recorded anywhere. A future confounded
+    regression is self-describing in the committed BENCH_r*.json."""
+    import jax
+
+    try:
+        import jaxlib
+
+        jaxlib_v = getattr(jaxlib, "__version__", None)
+    except Exception:
+        jaxlib_v = None
+    return {"jax": jax.__version__, "jaxlib": jaxlib_v,
+            "backend": platform,
+            "device_kind": getattr(dev, "device_kind", platform),
+            "operand_mode": OPERAND_MODE}
 
 
 def _best_of(k: int, run):
@@ -67,7 +89,13 @@ def _timed_device_loop(step, iters: int, *args):
     Large device operands should be passed via ``*args`` rather than closed
     over: jit-captured arrays embed in the program as constants, and on a
     remote-compile backend a multi-hundred-MB serialized program is
-    rejected outright (HTTP 413 at B=8, S=16k attention shapes)."""
+    rejected outright (HTTP 413 at B=8, S=16k attention shapes).
+
+    Returns ``(seconds_per_iter, last_value, warm_s)`` — ``warm_s`` is the
+    first (trace + XLA compile + execute) call's wall time, stamped into
+    lane provenance as ``compile_warm_s`` so ``tools/perf_diff.py`` can
+    attribute a round-over-round delta to the compile side vs the execute
+    side (the timed region itself is always warm)."""
     import jax
     import jax.numpy as jnp
 
@@ -77,14 +105,16 @@ def _timed_device_loop(step, iters: int, *args):
             return acc + step(acc * jnp.float32(1e-30), *a)
         return jax.lax.fori_loop(0, iters, body, jnp.float32(0.0))
 
+    t0 = time.perf_counter()
     float(loop(*args))  # compile + warm
+    warm_s = time.perf_counter() - t0
     out = []
 
     def run():
         out.append(float(loop(*args)))  # scalar pull: real completion barrier
 
     best = _best_of(3, run)
-    return best / iters, out[-1]
+    return best / iters, out[-1], warm_s
 
 
 def bench_resnet50(platform, peak):
@@ -104,12 +134,13 @@ def bench_resnet50(platform, peak):
             fn.output_names.index("logits")].astype("float32").sum()
 
     iters = 30 if platform != "cpu" else 2
-    dt, _ = _timed_device_loop(step, iters)
+    dt, _, warm_s = _timed_device_loop(step, iters)
     ips = batch / dt
     flops_per_img = 4.09e9 * 2  # ~4.09 GMACs fwd (He et al. / v1.5)
     mfu = ips * flops_per_img / peak if peak else None
     return {"images_per_sec_per_chip": round(ips, 2),
-            "mfu": round(mfu, 4) if mfu else None}
+            "mfu": round(mfu, 4) if mfu else None,
+            "compile_warm_s": round(warm_s, 2)}
 
 
 def bench_bert(platform, peak):
@@ -134,14 +165,15 @@ def bench_bert(platform, peak):
         return out[0].astype("float32").sum()
 
     iters = 20 if platform != "cpu" else 2
-    dt, _ = _timed_device_loop(step, iters)
+    dt, _, warm_s = _timed_device_loop(step, iters)
     sps = batch / dt
     # matmul MACs per layer: qkv+out 4H^2 per token + ffn 2*H*FFN per token
     # + attention scores/values 2*S*H per token
     macs_per_seq = L * S * (4 * H * H + 2 * H * FFN + 2 * S * H)
     mfu = sps * macs_per_seq * 2 / peak if peak else None
     return {"sequences_per_sec_per_chip": round(sps, 2), "seq_len": S,
-            "mfu": round(mfu, 4) if mfu else None}
+            "mfu": round(mfu, 4) if mfu else None,
+            "compile_warm_s": round(warm_s, 2)}
 
 
 def bench_gbdt_adult(platform):
@@ -275,11 +307,12 @@ def bench_vit_gbdt(platform, peak):
         return booster.predict_device(f).sum().astype("float32")
 
     iters = 10 if platform != "cpu" else 2
-    dt, _ = _timed_device_loop(step, iters)
+    dt, _, warm_s = _timed_device_loop(step, iters)
     ips = batch / dt
     mfu = ips * 17.6e9 * 2 / peak if peak else None  # ViT-B/16 ~17.6 GMACs/img
     return {"images_per_sec_end_to_end": round(ips, 2),
-            "mfu_vit_only": round(mfu, 4) if mfu else None}
+            "mfu_vit_only": round(mfu, 4) if mfu else None,
+            "compile_warm_s": round(warm_s, 2)}
 
 
 def bench_flash_attention(platform, peak):
@@ -299,7 +332,7 @@ def bench_flash_attention(platform, peak):
     import jax.numpy as jnp
 
     from synapseml_tpu.parallel import flash_attention
-    from synapseml_tpu.parallel.flash import dense_attention
+    from synapseml_tpu.parallel.flash import _pick_blocks, dense_attention
 
     H, D = 8, 64
     rng = np.random.default_rng(9)
@@ -333,9 +366,10 @@ def bench_flash_attention(platform, peak):
         q, k, v = qkv(B, S)
         dt = None
         err = None
+        warm_s = None
         for attempt in range(3):  # tunneled remote-compile flakes per point
             try:
-                dt, _ = _timed_device_loop(
+                dt, _, warm_s = _timed_device_loop(
                     fstep, 5 if platform != "cpu" else 1, q, k, v)
                 break
             except Exception as e:
@@ -347,16 +381,22 @@ def bench_flash_attention(platform, peak):
             curve[key] = {"flash_error": f"{type(err).__name__}"}
             continue
         flops = 4 * B * H * S * S * D  # nominal; causal skips ~half
+        # per-point provenance: the auto-picked blocks and operand mode ARE
+        # the two confounds that made the r5 regression undiagnosable from
+        # the artifact alone — stamp them so perf_diff can attribute
         entry = {"flash_ms": round(dt * 1000, 2),
                  "flash_tflops_nominal": round(flops / dt / 1e12, 1),
-                 "flash_mfu": round(flops / dt / peak, 4) if peak else None}
+                 "flash_mfu": round(flops / dt / peak, 4) if peak else None,
+                 "blocks": list(_pick_blocks(B * H, S, S)),
+                 "operand_mode": OPERAND_MODE,
+                 "compile_warm_s": round(warm_s, 2)}
         # XLA dense at the same shape: ATTEMPT whenever the f32 score tensor
         # alone could fit (failures record the error class, so the curve
         # distinguishes "tried and OOM'd" from "not attempted")
         score_bytes = 4 * B * H * S * S
         if score_bytes <= 10e9:
             try:
-                xdt, _ = _timed_device_loop(
+                xdt, _, _xw = _timed_device_loop(
                     xstep, 5 if platform != "cpu" else 1, q, k, v)
                 entry["xla_ms"] = round(xdt * 1000, 2)
                 entry["flash_speedup_vs_xla"] = round(xdt / dt, 2)
@@ -381,6 +421,69 @@ def bench_flash_attention(platform, peak):
     if serving:
         out["serving_b8_mfu"] = serving["flash_mfu"]
     out["curve"] = curve
+    return out
+
+
+def bench_flash_gqa(platform, peak):
+    """Grouped-query flash attention (ROADMAP item 1: the GQA path existed
+    but was perf-unmeasured). H=8 query heads over H_kv=2 K/V heads — the
+    Llama/Mistral-shaped 4:1 grouping — at the serving-shaped point (B=8,
+    S=8k). The kernel maps query heads onto K/V groups in its block index
+    map, so grouped K/V are never expanded in HBM; the lane proves that
+    bandwidth win is real by ALSO timing the same shapes with K/V
+    pre-expanded to full multi-head (``expanded_ms`` — what a GQA-unaware
+    kernel would pay). Participates in ``vs_prev_round`` and the ratchet
+    gate (tests/test_bench_ratchet.py) via ``tflops_nominal``."""
+    import jax
+    import jax.numpy as jnp
+
+    from synapseml_tpu.parallel import flash_attention
+    from synapseml_tpu.parallel.flash import _pick_blocks
+
+    H, H_kv, D = 8, 2, 64
+    rng = np.random.default_rng(11)
+    B, S = (8, 8192) if platform != "cpu" else (1, 512)
+
+    def mk(h):
+        return jax.device_put(rng.normal(size=(B, S, h, D)).astype(
+            np.float32)).astype(jnp.bfloat16)
+
+    q, k, v = mk(H), mk(H_kv), mk(H_kv)
+
+    def gstep(eps, q, k, v):
+        return flash_attention(q + eps.astype(jnp.bfloat16), k, v,
+                               causal=True).astype(jnp.float32).sum()
+
+    iters = 5 if platform != "cpu" else 1
+    dt = warm_s = None
+    err = None
+    for attempt in range(3):  # tunneled remote-compile flakes, like the
+        try:                  # sibling flash_attention_32k lane
+            dt, _, warm_s = _timed_device_loop(gstep, iters, q, k, v)
+            break
+        except Exception as e:
+            err = e
+            if not ("remote_compile" in str(e) or "INTERNAL" in str(e)
+                    or "read body" in str(e)):
+                break
+    if dt is None:
+        raise err  # recorded by main()'s per-lane error capture
+    flops = 4 * B * H * S * S * D  # query-head count sets the math
+    out = {"seq_len": S, "batch": B, "heads": H, "kv_heads": H_kv,
+           "flash_ms": round(dt * 1000, 2),
+           "tflops_nominal": round(flops / dt / 1e12, 1),
+           "mfu_vs_bf16_peak": round(flops / dt / peak, 4) if peak else None,
+           "blocks": list(_pick_blocks(B * H, S, S)),
+           "operand_mode": OPERAND_MODE,
+           "compile_warm_s": round(warm_s, 2)}
+    try:  # the control: K/V pre-expanded to full MHA (4x K/V HBM traffic)
+        ke = jnp.repeat(k, H // H_kv, axis=2)
+        ve = jnp.repeat(v, H // H_kv, axis=2)
+        edt, _, _ = _timed_device_loop(gstep, iters, q, ke, ve)
+        out["expanded_ms"] = round(edt * 1000, 2)
+        out["gqa_speedup_vs_expanded"] = round(edt / dt, 2)
+    except Exception as e:
+        out["expanded_error"] = f"{type(e).__name__}"[:120]
     return out
 
 
@@ -550,6 +653,74 @@ def bench_tracing_overhead(platform):
     return {"per_transform_base_us": round(base_us, 2),
             "traced_span_cost_us": round(traced_us, 3),
             "tracing_overhead_pct": round(traced_us / base_us * 100.0, 2)}
+
+
+def bench_profiling_overhead(platform):
+    """Per-transform overhead of the device-profiling span hook
+    (observability/profiling.py): same methodology as
+    ``observability_span_overhead`` — the bare per-span cost with the
+    profiler hook INSTALLED and a profiled jit call inside every span (the
+    worst case: signature hash + compiled-call dispatch + thread-local
+    FLOPs accounting + span-exit attribution), against the per-transform
+    baseline of a cheap real stage with spans disabled. Contract: the
+    profiled path stays within the same <5% budget (docs/observability.md).
+    """
+    from synapseml_tpu import observability
+    from synapseml_tpu.core import Table, UnaryTransformer
+    from synapseml_tpu.observability import profiling
+    from synapseml_tpu.observability.spans import stage_span
+
+    class _ProfBenchScale(UnaryTransformer):  # _ prefix: not registered
+        def _transform_column(self, col, table):
+            return (col - col.mean()) / (col.std() + 1e-12)
+
+    table = Table({"input": np.random.default_rng(8).normal(size=100_000)})
+    stage = _ProfBenchScale()
+    stage.transform(table)  # warm (cold-span + lazy allocation)
+
+    pj = profiling.profiled_jit(lambda x: x * 2.0, name="bench.profiled")
+    xs = np.ones(8, np.float32)
+    pj(xs)  # compile once, outside the timed loop
+
+    n_span = 20_000
+
+    def span_loop():
+        for _ in range(n_span):
+            with stage_span(stage, "transform") as sp:
+                pj(xs)
+                sp.set_rows(100_000)
+
+    profiling.enable()
+    span_loop()  # untimed warm pass
+    prof_us = _best_of(3, span_loop) / n_span * 1e6
+
+    # the profiled-jit call alone (dispatch we'd pay with plain jax.jit
+    # anyway); subtracting isolates the ACCOUNTING overhead
+    def call_loop():
+        for _ in range(n_span):
+            pj(xs)
+
+    call_loop()
+    call_us = _best_of(3, call_loop) / n_span * 1e6
+
+    n = 300
+
+    def run():
+        for _ in range(n):
+            stage.transform(table)
+
+    enabled_before = observability.is_enabled()
+    try:
+        observability.disable()
+        base_us = _best_of(5, run) / n * 1e6
+    finally:
+        (observability.enable if enabled_before else observability.disable)()
+    span_cost_us = max(prof_us - call_us, 0.0)
+    return {"per_transform_base_us": round(base_us, 2),
+            "profiled_span_cost_us": round(span_cost_us, 3),
+            "profiled_call_us": round(call_us, 3),
+            "profiling_overhead_pct": round(span_cost_us / base_us * 100.0,
+                                            2)}
 
 
 def _balanced_json_at(s: str, start: int):
@@ -752,6 +923,7 @@ _PRIMARY = {
     "gbdt_sparse_hashed": "train_rows_per_sec",
     "vit_to_gbdt_pipeline": "images_per_sec_end_to_end",
     "flash_attention_32k": "tflops_nominal",
+    "flash_attention_gqa": "tflops_nominal",
 }
 
 
@@ -781,6 +953,10 @@ def main() -> None:
 
     extra = {"device_kind": getattr(dev, "device_kind", platform),
              "peak_bf16_flops": peak}
+    try:
+        extra["provenance"] = _provenance(dev, platform)
+    except Exception:
+        pass  # provenance must never sink the bench
     headline = None
     for key, fn in [
         ("resnet50_onnx", lambda: bench_resnet50(platform, peak)),
@@ -790,9 +966,11 @@ def main() -> None:
         ("gbdt_sparse_hashed", lambda: bench_gbdt_sparse(platform)),
         ("vit_to_gbdt_pipeline", lambda: bench_vit_gbdt(platform, peak)),
         ("flash_attention_32k", lambda: bench_flash_attention(platform, peak)),
+        ("flash_attention_gqa", lambda: bench_flash_gqa(platform, peak)),
         ("serving_latency", lambda: bench_serving(platform)),
         ("observability_span_overhead", lambda: bench_span_overhead(platform)),
         ("tracing_overhead", lambda: bench_tracing_overhead(platform)),
+        ("profiling_overhead", lambda: bench_profiling_overhead(platform)),
     ]:
         try:
             extra[key] = fn()

@@ -12,14 +12,15 @@ from __future__ import annotations
 import collections
 import json
 import logging
+import os
 import sys
 import threading
 import time
 from typing import Any, Dict, List, Optional
 
-__all__ = ["log_stage_call", "recent_events", "clear_events", "drain_events",
-           "get_logger", "set_event_capacity", "event_capacity",
-           "profile_trace", "BUILD_VERSION"]
+__all__ = ["log_stage_call", "log_event", "recent_events", "clear_events",
+           "drain_events", "get_logger", "set_event_capacity",
+           "event_capacity", "profile_trace", "BUILD_VERSION"]
 
 BUILD_VERSION = "0.1.0"
 
@@ -75,12 +76,24 @@ def log_stage_call(stage, method: str, **extra) -> None:
     trace is active carry its ``trace_id`` so the per-call view joins
     against ``/traces``.
     """
+    log_event(method, className=type(stage).__name__,
+              uid=getattr(stage, "uid", "?"), **extra)
+
+
+def log_event(method: str, className: str = "event", uid: str = "?",
+              **extra) -> None:
+    """Record one structured event with the same schema as stage-call
+    events — the hook for non-stage emitters (XLA compile accounting in
+    ``observability.profiling``, profiler captures). ``pid`` is stamped
+    live so multi-process event streams (a ``ProcessServingFleet``)
+    stay attributable after they are pooled into one timeline."""
     evt = {
-        "uid": getattr(stage, "uid", "?"),
-        "className": type(stage).__name__,
+        "uid": uid,
+        "className": className,
         "method": method,
         "buildVersion": BUILD_VERSION,
         "ts": time.time(),
+        "pid": os.getpid(),
         **extra,
     }
     tid = _active_trace_id()
@@ -120,7 +133,8 @@ def profile_trace(trace_dir: str):
 
         evt = {"method": "profile_trace", "trace_dir": trace_dir,
                "className": "profiler", "uid": "profiler",
-               "buildVersion": BUILD_VERSION, "ts": time.time()}
+               "buildVersion": BUILD_VERSION, "ts": time.time(),
+               "pid": os.getpid()}
         tid = _active_trace_id()
         if tid is not None:
             evt["trace_id"] = tid

@@ -24,6 +24,7 @@ import numpy as np
 
 from ..core.clock import StopWatch
 from ..observability import get_registry
+from ..observability.profiling import profiled_jit
 from .binning import BinMapper
 from .grow import GrownTree, TreeConfig, grow_tree
 
@@ -1333,27 +1334,34 @@ def _build_step(grad_fn=None, fobj=None, *, cfg, C, lr, boosting, d, cat_idx,
             binned_spec = data_spec
         in_specs = (binned_spec, data_spec, data_spec, data_spec, rep, rep)
         out_specs = (rep, data_spec)
+        # profiled jit entry points (observability/profiling.py): every
+        # XLA compile of a training step is timed into
+        # smt_compile_seconds{fn=...} with its recompile cause, and the
+        # executable's cost_analysis FLOPs attribute achieved MFU to the
+        # enclosing fit() span
         if scan_iters is not None:
-            return jax.jit(shard_map_compat(scan_loop, mesh=mesh,
-                                            in_specs=in_specs,
-                                            out_specs=out_specs, check=False))
+            return profiled_jit(shard_map_compat(scan_loop, mesh=mesh,
+                                                 in_specs=in_specs,
+                                                 out_specs=out_specs,
+                                                 check=False),
+                                name="gbdt.scan_sharded")
 
         def sharded_iter(binned, yv, wv, raw, key, fkey):
             key = jax.random.fold_in(key, jax.lax.axis_index(axis))
             trees, new_raw = one_iter(binned, yv, wv, raw, key, fkey)
             return trees, new_raw
 
-        return jax.jit(shard_map_compat(
+        return profiled_jit(shard_map_compat(
             sharded_iter, mesh=mesh,
             in_specs=in_specs,
             out_specs=out_specs,
             check=False,
-        ))
+        ), name="gbdt.iter_sharded")
     if scan_iters is not None and n_eval > 0:
-        return jax.jit(scan_loop_eval)
+        return profiled_jit(scan_loop_eval, name="gbdt.scan_eval")
     if scan_iters is not None:
-        return jax.jit(scan_loop)
-    return jax.jit(one_iter)
+        return profiled_jit(scan_loop, name="gbdt.scan")
+    return profiled_jit(one_iter, name="gbdt.iter")
 
 
 @lru_cache(maxsize=64)

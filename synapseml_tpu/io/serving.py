@@ -39,7 +39,8 @@ from .http_schema import HTTPRequestData, HTTPResponseData
 
 __all__ = ["ServingServer", "MicroBatchServingEngine", "serve",
            "serve_metrics_exposition", "serve_traces_exposition",
-           "request_to_string", "string_to_response"]
+           "serve_timeline_exposition", "request_to_string",
+           "string_to_response"]
 
 _logger = get_logger("io.serving")
 
@@ -90,6 +91,11 @@ class ServingServer:
                     # same rule for the flight recorder: reading traces of
                     # a wedged engine is exactly when you need them
                     serve_traces_exposition(self)
+                    return
+                if method == "GET" and op_path == "/timeline":
+                    # the flight recorder as Chrome-trace JSON (open in
+                    # Perfetto); same server-answers rule as /traces
+                    serve_timeline_exposition(self)
                     return
                 length = int(self.headers.get("Content-Length") or 0)
                 body = self.rfile.read(length) if length else None
@@ -194,6 +200,12 @@ class ServingServer:
             "smt_serving_latency_seconds", "enqueue->reply latency",
             ("server",)).labels(self.server_label)
         reg.register_collector(self._collect_metrics)
+        # device-memory gauges sync at scrape time (graceful no-op until a
+        # backend with allocator stats exists): every worker's /metrics
+        # carries its HBM watermarks into the fleet merge
+        from ..observability.profiling import install_memory_collector
+
+        install_memory_collector(reg)
         self._thread = threading.Thread(target=self._httpd.serve_forever,
                                         name=f"serving-{self.port}", daemon=True)
         self._thread.start()
@@ -343,6 +355,32 @@ def serve_traces_exposition(handler, payload: Optional[dict] = None) -> None:
     if payload is None:
         payload = tracing.get_tracer().snapshot()
     body = json.dumps(payload).encode()
+    try:
+        handler.send_response(200)
+        handler.send_header("Content-Type", "application/json")
+        handler.send_header("Content-Length", str(len(body)))
+        handler.end_headers()
+        handler.wfile.write(body)
+    except OSError:
+        pass  # reader went away
+
+
+def serve_timeline_exposition(handler, payload: Optional[dict] = None) -> None:
+    """Answer a ``GET /timeline``: the flight recorder rendered as
+    Chrome-trace/Perfetto JSON (``observability.render_chrome_trace``),
+    with recent telemetry events merged in as instant events. ``payload``
+    overrides the trace source — the routing front door passes its
+    stitched fleet view, so one download shows every worker process as
+    its own track."""
+    from ..core.telemetry import recent_events
+    from ..observability.profiling import render_chrome_trace
+
+    if payload is None:
+        payload = tracing.get_tracer().snapshot()
+    # default=str: telemetry event extras are caller-supplied (numpy
+    # scalars etc.) and must never 500 the timeline endpoint
+    body = json.dumps(render_chrome_trace(payload, recent_events()),
+                      default=str).encode()
     try:
         handler.send_response(200)
         handler.send_header("Content-Type", "application/json")

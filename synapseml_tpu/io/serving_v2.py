@@ -41,7 +41,8 @@ from ..observability import (get_registry, histogram_quantile,
 from .http_schema import HTTPResponseData
 from .serving import (MicroBatchServingEngine, ServingServer, engine_metrics,
                       respond_batch, serve_metrics_exposition,
-                      serve_traces_exposition, traced_batch)
+                      serve_timeline_exposition, serve_traces_exposition,
+                      traced_batch)
 
 __all__ = ["ContinuousServingEngine", "DistributedServingEngine",
            "ProcessServingFleet", "ServiceRegistry", "RoutingServer",
@@ -192,6 +193,12 @@ class RoutingServer:
                     # stitched fleet traces: worker fragments merge into
                     # the routed trace by trace id (merge.merge_traces)
                     serve_traces_exposition(self, outer.fleet_traces())
+                    return
+                if method == "GET" and op_path == "/timeline":
+                    # the stitched fleet view as ONE Chrome-trace JSON:
+                    # spans carry their recording process's pid, so the
+                    # router and every worker render as separate tracks
+                    serve_timeline_exposition(self, outer.fleet_traces())
                     return
                 targets = outer.registry.lookup(outer.service)
                 if not targets:
@@ -607,6 +614,14 @@ class ProcessServingFleet:
         fragments merged by trace id (what ``GET /traces`` on the front
         door serves)."""
         return self.router.fleet_traces()
+
+    def timeline_snapshot(self) -> dict:
+        """The stitched fleet traces rendered as Chrome-trace JSON (what
+        ``GET /timeline`` on the front door serves): one timeline, one
+        ``pid`` track per worker PROCESS plus the router's own."""
+        from ..observability.profiling import render_chrome_trace
+
+        return render_chrome_trace(self.router.fleet_traces())
 
     def latency_p50(self) -> Optional[float]:
         """Fleet p50 across worker processes, from merged histogram buckets

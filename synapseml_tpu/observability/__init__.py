@@ -41,6 +41,11 @@ from .tracing import (SpanContext, Tracer, TraceSpan, current_span,
                       get_tracer, inject_headers, parse_traceparent,
                       set_tracer, start_span, use_span)
 
+# imported AFTER spans so the device-profiling span hook installs into the
+# fully-initialized module; profiling stays stdlib-only at import (lazy jax)
+from . import profiling  # noqa: E402  (install order is load-bearing)
+from .profiling import profiled_jit, render_chrome_trace
+
 __all__ = [
     "CONTENT_TYPE",
     "DEFAULT_BUCKETS",
@@ -65,6 +70,9 @@ __all__ = [
     "merge_snapshots",
     "merge_traces",
     "parse_traceparent",
+    "profiled_jit",
+    "profiling",
+    "render_chrome_trace",
     "render_openmetrics",
     "render_prometheus",
     "set_registry",

@@ -48,11 +48,14 @@ def _merge_exemplars(tgt: Dict[str, Any], src: Dict[str, Any]) -> None:
 
 
 def merge_snapshots(snapshots: Sequence[Dict[str, Any]]) -> Dict[str, Any]:
-    """Merge registry snapshots: counters/gauges sum per label set,
-    histograms sum bucket-wise (exact — all histograms share the fixed
-    log-spaced layout). Same-``registry_id`` snapshots dedupe (last wins).
-    Families whose schema disagrees across snapshots are skipped rather
-    than mis-merged."""
+    """Merge registry snapshots: counters sum per label set, histograms
+    sum bucket-wise (exact — all histograms share the fixed log-spaced
+    layout), and gauges follow their family's explicit ``merge`` mode:
+    ``sum`` (the default — additive gauges like in-flight requests or
+    live bytes) or ``max`` (high watermarks like peak HBM, where a sum
+    across workers answers no question anyone asked). Same-
+    ``registry_id`` snapshots dedupe (last wins). Families whose schema
+    disagrees across snapshots are skipped rather than mis-merged."""
     by_id: Dict[str, Dict[str, Any]] = {}
     anon: List[Dict[str, Any]] = []  # already-merged snapshots have no id
     for snap in snapshots:
@@ -76,13 +79,18 @@ def merge_snapshots(snapshots: Sequence[Dict[str, Any]]) -> Dict[str, Any]:
                                for s in fam.get("series", [])],
                     **({"buckets": list(fam["buckets"])}
                        if fam.get("buckets") else {}),
+                    **({"merge": fam["merge"]}
+                       if fam.get("merge", "sum") != "sum" else {}),
                 }
                 continue
             if (out["type"] != fam["type"]
                     or out["labelnames"] != list(fam.get("labelnames", []))
                     or out.get("buckets") != (list(fam["buckets"])
-                                              if fam.get("buckets") else None)):
+                                              if fam.get("buckets") else None)
+                    or out.get("merge", "sum") != fam.get("merge", "sum")):
                 continue  # schema drift across workers: don't mis-merge
+            take_max = (fam["type"] == "gauge"
+                        and fam.get("merge", "sum") == "max")
             index = {tuple(s["labels"]): s for s in out["series"]}
             for s in fam.get("series", []):
                 key = tuple(s["labels"])
@@ -97,8 +105,10 @@ def merge_snapshots(snapshots: Sequence[Dict[str, Any]]) -> Dict[str, Any]:
                     tgt["sum"] += s["sum"]
                     tgt["count"] += s["count"]
                     _merge_exemplars(tgt, s)
-                else:  # counters AND gauges sum across workers (a fleet
-                    # gauge like in-flight requests is additive)
+                elif take_max:  # watermark gauges: the worst worker wins
+                    tgt["value"] = max(tgt["value"], s["value"])
+                else:  # counters and additive gauges (in-flight requests,
+                    # live bytes) sum across workers
                     tgt["value"] += s["value"]
     # no registry_id: a merged snapshot is an aggregate, not a scrape of one
     # registry, so a second-level merger must treat it as anonymous (sum)

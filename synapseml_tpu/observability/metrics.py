@@ -102,6 +102,18 @@ class _Series:
         with self._family._lock:
             self.value = float(v)
 
+    def set_max(self, v: float) -> None:
+        """Monotone update: keep the larger of the current value and
+        ``v``, atomically. High-watermark gauges must use this — an
+        unlocked read-compare-set lets two racing updaters move the
+        watermark BACKWARDS (A reads 0, B sets 200, A sets 100)."""
+        if self._family.type != "gauge":
+            raise ValueError("set_max() is gauge-only")
+        v = float(v)
+        with self._family._lock:
+            if v > self.value:
+                self.value = v
+
     # histogram -----------------------------------------------------------
     def observe(self, v: float, exemplar: Optional[str] = None) -> None:
         """Record one sample. ``exemplar`` optionally names the trace id
@@ -161,14 +173,22 @@ class MetricFamily:
 
     def __init__(self, name: str, type_: str, help_: str,
                  labelnames: Tuple[str, ...],
-                 buckets: Optional[Tuple[float, ...]] = None):
+                 buckets: Optional[Tuple[float, ...]] = None,
+                 merge: str = "sum"):
         if type_ not in ("counter", "gauge", "histogram"):
             raise ValueError(f"unknown metric type {type_!r}")
+        if merge not in ("sum", "max"):
+            raise ValueError(f"unknown merge mode {merge!r} (sum|max)")
         self.name = name
         self.type = type_
         self.help = help_
         self.labelnames = labelnames
         self.buckets = tuple(buckets) if type_ == "histogram" else None
+        # fleet-merge semantics for GAUGES: "sum" (additive — in-flight
+        # requests, live bytes) or "max" (a high watermark — peak HBM;
+        # summing watermarks across workers is meaningless). Travels in
+        # the snapshot so merge.py applies the right rule per metric.
+        self.merge_mode = merge if type_ == "gauge" else "sum"
         # plain Lock (not RLock): never held across a call that could
         # re-enter, and it is on the per-observation hot path
         self._lock = threading.Lock()
@@ -209,6 +229,9 @@ class MetricFamily:
     def set(self, v: float) -> None:
         self._default.set(v)
 
+    def set_max(self, v: float) -> None:
+        self._default.set_max(v)
+
     def observe(self, v: float, exemplar: Optional[str] = None) -> None:
         self._default.observe(v, exemplar)
 
@@ -236,6 +259,8 @@ class MetricFamily:
                                "series": series}
         if self.buckets is not None:
             out["buckets"] = list(self.buckets)
+        if self.merge_mode != "sum":
+            out["merge"] = self.merge_mode
         return out
 
 
@@ -280,21 +305,24 @@ class MetricsRegistry:
 
     def _family(self, name: str, type_: str, help_: str,
                 labelnames: Sequence[str],
-                buckets: Optional[Sequence[float]] = None) -> MetricFamily:
+                buckets: Optional[Sequence[float]] = None,
+                merge: str = "sum") -> MetricFamily:
         labelnames = tuple(labelnames)
         buckets = tuple(buckets) if buckets else None
         with self._lock:
             fam = self._families.get(name)
             if fam is None:
-                fam = MetricFamily(name, type_, help_, labelnames, buckets)
+                fam = MetricFamily(name, type_, help_, labelnames, buckets,
+                                   merge=merge)
                 self._families[name] = fam
                 return fam
         if (fam.type != type_ or fam.labelnames != labelnames
-                or fam.buckets != buckets):
+                or fam.buckets != buckets
+                or (type_ == "gauge" and fam.merge_mode != merge)):
             raise ValueError(
                 f"metric {name!r} re-registered with a different schema: "
-                f"{fam.type}{fam.labelnames}/{fam.buckets} vs "
-                f"{type_}{labelnames}/{buckets}")
+                f"{fam.type}{fam.labelnames}/{fam.buckets}/{fam.merge_mode} "
+                f"vs {type_}{labelnames}/{buckets}/{merge}")
         return fam
 
     def counter(self, name: str, help_: str = "",
@@ -302,8 +330,9 @@ class MetricsRegistry:
         return self._family(name, "counter", help_, labelnames)
 
     def gauge(self, name: str, help_: str = "",
-              labelnames: Sequence[str] = ()) -> MetricFamily:
-        return self._family(name, "gauge", help_, labelnames)
+              labelnames: Sequence[str] = (),
+              merge: str = "sum") -> MetricFamily:
+        return self._family(name, "gauge", help_, labelnames, merge=merge)
 
     def histogram(self, name: str, help_: str = "",
                   labelnames: Sequence[str] = (),

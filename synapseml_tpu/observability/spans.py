@@ -36,9 +36,23 @@ from typing import Any, Optional
 from . import tracing as _tracing
 from .metrics import MetricsRegistry, get_registry
 
-__all__ = ["span", "stage_span", "enable", "disable", "is_enabled", "Span"]
+__all__ = ["span", "stage_span", "enable", "disable", "is_enabled", "Span",
+           "set_profiler"]
 
 _enabled = True
+
+# Device-profiling hook (installed by ``observability.profiling``): an
+# object with ``enter() -> token`` and ``exit(token, name, elapsed_s)``.
+# When set, every span attributes the FLOPs/bytes of profiled jit calls
+# that ran inside it (achieved MFU per stage) and samples device memory.
+# Kept as a hook so this module stays stdlib-pure on its own.
+_profiler = None
+
+
+def set_profiler(profiler) -> None:
+    """Install (or with ``None`` remove) the span profiling hook."""
+    global _profiler
+    _profiler = profiler
 
 
 def enable() -> None:
@@ -96,7 +110,7 @@ class Span:
     keep the hot path at two clock reads + one histogram observe."""
 
     __slots__ = ("_dur", "_rows_c", "_errors", "_t0", "rows", "_name",
-                 "_trace_parent")
+                 "_trace_parent", "_prof0")
 
     def __init__(self, series, cold: bool, name=("span", "call")):
         dur_cold, dur_warm, rows_c, errors = series
@@ -116,12 +130,20 @@ class Span:
         # active trace: one module-bool check + one contextvar read.
         self._trace_parent = (_tracing.current_span()
                               if _tracing.is_enabled() else None)
+        # device-profiling snapshot (FLOPs/bytes thread-local counters);
+        # cost with no profiler installed: one module-global check
+        self._prof0 = _profiler.enter() if _profiler is not None else None
         self._t0 = _now_ns()
         return self
 
     def __exit__(self, exc_type, exc, tb) -> bool:
         elapsed_s = (_now_ns() - self._t0) * 1e-9
         self._dur.observe(elapsed_s)
+        if self._prof0 is not None and _profiler is not None:
+            try:
+                _profiler.exit(self._prof0, self._name, elapsed_s)
+            except Exception:
+                pass  # accounting must never break the instrumented call
         tp = self._trace_parent
         if tp is not None:
             attrs = {"stage": self._name[0], "method": self._name[1]}

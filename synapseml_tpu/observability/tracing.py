@@ -273,10 +273,14 @@ class TraceSpan:
         self.tracer._finish(self)
 
     def to_dict(self) -> Dict[str, Any]:
+        # ``pid`` identifies the RECORDING process (read live — fork-safe):
+        # it is what lets the timeline export give each worker of a
+        # ``ProcessServingFleet`` its own track after fragments stitch
         return {"trace_id": self.trace_id, "span_id": self.span_id,
                 "parent_id": self.parent_id, "name": self.name,
                 "start_ts": self.start_ts, "duration_s": self.duration_s,
-                "status": self.status, "attributes": self.attributes}
+                "status": self.status, "attributes": self.attributes,
+                "pid": os.getpid()}
 
     # context-manager sugar: activates in this thread and ends on exit
     def __enter__(self) -> "TraceSpan":

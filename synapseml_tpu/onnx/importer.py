@@ -84,7 +84,15 @@ class OnnxFunction:
         self.input_names: List[str] = [vi.name for vi in self.input_infos]
         self.output_names: List[str] = [vi.name for vi in self.graph.output]
         self._validate_ops(self.graph)
-        self._jit = jax.jit(self._run_positional)
+        # profiled jit entry point: every XLA compile of this model is
+        # timed into smt_compile_seconds{fn=...}, its cost_analysis FLOPs
+        # cached, and warm calls attribute achieved MFU to the enclosing
+        # stage span (observability/profiling.py)
+        from ..observability.profiling import profiled_jit
+
+        graph_name = getattr(self.graph, "name", "") or "graph"
+        self._jit = profiled_jit(self._run_positional,
+                                 name=f"onnx.{graph_name}")
 
     # -- public ------------------------------------------------------------------
 

@@ -172,12 +172,17 @@ def flash_attention(q, k, v, causal: bool = False, block_q: int = None,
 
 @functools.lru_cache(maxsize=1)
 def _flash_bh_jit():
-    """jax.jit applied lazily so importing the package never imports jax."""
-    import jax
+    """Profiled jit entry point, applied lazily so importing the package
+    never imports jax. ``observability.profiling`` times every compile
+    (``smt_compile_seconds{fn="flash.attention"}``), counts recompiles by
+    the signature change that caused them (block-size churn shows up as
+    ``cause="static"``), and caches cost_analysis FLOPs so serving spans
+    report the kernel's achieved MFU."""
+    from ..observability.profiling import profiled_jit
 
-    return jax.jit(_flash_bh_impl,
-                   static_argnames=("causal", "block_q", "block_k", "rep",
-                                    "interpret"))
+    return profiled_jit(_flash_bh_impl, name="flash.attention",
+                        static_argnames=("causal", "block_q", "block_k",
+                                         "rep", "interpret"))
 
 
 def _flash_bh(q, k, v, causal, block_q, block_k, rep, interpret):

@@ -28,6 +28,7 @@ _GATED_MODULES = [
     "synapseml_tpu.observability.exposition",
     "synapseml_tpu.observability.merge",
     "synapseml_tpu.observability.metrics",
+    "synapseml_tpu.observability.profiling",
     "synapseml_tpu.observability.spans",
     "synapseml_tpu.observability.tracing",
     "synapseml_tpu.io.serving",
@@ -57,9 +58,10 @@ _GATED_MODULES = [
 _TOOLS_DIR = os.path.join(
     os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tools")
 
-# standalone CLI tools a human points at PRODUCTION endpoints; they must
-# stay jax-free (tools/ is not a package — imported via a path entry)
-_GATED_TOOLS = ["trace_dump", "lint"]
+# standalone CLI tools a human points at PRODUCTION endpoints or saved
+# artifacts; they must stay jax-free (tools/ is not a package — imported
+# via a path entry)
+_GATED_TOOLS = ["trace_dump", "lint", "perf_diff", "perf_timeline"]
 
 
 def test_no_jax_at_import():

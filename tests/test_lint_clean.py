@@ -486,8 +486,13 @@ def test_subtree_invocation_matches_waivers():
                            acks_path=ACKS)
     assert report["findings"] == [], [
         f"{f.location}: {f.code}" for f in report["findings"]]
-    assert [f.path for f in report["waived"]] == \
-        ["synapseml_tpu/runtime/topology.py"]
+    # the reviewed waiver set: the shard_map compat shim plus the two
+    # SMT008 nodes for observability/__init__'s eager (but import-pure,
+    # hygiene-gated) import of the profiling hook module
+    assert sorted(set(f.path for f in report["waived"])) == [
+        "synapseml_tpu/observability/__init__.py",
+        "synapseml_tpu/runtime/topology.py",
+    ]
 
 
 def test_full_repo_zero_unwaived_findings():

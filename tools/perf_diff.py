@@ -1,0 +1,341 @@
+#!/usr/bin/env python
+"""Perf-diff bisection toolkit: attribute a round-over-round regression.
+
+Compares two committed ``BENCH_r*.json`` artifacts lane by lane and, for
+each regressed lane, attributes the delta to what the artifacts can prove:
+
+- **compile vs execute** — every timed region in ``bench.py`` is warm
+  (the first trace+compile+execute call is stamped separately as
+  ``compile_warm_s``), so a moved lane metric is an EXECUTE-side change;
+  a moved ``compile_warm_s`` is a compile-side one. Both are diffed when
+  present.
+- **block-size metadata** — flash lanes stamp the auto-picked Pallas
+  blocks per curve point (``_pick_blocks`` output); a changed block pick
+  at a regressed point is named outright.
+- **operand-passing mode** — ``operand_mode`` (operands as jit args vs
+  closed-over constants) is stamped per lane and per artifact; a change
+  is a harness confound, not a kernel change.
+- **control lanes** — where a curve carries the XLA dense baseline
+  (``xla_ms``) at the same shapes, its movement separates "the kernel
+  got slower" from "the harness/environment got slower": a control that
+  moved with the kernel implicates the shared harness.
+
+Artifacts damaged by the driver's tail-window truncation (r4's
+``parsed: null``) recover per-lane objects by brace matching, same as
+``bench.py``'s armored loader.
+
+    python tools/perf_diff.py BENCH_r04.json BENCH_r05.json
+    python tools/perf_diff.py BENCH_r04.json BENCH_r05.json --json
+    python tools/perf_diff.py old.json new.json --threshold 0.9 --all
+
+Exit code 1 when any lane regressed below the threshold (CI-friendly).
+Stdlib-only and import-hygiene-gated: diagnosing a regression from saved
+artifacts must never require jax in the diagnosing process.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import re
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+# per-lane primary metric (higher is better); mirrors bench._PRIMARY plus
+# the lanes whose primary is an overhead percentage (lower is better)
+PRIMARY = {
+    "resnet50_onnx": "images_per_sec_per_chip",
+    "gbdt_adult_scale": "train_rows_per_sec",
+    "bert_base_onnx": "sequences_per_sec_per_chip",
+    "gbdt_higgs_scale": "train_rows_per_sec",
+    "gbdt_sparse_hashed": "train_rows_per_sec",
+    "vit_to_gbdt_pipeline": "images_per_sec_end_to_end",
+    "flash_attention_32k": "tflops_nominal",
+    "flash_attention_gqa": "tflops_nominal",
+}
+
+
+def _balanced_json_at(s: str, start: int):
+    try:
+        obj, _ = json.JSONDecoder().raw_decode(s, start)
+        return obj
+    except Exception:
+        return None
+
+
+def _recover_from_tail(tail: str) -> Dict[str, Any]:
+    """Salvage per-lane objects out of a truncated artifact tail (the
+    driver keeps only the last ~2KB of stdout; r4's embedded traceback
+    pushed the JSON front out of the window)."""
+    out: Dict[str, Any] = {}
+    keys = list(PRIMARY) + ["serving_latency", "vs_prev_round", "provenance",
+                            "observability_span_overhead", "tracing_overhead",
+                            "profiling_overhead"]
+    for key in keys:
+        for m in re.finditer(r'"%s":\s*(\{)' % re.escape(key), tail):
+            obj = _balanced_json_at(tail, m.start(1))
+            if isinstance(obj, dict):
+                out[key] = obj  # last complete occurrence wins
+    return out
+
+
+def load_artifact(path: str) -> Dict[str, Any]:
+    """One BENCH artifact -> its ``extra`` dict (lane objects), surviving
+    a damaged ``parsed: null`` artifact via tail recovery. Accepts a raw
+    bench stdout line (``{"metric": ..., "extra": {...}}``) too."""
+    with open(path) as f:
+        d = json.load(f)
+    if isinstance(d.get("extra"), dict):  # raw bench output line
+        return d["extra"]
+    parsed = d.get("parsed")
+    if isinstance(parsed, dict) and isinstance(parsed.get("extra"), dict):
+        return parsed["extra"]
+    extra = _recover_from_tail(d.get("tail") or "")
+    if not extra:
+        raise ValueError(f"{path}: no parseable lane data (neither "
+                         f"'parsed' nor a recoverable 'tail')")
+    extra["_tail_recovered"] = True  # lanes outside the tail window are gone
+    return extra
+
+
+def _num(d: Any, key: str) -> Optional[float]:
+    if isinstance(d, dict) and isinstance(d.get(key), (int, float)):
+        return float(d[key])
+    return None
+
+
+def _ratio(new: Optional[float], old: Optional[float]) -> Optional[float]:
+    if new is None or old is None or not old:
+        return None
+    return new / old
+
+
+def _fmt_ratio(r: Optional[float]) -> str:
+    return f"{r:.3f}" if r is not None else "n/a"
+
+
+def diff_curve(old: Dict[str, Any], new: Dict[str, Any]
+               ) -> Tuple[List[str], Dict[str, Any]]:
+    """Per-point comparison of a flash-style ``curve``: kernel ratios,
+    control (XLA dense) ratios, and per-point block metadata diffs.
+    Returns (report lines, signals dict for the diagnosis)."""
+    lines: List[str] = []
+    kernel_ratios: Dict[str, float] = {}
+    control_ratios: Dict[str, float] = {}
+    block_changes: Dict[str, Tuple[Any, Any]] = {}
+    oc, nc = old.get("curve") or {}, new.get("curve") or {}
+    for point in sorted(set(oc) & set(nc)):
+        po, pn = oc[point], nc[point]
+        if not (isinstance(po, dict) and isinstance(pn, dict)):
+            continue
+        fr = _ratio(_num(po, "flash_ms"), _num(pn, "flash_ms"))  # old/new ms
+        xr = _ratio(_num(po, "xla_ms"), _num(pn, "xla_ms"))
+        if fr is not None:
+            kernel_ratios[point] = fr
+        if xr is not None:
+            control_ratios[point] = xr
+        parts = [f"flash {_num(po, 'flash_ms')} -> {_num(pn, 'flash_ms')} ms"
+                 f" (x{_fmt_ratio(fr)})"]
+        if xr is not None:
+            parts.append(f"xla control x{_fmt_ratio(xr)}")
+        bo, bn = po.get("blocks"), pn.get("blocks")
+        if bo is not None or bn is not None:
+            if bo != bn:
+                block_changes[point] = (bo, bn)
+                parts.append(f"blocks {bo} -> {bn}  <-- CHANGED")
+            else:
+                parts.append(f"blocks {bn}")
+        cwo, cwn = _num(po, "compile_warm_s"), _num(pn, "compile_warm_s")
+        if cwo is not None and cwn is not None:
+            parts.append(f"compile+warm {cwo:.2f}s -> {cwn:.2f}s")
+        lines.append(f"    {point:<12} " + ", ".join(parts))
+    return lines, {"kernel": kernel_ratios, "control": control_ratios,
+                   "blocks": block_changes,
+                   "blocks_stamped": any("blocks" in p
+                                         for p in list(oc.values())
+                                         + list(nc.values())
+                                         if isinstance(p, dict))}
+
+
+def diagnose_lane(name: str, old: Dict[str, Any], new: Dict[str, Any],
+                  prov_old: Dict[str, Any], prov_new: Dict[str, Any]
+                  ) -> List[str]:
+    """The written diagnosis: compile-vs-execute, then metadata, then the
+    control-lane inference, each stated only as strongly as the artifacts
+    support."""
+    out: List[str] = []
+
+    # compile vs execute
+    cwo, cwn = _num(old, "compile_warm_s"), _num(new, "compile_warm_s")
+    if cwo is not None and cwn is not None:
+        moved = cwn / cwo if cwo else None
+        if moved is not None and (moved > 1.25 or moved < 0.8):
+            out.append(f"compile-vs-execute: compile+warm moved "
+                       f"{cwo:.2f}s -> {cwn:.2f}s (x{moved:.2f}) — a "
+                       f"COMPILE-side change on top of any execute delta.")
+        else:
+            out.append("compile-vs-execute: compile+warm is flat "
+                       f"({cwo:.2f}s -> {cwn:.2f}s); the timed region is "
+                       "warm, so the regression is on the EXECUTE side.")
+    else:
+        out.append("compile-vs-execute: the timed region is warm by "
+                   "construction, so the delta is on the EXECUTE side; "
+                   "compile_warm_s is absent from the artifact(s) "
+                   "(pre-provenance round), so a compile-time shift "
+                   "cannot be cross-checked from the artifacts alone.")
+
+    # metadata: operand mode + blocks + toolchain
+    om_o = old.get("operand_mode") or (prov_old or {}).get("operand_mode")
+    om_n = new.get("operand_mode") or (prov_new or {}).get("operand_mode")
+    if om_o and om_n and om_o != om_n:
+        out.append(f"metadata: operand-passing mode changed "
+                   f"{om_o!r} -> {om_n!r} — a HARNESS confound, not a "
+                   f"kernel change.")
+    elif not (om_o and om_n):
+        out.append("metadata: operand-passing mode is not stamped in the "
+                   "older artifact (pre-provenance round) — the known "
+                   "r4->r5 harness change (operands closed-over -> "
+                   "jit-args) is exactly the kind of confound this field "
+                   "now records.")
+    for field in ("jax", "jaxlib", "device_kind"):
+        vo = (prov_old or {}).get(field)
+        vn = (prov_new or {}).get(field)
+        if vo and vn and vo != vn:
+            out.append(f"metadata: {field} changed {vo} -> {vn}.")
+
+    # curve-level signals
+    if "curve" in old or "curve" in new:
+        _, sig = diff_curve(old, new)
+        kr, cr = sig["kernel"], sig["control"]
+        if sig["blocks"]:
+            pts = ", ".join(f"{p}: {a} -> {b}"
+                            for p, (a, b) in sorted(sig["blocks"].items()))
+            out.append(f"metadata: auto-picked blocks changed at {pts} — "
+                       f"block-size attribution applies at those points.")
+        elif not sig["blocks_stamped"]:
+            out.append("metadata: block sizes are not stamped in these "
+                       "artifacts (pre-provenance rounds), so the "
+                       "block-pick confound cannot be ruled in or out "
+                       "from the artifacts alone.")
+        if kr:
+            worst = min(kr.values())
+            best = max(kr.values())
+            uniform = best - worst < 0.15
+            shape = ("uniform across the curve"
+                     if uniform else "point-local")
+            out.append(f"curve: kernel slowdown is {shape} "
+                       f"(x{worst:.2f}..x{best:.2f} old/new speed).")
+            if cr:
+                moved = [p for p, r in cr.items() if r < 0.9]
+                flat = [p for p, r in cr.items() if r >= 0.9]
+                if moved and not flat:
+                    out.append("control: the XLA dense baseline regressed "
+                               "at every shared point too — implicates the "
+                               "shared HARNESS or environment, not the "
+                               "flash kernel or its block picks.")
+                elif moved:
+                    out.append(f"control: the XLA dense baseline also "
+                               f"regressed at {', '.join(sorted(moved))} "
+                               f"but held at {', '.join(sorted(flat))} — a "
+                               f"MIXED control signal: part of the delta "
+                               f"is harness/environment-side, and the "
+                               f"kernel-side remainder cannot be separated "
+                               f"without the block/operand provenance "
+                               f"above.")
+                else:
+                    out.append("control: the XLA dense baseline is flat at "
+                               "the shared points — the regression is "
+                               "specific to the flash kernel (blocks / "
+                               "kernel code), not the harness.")
+    return out
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="attribute per-lane deltas between two bench artifacts")
+    ap.add_argument("old", help="baseline BENCH_r*.json (or raw bench line)")
+    ap.add_argument("new", help="candidate BENCH_r*.json (or raw bench line)")
+    ap.add_argument("--threshold", type=float, default=0.95,
+                    help="flag lanes whose new/old ratio falls below this "
+                         "(default 0.95, the ratchet threshold)")
+    ap.add_argument("--all", action="store_true",
+                    help="show every lane's curve detail, not just "
+                         "regressed ones")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the comparison as JSON instead of text")
+    args = ap.parse_args(argv)
+
+    old, new = load_artifact(args.old), load_artifact(args.new)
+    prov_old = old.get("provenance") or {}
+    prov_new = new.get("provenance") or {}
+
+    lanes: List[Dict[str, Any]] = []
+    for lane, metric in PRIMARY.items():
+        vo, vn = _num(old.get(lane), metric), _num(new.get(lane), metric)
+        if vo is None and vn is None:
+            continue
+        r = _ratio(vn, vo)
+        status = ("only-in-one" if r is None
+                  else "REGRESSED" if r < args.threshold
+                  else "improved" if r > 1.0 / args.threshold
+                  else "flat")
+        lanes.append({"lane": lane, "metric": metric, "old": vo, "new": vn,
+                      "ratio": r, "status": status})
+
+    regressed = [ln for ln in lanes if ln["status"] == "REGRESSED"]
+
+    if args.json:
+        payload = {"threshold": args.threshold, "lanes": lanes,
+                   "diagnosis": {
+                       ln["lane"]: diagnose_lane(
+                           ln["lane"], old.get(ln["lane"]) or {},
+                           new.get(ln["lane"]) or {}, prov_old, prov_new)
+                       for ln in regressed}}
+        json.dump(payload, sys.stdout, indent=2)
+        print()
+        return 1 if regressed else 0
+
+    print(f"perf diff: {args.old} -> {args.new} "
+          f"(threshold {args.threshold})")
+    for label, ex in (("old", old), ("new", new)):
+        if ex.get("_tail_recovered"):
+            print(f"  note: the {label} artifact was damaged (parsed: null) "
+                  f"— lanes recovered from its tail window only; missing "
+                  f"lanes show as only-in-one")
+    if prov_old or prov_new:
+        for field in ("jax", "jaxlib", "backend", "device_kind",
+                      "operand_mode"):
+            vo, vn = prov_old.get(field), prov_new.get(field)
+            if vo or vn:
+                mark = "  <-- CHANGED" if (vo and vn and vo != vn) else ""
+                print(f"  provenance {field}: {vo} -> {vn}{mark}")
+    print()
+    for ln in lanes:
+        r = ln["ratio"]
+        print(f"  {ln['lane']:<24} {ln['metric']:<28} "
+              f"{ln['old']} -> {ln['new']}  x{_fmt_ratio(r)}"
+              f"  [{ln['status']}]")
+    for ln in lanes:
+        if ln["status"] != "REGRESSED" and not args.all:
+            continue
+        lo, n = old.get(ln["lane"]) or {}, new.get(ln["lane"]) or {}
+        curve_lines, _ = diff_curve(lo, n)
+        diag = (diagnose_lane(ln["lane"], lo, n, prov_old, prov_new)
+                if ln["status"] == "REGRESSED" else [])
+        if not curve_lines and not diag:
+            continue
+        print(f"\n  == {ln['lane']} ==")
+        for line in curve_lines:
+            print(line)
+        for d in diag:
+            print(f"    * {d}")
+    if regressed:
+        names = ", ".join(ln["lane"] for ln in regressed)
+        print(f"\n{len(regressed)} lane(s) below threshold: {names}")
+        return 1
+    print("\nno lane below threshold")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,107 @@
+#!/usr/bin/env python
+"""Chrome-trace / Perfetto timeline export for the ``/traces`` flight
+recorder.
+
+Reads a live endpoint or a saved ``/traces`` JSON payload and renders the
+span streams as Chrome trace JSON (open the output in Perfetto or
+``chrome://tracing``). Pointed at a routing front door it exports the
+STITCHED fleet view: the router and every worker process appear as
+separate ``pid`` tracks on one wall-clock axis, each trace on its own
+row — the cross-process waterfall ``trace_dump.py`` draws in ASCII,
+rendered by a real trace viewer instead:
+
+    python tools/perf_timeline.py http://127.0.0.1:8888 -o timeline.json
+    python tools/perf_timeline.py captured_traces.json            # stdout
+    python tools/perf_timeline.py fleet.json --events events.json
+
+``--events`` merges a saved telemetry event stream
+(``core.telemetry.drain_events()`` dumped as JSON) as instant events —
+XLA-compile events from the profiling subsystem land on the trace rows
+they belong to, so a compile spike is visible in the same timeline as the
+request that paid for it.
+
+Live servers also answer ``GET /timeline`` with the same rendering; this
+tool is for saved payloads and for pulling a timeline without knowing the
+endpoint layout. Import-hygiene-gated (``tests/test_import_hygiene.py``):
+it must run jax-free — pointing it at a production fleet must never drag
+jax into the process doing the looking.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:  # tools/ is not a package; find synapseml_tpu
+    sys.path.insert(0, _REPO)
+
+from synapseml_tpu.observability.profiling import render_chrome_trace  # noqa: E402
+
+
+def load_payload(source: str, timeout: float = 10.0) -> dict:
+    """``/traces`` payload from a URL (``/traces`` appended when the path
+    doesn't already end there) or a local JSON file. A saved file may be
+    either a ``/traces`` payload or an already-rendered Chrome trace (the
+    latter passes through untouched)."""
+    if source.startswith("http://") or source.startswith("https://"):
+        import urllib.request
+
+        url = source
+        if not url.rstrip("/").endswith("/traces"):
+            url = url.rstrip("/") + "/traces"
+        with urllib.request.urlopen(url, timeout=timeout) as r:
+            return json.loads(r.read().decode())
+    with open(source) as f:
+        return json.load(f)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="render /traces payloads as Chrome-trace/Perfetto JSON")
+    ap.add_argument("source", help="endpoint URL (…/traces implied) or a "
+                                   "saved /traces JSON file")
+    ap.add_argument("-o", "--output", default=None,
+                    help="write the Chrome trace here (default: stdout)")
+    ap.add_argument("--events", default=None,
+                    help="saved telemetry events JSON (a list of "
+                         "drain_events() dicts) to merge as instant events")
+    ap.add_argument("--trace", default=None,
+                    help="only this trace id (prefix match)")
+    args = ap.parse_args(argv)
+
+    payload = load_payload(args.source)
+    if "traceEvents" in payload and "traces" not in payload:
+        rendered = payload  # already a Chrome trace: pass through
+    else:
+        if args.trace:
+            payload = dict(payload)
+            payload["traces"] = [
+                t for t in (payload.get("traces") or [])
+                if str(t.get("trace_id", "")).startswith(args.trace)]
+        events = None
+        if args.events:
+            with open(args.events) as f:
+                events = json.load(f)
+            if isinstance(events, dict):  # tolerate {"events": [...]} dumps
+                events = events.get("events") or []
+        rendered = render_chrome_trace(payload, events)
+
+    n = len(rendered.get("traceEvents") or [])
+    if args.output:
+        with open(args.output, "w") as f:
+            json.dump(rendered, f)
+        pids = {e.get("pid") for e in rendered.get("traceEvents") or []
+                if e.get("ph") != "M"}
+        print(f"wrote {n} events across {len(pids)} process track(s) "
+              f"to {args.output}")
+    else:
+        json.dump(rendered, sys.stdout)
+        print()
+    return 0 if n else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
