@@ -8,6 +8,14 @@ Output formats:
   machine consumers.
 - ``github``: ``::error file=...,line=...`` workflow annotations so CI
   failures are clickable at the offending line in the PR diff.
+- ``sarif``: SARIF 2.1.0 — what GitHub code scanning ingests
+  (``upload-sarif``), so findings land in the repo's Security tab with
+  rule metadata attached.
+
+``--device`` additionally runs the jaxpr-level device pack (SMT1xx,
+``rules_device``) over its canonical entry points — the ONLY mode that
+imports jax; the default run stays jax-free (enforced by
+``tests/test_import_hygiene.py``).
 
 Exit codes: 0 clean (waived findings allowed), 1 unwaived findings or
 unparseable files, 2 configuration errors (unknown rule, reasonless
@@ -41,6 +49,7 @@ def _default_paths() -> List[str]:
 
 def _rule_listing() -> str:
     from . import rules as _rules  # noqa: F401 — populate the registry
+    from . import rules_device as _rd  # noqa: F401 — SMT1xx codes
 
     lines = []
     for code in sorted(RULES):
@@ -95,6 +104,63 @@ def render_github(report: dict, out) -> None:
         print(f"::error::{_github_escape(e)}", file=out)
 
 
+def render_sarif(report: dict, out) -> None:
+    """SARIF 2.1.0 (the GitHub code-scanning upload schema): one run, one
+    driver, one ``results`` entry per unwaived finding, waived findings
+    carried as suppressed results so the security tab shows the reviewed
+    decision instead of losing it."""
+    rules = [{
+        "id": code,
+        "name": RULES[code].name,
+        "shortDescription": {"text": RULES[code].name},
+        "fullDescription": {"text": RULES[code].rationale},
+        "defaultConfiguration": {"level": "error"},
+    } for code in sorted({f.code for f in
+                          report["findings"] + report["waived"]} |
+                         set(report["codes"]))]
+
+    def result(f: Finding, suppressed: bool) -> dict:
+        r = {
+            "ruleId": f.code,
+            "level": "error",
+            "message": {"text": f.message},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {"uri": f.path,
+                                         "uriBaseId": "SRCROOT"},
+                    "region": {"startLine": f.line, "startColumn": f.col},
+                },
+            }],
+        }
+        if suppressed:
+            r["suppressions"] = [{"kind": "external",
+                                  "justification": "waived in LINT_ACKS.md"}]
+        return r
+
+    json.dump({
+        "$schema": "https://raw.githubusercontent.com/oasis-tcs/sarif-spec/"
+                   "master/Schemata/sarif-schema-2.1.0.json",
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "synapseml_tpu-lint",
+                "informationUri":
+                    "https://github.com/synapseml_tpu/docs/analysis.md",
+                "rules": rules,
+            }},
+            "results": ([result(f, False) for f in report["findings"]]
+                        + [result(f, True) for f in report["waived"]]),
+            "invocations": [{
+                "executionSuccessful": not report["errors"],
+                "toolExecutionNotifications": [
+                    {"level": "error", "message": {"text": e}}
+                    for e in report["errors"]],
+            }],
+        }],
+    }, out, indent=2)
+    out.write("\n")
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m synapseml_tpu.analysis",
@@ -102,10 +168,13 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("paths", nargs="*", default=None,
                     help=f"files/directories to lint "
                          f"(default: {' '.join(DEFAULT_PATHS)})")
-    ap.add_argument("--format", choices=["text", "json", "github"],
+    ap.add_argument("--format", choices=["text", "json", "github", "sarif"],
                     default="text")
     ap.add_argument("--select", default=None,
                     help="comma-separated rule codes (default: all)")
+    ap.add_argument("--device", action="store_true",
+                    help="also run the jaxpr-level device pack (SMT1xx) "
+                         "over its canonical entry points; imports jax")
     ap.add_argument("--acks", default=None,
                     help="waiver file (default: LINT_ACKS.md found walking "
                          "up from the first path)")
@@ -124,12 +193,13 @@ def main(argv: Optional[List[str]] = None) -> int:
     t0 = time.perf_counter()
     try:
         report = analyze_paths(paths, select=select, acks_path=args.acks,
-                               use_acks=not args.no_acks)
+                               use_acks=not args.no_acks,
+                               device=args.device)
     except (LintConfigError, FileNotFoundError) as e:
         print(f"error: {e}", file=sys.stderr)
         return 2
-    {"text": render_text, "json": render_json,
-     "github": render_github}[args.format](report, sys.stdout)
+    {"text": render_text, "json": render_json, "github": render_github,
+     "sarif": render_sarif}[args.format](report, sys.stdout)
     if args.format == "text":
         print(f"({time.perf_counter() - t0:.2f}s)", file=sys.stderr)
     return 1 if (report["findings"] or report["errors"]) else 0

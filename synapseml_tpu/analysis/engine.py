@@ -91,7 +91,12 @@ class Module:
 
 class Rule:
     """One named invariant. Subclasses set ``code``/``name``/``rationale``
-    and implement :meth:`check` yielding findings for one module."""
+    and implement :meth:`check` yielding findings for one module.
+
+    Cross-module rules (e.g. SMT009 duplicate stage names) use the
+    :meth:`begin`/:meth:`finalize` hooks: ``begin()`` resets per-run state
+    before the file loop, ``check()`` accumulates, ``finalize()`` yields
+    the findings that only exist relative to the whole scanned set."""
 
     code: str = ""
     name: str = ""
@@ -99,6 +104,13 @@ class Rule:
 
     def check(self, module: Module) -> Iterable[Finding]:  # pragma: no cover
         raise NotImplementedError
+
+    def begin(self) -> None:
+        """Reset cross-module state at the start of an analyze run."""
+
+    def finalize(self) -> Iterable[Finding]:
+        """Findings computable only after every module was seen."""
+        return []
 
     def finding(self, module: Module, node: ast.AST, message: str) -> Finding:
         return Finding(path=module.rel,
@@ -353,21 +365,42 @@ def analyze_paths(paths: Sequence[str],
                   select: Optional[Sequence[str]] = None,
                   acks_path: Optional[str] = None,
                   use_acks: bool = True,
-                  root: Optional[str] = None) -> Dict[str, object]:
+                  root: Optional[str] = None,
+                  device: bool = False,
+                  device_entries: Optional[Sequence[object]] = None
+                  ) -> Dict[str, object]:
     """Run the (selected) rule pack over ``paths``.
+
+    ``device=True`` additionally runs the jaxpr-level device pack
+    (``rules_device``, SMT1xx) over its canonical entry points — the only
+    mode that imports jax; the default AST run never does.
 
     Returns a report dict: ``findings`` (unwaived), ``waived``,
     ``unused_waivers``, ``errors`` (unparseable files), ``n_files``.
     """
-    # rules register on import of the sibling module; import here so the
-    # engine is usable standalone in tests with a hand-built registry
+    # rules register on import of the sibling modules; import here so the
+    # engine is usable standalone in tests with a hand-built registry.
+    # rules_device registers its SMT1xx codes (for --select/--list-rules)
+    # but stays inert — and jax-free — unless device=True.
     from . import rules as _rules  # noqa: F401
+    from . import rules_device as _rules_device  # noqa: F401
 
     codes = sorted(RULES) if not select else sorted(select)
     unknown = [c for c in codes if c not in RULES]
     if unknown:
         raise LintConfigError(f"unknown rule code(s): {', '.join(unknown)}; "
                               f"known: {', '.join(sorted(RULES))}")
+    if select and not device:
+        # an explicitly selected device rule can only fire under --device;
+        # running it without the flag would print "0 findings" forever —
+        # a permanently-green gate is worse than a config error
+        dev_selected = [c for c in codes
+                        if c in _rules_device.DEVICE_RULES]
+        if dev_selected and len(dev_selected) == len(codes):
+            raise LintConfigError(
+                f"rule(s) {', '.join(dev_selected)} are device rules "
+                f"(jaxpr-level) and require --device to run; without it "
+                f"this selection can never produce a finding")
     if use_acks and acks_path is None:
         acks_path = default_acks_path(list(paths))
     if root is None and use_acks and acks_path is not None:
@@ -379,6 +412,8 @@ def analyze_paths(paths: Sequence[str],
     findings: List[Finding] = []
     errors: List[str] = []
     files = iter_python_files(paths, root=root)
+    for code in codes:
+        RULES[code].begin()
     for path, rel in files:
         try:
             module = Module.parse(path, rel)
@@ -387,6 +422,13 @@ def analyze_paths(paths: Sequence[str],
             continue
         for code in codes:
             findings.extend(RULES[code].check(module))
+    for code in codes:
+        findings.extend(RULES[code].finalize())
+    if device:
+        dev_findings, dev_errors = _rules_device.run_device_pack(
+            entries=device_entries, select=codes, root=root)
+        findings.extend(dev_findings)
+        errors.extend(dev_errors)
     findings.sort()
     waivers: List[Waiver] = []
     if use_acks and acks_path is not None:

@@ -282,6 +282,45 @@ _STAGE_BASES = {"PipelineStage", "Transformer", "Estimator", "Model",
 _STAGE_SUFFIXES = ("Transformer", "Estimator", "Model", "Stage")
 
 
+def _registered_stage_classes(module: Module) -> List[ast.ClassDef]:
+    """ClassDefs that would auto-register in ``STAGE_REGISTRY``: inherit a
+    stage base (local subclass chains resolved, name-suffix heuristic for
+    imported bases), not ``_``-prefixed, no ``_abstract_stage = True`` in
+    their own body. Shared by SMT005 and SMT009 so the two rules cannot
+    drift on what "registered" means."""
+    local_bases: Dict[str, Set[str]] = {}
+    classes = [n for n in ast.walk(module.tree)
+               if isinstance(n, ast.ClassDef)]
+    for cls in classes:
+        local_bases[cls.name] = {
+            dn.split(".")[-1] for dn in
+            (dotted_name(b) for b in cls.bases) if dn}
+
+    def is_stage_base(name: str, seen: Set[str]) -> bool:
+        if name in _STAGE_BASES or name.endswith(_STAGE_SUFFIXES):
+            return True
+        if name in seen or name not in local_bases:
+            return False
+        seen.add(name)
+        return any(is_stage_base(b, seen) for b in local_bases[name])
+
+    out: List[ast.ClassDef] = []
+    for cls in classes:
+        if cls.name.startswith("_"):
+            continue  # never registered (test/bench-local stages)
+        abstract = any(
+            isinstance(st, ast.Assign)
+            and any(isinstance(t, ast.Name) and t.id == "_abstract_stage"
+                    for t in st.targets)
+            and isinstance(st.value, ast.Constant) and st.value.value
+            for st in cls.body)
+        if abstract:
+            continue
+        if any(is_stage_base(b, set()) for b in local_bases[cls.name]):
+            out.append(cls)
+    return out
+
+
 @register
 class StageOverridesInstrumentedMethod(Rule):
     """SMT005 — a registered ``PipelineStage`` subclass overrides base
@@ -301,40 +340,8 @@ class StageOverridesInstrumentedMethod(Rule):
                  "implement _transform/_fit")
 
     def check(self, module: Module) -> Iterable[Finding]:
-        # local class graph so in-module subclass chains resolve
-        local_bases: Dict[str, Set[str]] = {}
-        classes: List[ast.ClassDef] = [
-            n for n in ast.walk(module.tree) if isinstance(n, ast.ClassDef)]
-        for cls in classes:
-            names = set()
-            for b in cls.bases:
-                dn = dotted_name(b)
-                if dn:
-                    names.add(dn.split(".")[-1])
-            local_bases[cls.name] = names
-
-        def is_stage_base(name: str, seen: Set[str]) -> bool:
-            if name in _STAGE_BASES or name.endswith(_STAGE_SUFFIXES):
-                return True
-            if name in seen or name not in local_bases:
-                return False
-            seen.add(name)
-            return any(is_stage_base(b, seen) for b in local_bases[name])
-
         findings: List[Finding] = []
-        for cls in classes:
-            if cls.name.startswith("_"):
-                continue  # never registered (test/bench-local stages)
-            abstract = any(
-                isinstance(st, ast.Assign)
-                and any(isinstance(t, ast.Name) and t.id == "_abstract_stage"
-                        for t in st.targets)
-                and isinstance(st.value, ast.Constant) and st.value.value
-                for st in cls.body)
-            if abstract:
-                continue
-            if not any(is_stage_base(b, set()) for b in local_bases[cls.name]):
-                continue
+        for cls in _registered_stage_classes(module):
             for st in cls.body:
                 if (isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef))
                         and st.name in ("transform", "fit")):
@@ -591,6 +598,61 @@ class BlockingWorkUnderLock(Rule):
                     f"work outside the critical section"))
 
         walk_scoped(module.tree, visit)
+        return findings
+
+
+@register
+class DuplicateStageName(Rule):
+    """SMT009 — the same stage class name registered from two modules.
+
+    ``STAGE_REGISTRY`` (and therefore ``load_stage``) is keyed by CLASS
+    NAME: when two modules define a registered stage with the same name,
+    whichever imports later silently wins, and a saved pipeline can load
+    the WRONG class depending on import order. The runtime path only
+    logged a warning (``core/stage.py register_stage``) — swallowed in
+    production. This rule promotes it to a CI-failing finding: one
+    diagnostic per defining site, each naming the other module(s).
+
+    Detection reuses SMT005's registration heuristics: classes inheriting
+    a stage base, not ``_``-prefixed, without ``_abstract_stage = True``
+    in their own body.
+    """
+
+    code = "SMT009"
+    name = "duplicate-stage-name"
+    rationale = ("STAGE_REGISTRY is keyed by class name; a cross-module "
+                 "collision makes load_stage resolve to whichever module "
+                 "imported last")
+
+    def __init__(self):
+        # name -> [(module rel path, line, col)] — plain tuples only, so a
+        # long-lived process does not pin every scanned module's AST
+        self._sites: Dict[str, List[Tuple[str, int, int]]] = {}
+
+    def begin(self) -> None:
+        self._sites = {}
+
+    def check(self, module: Module) -> Iterable[Finding]:
+        for cls in _registered_stage_classes(module):
+            self._sites.setdefault(cls.name, []).append(
+                (module.rel, cls.lineno, cls.col_offset + 1))
+        return []
+
+    def finalize(self) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        for name, sites in sorted(self._sites.items()):
+            modules = sorted({rel for rel, _, _ in sites})
+            if len(modules) < 2:
+                continue
+            for rel, line, col in sites:
+                others = [m for m in modules if m != rel]
+                findings.append(Finding(
+                    path=rel, line=line, col=col, code=self.code,
+                    message=f"stage class name {name!r} is also registered "
+                            f"from {', '.join(others)}; load_stage resolves "
+                            f"by NAME, so the later import silently shadows "
+                            f"this one — rename one of the classes"))
+        self._sites = {}
         return findings
 
 

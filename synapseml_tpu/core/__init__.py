@@ -1,6 +1,8 @@
 """Core substrate: params, stages, pipelines, columnar tables, persistence, telemetry."""
 
 from .params import ComplexParam, Param, ParamValidators, Params
+from .schema import (ColumnSpec, PipelineSchemaError, SchemaError,
+                     TableSchema)
 from .stage import (
     Estimator,
     Model,
@@ -31,6 +33,10 @@ __all__ = [
     "UnaryTransformer",
     "STAGE_REGISTRY",
     "stage_class",
+    "ColumnSpec",
+    "TableSchema",
+    "SchemaError",
+    "PipelineSchemaError",
     "Table",
     "concat_tables",
     "features_matrix",

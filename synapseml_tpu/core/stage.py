@@ -23,6 +23,7 @@ from typing import Any, Dict, List, Optional, Sequence
 
 from ..observability.spans import stage_span
 from .params import ComplexParam, Param, Params
+from .schema import ColumnSpec, PipelineSchemaError, SchemaError, TableSchema
 from .table import Table
 from .telemetry import log_stage_call
 
@@ -35,6 +36,7 @@ __all__ = [
     "PipelineModel",
     "UnaryTransformer",
     "STAGE_REGISTRY",
+    "STAGE_NAME_COLLISIONS",
     "register_stage",
     "stage_class",
 ]
@@ -42,14 +44,25 @@ __all__ = [
 # name -> class, for save/load + reflection tests (SURVEY.md §4 FuzzingTest).
 STAGE_REGISTRY: Dict[str, type] = {}
 
+# name -> sorted module list, recorded whenever two modules register the same
+# class name. load_stage resolves by NAME, so the later registration shadows
+# the earlier one — lint rule SMT009 fails CI on this; the runtime record is
+# the introspection hook (and keeps the warning actionable).
+STAGE_NAME_COLLISIONS: Dict[str, List[str]] = {}
+
 
 def register_stage(cls):
     prev = STAGE_REGISTRY.get(cls.__name__)
     if prev is not None and prev.__module__ != cls.__module__:
         import logging
 
+        mods = STAGE_NAME_COLLISIONS.setdefault(
+            cls.__name__, [prev.__module__])
+        if cls.__module__ not in mods:
+            mods.append(cls.__module__)
         logging.getLogger("synapseml_tpu").warning(
-            "stage name collision: %s defined in both %s and %s; later wins for load_stage",
+            "stage name collision: %s defined in both %s and %s; later wins "
+            "for load_stage (lint rule SMT009 fails CI on this)",
             cls.__name__, prev.__module__, cls.__module__,
         )
     STAGE_REGISTRY[cls.__name__] = cls
@@ -90,13 +103,71 @@ class PipelineStage(Params):
 
         return load_stage(path)
 
+    # -- static schema contract (SparkML transformSchema analogue) ----------
+
+    def input_schema(self) -> Optional[TableSchema]:
+        """The minimal input schema this stage's ``transform``/``fit``
+        needs, or None when the stage does not declare one. Consumed by
+        :meth:`transform_schema`, ``Pipeline.validate`` and the richer
+        ``_validate_input`` error messages."""
+        return None
+
+    def request_schema(self) -> Optional[TableSchema]:
+        """The JSON-BODY contract a serving request must satisfy, or None.
+
+        Distinct from :meth:`input_schema` on purpose: serving engines
+        feed pipelines a ``{id, request}`` table (the raw HTTP exchange),
+        so a stage that parses request bodies declares its *table* needs
+        as ``{request: object:scalar}`` and its *payload fields* here —
+        admission (``io/serving_v2.py``) answers 400-with-diff from this
+        schema before a request ever occupies a batch slot."""
+        return None
+
+    def transform_schema(self, schema: TableSchema) -> Optional[TableSchema]:
+        """Statically map an input :class:`TableSchema` to the output
+        schema this stage's ``transform`` would produce — no jax, no
+        device work, milliseconds (SparkML ``transformSchema``).
+
+        Raises :class:`SchemaError` when ``schema`` cannot feed this
+        stage. Returns None when the OUTPUT is undeclared (validation
+        degrades to an open schema downstream); the default implementation
+        still checks :meth:`input_schema` requirements when declared."""
+        ins = self.input_schema()
+        if ins is not None:
+            self._check_schema(schema, ins)
+        return None
+
+    def fit_schema(self, schema: TableSchema) -> Optional[TableSchema]:
+        """Static schema of ``fit(table).transform(table)`` — what a
+        pipeline position occupied by this estimator contributes. Defaults
+        to :meth:`transform_schema` (estimators declare the fitted model's
+        mapping there)."""
+        return self.transform_schema(schema)
+
+    def _check_schema(self, schema: TableSchema,
+                      needed) -> None:
+        """``schema.require(needed)`` with this stage's name attached."""
+        schema.require(needed, stage=f"{type(self).__name__}({self.uid})")
+
     def _validate_input(self, table: Table, *needed_cols: str) -> None:
-        for c in needed_cols:
-            if c not in table:
-                raise ValueError(
-                    f"{type(self).__name__}({self.uid}): input is missing column {c!r}; "
-                    f"available: {table.column_names}"
-                )
+        missing = [c for c in needed_cols if c not in table]
+        if not missing:
+            return
+        from .schema import nearest_name
+
+        parts = []
+        for c in missing:
+            sug = nearest_name(c, table.column_names)
+            parts.append(f"{c!r}" + (f" (did you mean {sug!r}?)" if sug
+                                     else ""))
+        msg = (f"{type(self).__name__}({self.uid}): input is missing "
+               f"column{'s' if len(missing) > 1 else ''} "
+               + ", ".join(parts)
+               + f"; available: {table.column_names}")
+        ins = self.input_schema()
+        if ins is not None:
+            msg += f"; declared input schema: {ins.describe()}"
+        raise ValueError(msg)
 
 
 class Transformer(PipelineStage):
@@ -143,12 +214,33 @@ class Model(Transformer):
 
 
 class UnaryTransformer(Transformer):
-    """Convenience: input column -> output column transformers."""
+    """Convenience: input column -> output column transformers.
+
+    The schema contract is DERIVED automatically: the input schema is
+    ``{input_col: any}`` and ``transform_schema`` adds ``output_col`` with
+    :meth:`_output_col_spec`'s spec (default wildcard — subclasses narrow
+    it by overriding the method or the ``output_spec`` class attribute)."""
 
     _abstract_stage = True
 
     input_col = Param("input column name", str, default="input")
     output_col = Param("output column name", str, default="output")
+
+    # subclasses may pin the produced column's spec ("float:vector", ...)
+    output_spec: Any = None
+
+    def input_schema(self) -> Optional[TableSchema]:
+        return TableSchema({self.input_col: ColumnSpec()})
+
+    def transform_schema(self, schema: TableSchema) -> Optional[TableSchema]:
+        self._check_schema(schema, self.input_schema())
+        spec = self._output_col_spec(schema.get(self.input_col))
+        return schema.with_column(self.output_col, spec)
+
+    def _output_col_spec(self, input_spec: Optional[ColumnSpec]) -> ColumnSpec:
+        if self.output_spec is not None:
+            return ColumnSpec.parse(self.output_spec)
+        return ColumnSpec()
 
     def _transform(self, table: Table) -> Table:
         self._validate_input(table, self.input_col)
@@ -159,11 +251,42 @@ class UnaryTransformer(Transformer):
         raise NotImplementedError
 
 
+def _validate_stage_chain(owner, stages: Sequence[PipelineStage],
+                          schema_or_table, fitting: bool) -> TableSchema:
+    """Thread a schema through ``stages`` statically (no jax, no device
+    work). Raises ONE :class:`PipelineSchemaError` naming the first broken
+    stage; stages without a declaration turn the running schema into an
+    open schema (downstream checks degrade gracefully instead of false-
+    failing). Returns the final (possibly open) output schema."""
+    if isinstance(schema_or_table, Table):
+        schema = TableSchema.from_table(schema_or_table)
+    elif isinstance(schema_or_table, TableSchema):
+        schema = schema_or_table
+    else:
+        schema = TableSchema(schema_or_table)
+    for i, st in enumerate(stages):
+        mapper = (st.fit_schema if fitting and isinstance(st, Estimator)
+                  else st.transform_schema)
+        try:
+            out = mapper(schema)
+        except SchemaError as e:
+            raise PipelineSchemaError(
+                f"{type(owner).__name__}({owner.uid}) is statically invalid "
+                f"at stage {i} ({type(st).__name__}({st.uid})): {e}",
+                stage_index=i, stage=st, cause=e) from None
+        schema = out if out is not None else TableSchema.open_schema()
+    return schema
+
+
 class Pipeline(Estimator):
     """Sequential composition of stages (reference: SparkML ``Pipeline``).
 
     ``fit`` threads the table through: estimators are fitted and replaced by their
     models (which then transform the running table); transformers transform directly.
+
+    :meth:`validate` is the plan-time gate (SparkML ``transformSchema``
+    threading): a mis-wired pipeline fails in milliseconds with the first
+    broken stage named, before any stage burns device time.
     """
 
     stages = ComplexParam("list of pipeline stages", list, default=[])
@@ -172,6 +295,27 @@ class Pipeline(Estimator):
         super().__init__(uid=uid, **kw)
         if stages is not None:
             self.set("stages", list(stages))
+
+    def input_schema(self) -> Optional[TableSchema]:
+        st = list(self.stages)
+        return st[0].input_schema() if st else None
+
+    def request_schema(self) -> Optional[TableSchema]:
+        st = list(self.stages)
+        return st[0].request_schema() if st else None
+
+    def validate(self, schema_or_table) -> TableSchema:
+        """Statically thread a :class:`TableSchema` (or a live Table, or a
+        plain ``{name: "dtype:role"}`` mapping) through every stage.
+        Raises :class:`PipelineSchemaError` naming the first broken stage;
+        returns the pipeline's declared output schema."""
+        return _validate_stage_chain(self, list(self.stages),
+                                     schema_or_table, fitting=True)
+
+    def fit_schema(self, schema: TableSchema) -> Optional[TableSchema]:
+        # nested pipelines validate like top-level ones
+        return _validate_stage_chain(self, list(self.stages), schema,
+                                     fitting=True)
 
     def _fit(self, table: Table) -> "PipelineModel":
         stages = list(self.stages)
@@ -207,6 +351,24 @@ class PipelineModel(Model):
         super().__init__(uid=uid, **kw)
         if stages is not None:
             self.set("stages", list(stages))
+
+    def input_schema(self) -> Optional[TableSchema]:
+        st = list(self.stages)
+        return st[0].input_schema() if st else None
+
+    def request_schema(self) -> Optional[TableSchema]:
+        st = list(self.stages)
+        return st[0].request_schema() if st else None
+
+    def validate(self, schema_or_table) -> TableSchema:
+        """Static schema threading over the FITTED stages (see
+        ``Pipeline.validate``)."""
+        return _validate_stage_chain(self, list(self.stages),
+                                     schema_or_table, fitting=False)
+
+    def transform_schema(self, schema: TableSchema) -> Optional[TableSchema]:
+        return _validate_stage_chain(self, list(self.stages), schema,
+                                     fitting=False)
 
     def _transform(self, table: Table) -> Table:
         cur = table

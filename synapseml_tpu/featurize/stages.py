@@ -12,7 +12,8 @@ from typing import Any, Dict, List, Optional
 
 import numpy as np
 
-from ..core import ComplexParam, Estimator, Model, Param, Table, Transformer
+from ..core import (ColumnSpec, ComplexParam, Estimator, Model, Param, Table,
+                    TableSchema, Transformer)
 from ..core.params import ParamValidators
 
 __all__ = [
@@ -21,6 +22,19 @@ __all__ = [
     "DataConversion", "CountSelector", "CountSelectorModel",
     "Featurize", "FeaturizeModel",
 ]
+
+
+def _clean_missing_schema(stage, schema: TableSchema) -> TableSchema:
+    """Shared CleanMissingData(+Model) schema map. Inputs accept ANY
+    scalar column — the stage's documented job is cleaning dirty data,
+    including object columns holding None (np.asarray maps them to nan);
+    a float-only input spec would statically reject exactly the input the
+    stage exists to clean. Outputs are always float64 scalars."""
+    stage._check_schema(schema, {c: ColumnSpec("any", "scalar")
+                                 for c in stage.input_cols})
+    outs = list(stage.output_cols) or list(stage.input_cols)
+    return schema.with_columns({o: ColumnSpec("float", "scalar")
+                                for o in outs})
 
 
 class CleanMissingData(Estimator):
@@ -32,6 +46,14 @@ class CleanMissingData(Estimator):
     cleaning_mode = Param("Mean | Median | Custom", str, default="Mean",
                           validator=ParamValidators.in_list(["Mean", "Median", "Custom"]))
     custom_value = Param("fill value for Custom mode", float, default=0.0)
+
+    def input_schema(self):
+        # "any": dirty object columns (None/NaN mixes) are this stage's job
+        return TableSchema({c: ColumnSpec("any", "scalar")
+                            for c in self.input_cols})
+
+    def transform_schema(self, schema):
+        return _clean_missing_schema(self, schema)
 
     def _fit(self, table: Table) -> "CleanMissingDataModel":
         self._validate_input(table, *self.input_cols)
@@ -56,6 +78,14 @@ class CleanMissingDataModel(Model):
     output_cols = Param("output columns", list, default=[])
     fill_values = ComplexParam("column -> fill value", dict, default={})
 
+    def input_schema(self):
+        # "any": dirty object columns (None/NaN mixes) are this stage's job
+        return TableSchema({c: ColumnSpec("any", "scalar")
+                            for c in self.input_cols})
+
+    def transform_schema(self, schema):
+        return _clean_missing_schema(self, schema)
+
     def _transform(self, table: Table) -> Table:
         self._validate_input(table, *self.input_cols)
         out = table
@@ -72,6 +102,14 @@ class ValueIndexer(Estimator):
     input_col = Param("column to index", str, default="input")
     output_col = Param("indexed output column", str, default="output")
 
+    def input_schema(self):
+        return TableSchema({self.input_col: ColumnSpec("any", "scalar")})
+
+    def transform_schema(self, schema):
+        self._check_schema(schema, self.input_schema())
+        return schema.with_column(self.output_col,
+                                  ColumnSpec("int", "scalar"))
+
     def _fit(self, table: Table) -> "ValueIndexerModel":
         self._validate_input(table, self.input_col)
         vals = table[self.input_col]
@@ -86,6 +124,14 @@ class ValueIndexerModel(Model):
     input_col = Param("column to index", str, default="input")
     output_col = Param("indexed output column", str, default="output")
     levels = ComplexParam("index -> value array", object, default=None)
+
+    def input_schema(self):
+        return TableSchema({self.input_col: ColumnSpec("any", "scalar")})
+
+    def transform_schema(self, schema):
+        self._check_schema(schema, self.input_schema())
+        return schema.with_column(self.output_col,
+                                  ColumnSpec("int", "scalar"))
 
     def _transform(self, table: Table) -> Table:
         self._validate_input(table, self.input_col)
@@ -103,6 +149,14 @@ class IndexToValue(Transformer):
     input_col = Param("indexed column", str, default="input")
     output_col = Param("value output column", str, default="output")
     levels = ComplexParam("index -> value array", object, default=None)
+
+    def input_schema(self):
+        return TableSchema({self.input_col: ColumnSpec("int", "scalar")})
+
+    def transform_schema(self, schema):
+        self._check_schema(schema, self.input_schema())
+        return schema.with_column(self.output_col,
+                                  ColumnSpec("object", "scalar"))
 
     def _transform(self, table: Table) -> Table:
         self._validate_input(table, self.input_col)
@@ -130,6 +184,18 @@ class DataConversion(Transformer):
     _DTYPES = {"boolean": np.bool_, "byte": np.int8, "short": np.int16,
                "integer": np.int32, "long": np.int64, "float": np.float32,
                "double": np.float64}
+    _DTYPE_CLASSES = {"boolean": "bool", "byte": "int", "short": "int",
+                      "integer": "int", "long": "int", "float": "float",
+                      "double": "float", "string": "object"}
+
+    def input_schema(self):
+        return TableSchema({c: ColumnSpec("any", "scalar")
+                            for c in self.cols})
+
+    def transform_schema(self, schema):
+        self._check_schema(schema, self.input_schema())
+        target = ColumnSpec(self._DTYPE_CLASSES[self.convert_to], "scalar")
+        return schema.with_columns({c: target for c in self.cols})
 
     def _transform(self, table: Table) -> Table:
         self._validate_input(table, *self.cols)
@@ -152,6 +218,14 @@ class CountSelector(Estimator):
     input_col = Param("vector column", str, default="features")
     output_col = Param("selected output column", str, default="features")
 
+    def input_schema(self):
+        return TableSchema({self.input_col: ColumnSpec("float", "vector")})
+
+    def transform_schema(self, schema):
+        self._check_schema(schema, self.input_schema())
+        return schema.with_column(self.output_col,
+                                  ColumnSpec("float", "vector"))
+
     def _fit(self, table: Table) -> "CountSelectorModel":
         self._validate_input(table, self.input_col)
         x = np.asarray(table[self.input_col], dtype=np.float64)
@@ -164,6 +238,14 @@ class CountSelectorModel(Model):
     input_col = Param("vector column", str, default="features")
     output_col = Param("selected output column", str, default="features")
     indices = ComplexParam("kept slot indices", object, default=None)
+
+    def input_schema(self):
+        return TableSchema({self.input_col: ColumnSpec("float", "vector")})
+
+    def transform_schema(self, schema):
+        self._check_schema(schema, self.input_schema())
+        return schema.with_column(self.output_col,
+                                  ColumnSpec("float", "vector"))
 
     def _transform(self, table: Table) -> Table:
         self._validate_input(table, self.input_col)
@@ -183,6 +265,14 @@ class Featurize(Estimator):
     num_features = Param("hash space for text/high-cardinality columns", int,
                          default=262144)
     max_one_hot = Param("max levels for one-hot before hashing", int, default=64)
+
+    def input_schema(self):
+        return TableSchema({c: ColumnSpec() for c in self.input_cols})
+
+    def transform_schema(self, schema):
+        self._check_schema(schema, self.input_schema())
+        return schema.with_column(self.output_col,
+                                  ColumnSpec("float", "vector"))
 
     def _fit(self, table: Table) -> "FeaturizeModel":
         self._validate_input(table, *self.input_cols)
@@ -213,6 +303,14 @@ class FeaturizeModel(Model):
     input_cols = Param("columns to featurize", list, default=[])
     output_col = Param("assembled vector column", str, default="features")
     plan = ComplexParam("per-column featurization plan", list, default=[])
+
+    def input_schema(self):
+        return TableSchema({c: ColumnSpec() for c in self.input_cols})
+
+    def transform_schema(self, schema):
+        self._check_schema(schema, self.input_schema())
+        return schema.with_column(self.output_col,
+                                  ColumnSpec("float", "vector"))
 
     def _transform(self, table: Table) -> Table:
         from ..native import murmur3_32
@@ -269,6 +367,16 @@ class FastVectorAssembler(Transformer):
 
     input_cols = Param("columns to assemble", list, default=[])
     output_col = Param("assembled vector column", str, default="features")
+
+    def input_schema(self):
+        # numeric scalars or vectors; float accepts int/bool columns
+        return TableSchema({c: ColumnSpec("float", "any")
+                            for c in self.input_cols})
+
+    def transform_schema(self, schema):
+        self._check_schema(schema, self.input_schema())
+        return schema.with_column(self.output_col,
+                                  ColumnSpec("float", "vector"))
 
     def _transform(self, table: Table) -> Table:
         if not self.input_cols:

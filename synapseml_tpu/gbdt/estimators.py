@@ -24,7 +24,8 @@ from typing import List, Optional
 
 import numpy as np
 
-from ..core import ComplexParam, Estimator, Model, Param, Table
+from ..core import (ColumnSpec, ComplexParam, Estimator, Model, Param, Table,
+                    TableSchema)
 from ..core.params import ParamValidators
 from .boost import GBDTBooster, train
 
@@ -157,6 +158,39 @@ class _LightGBMBase(Estimator):
 
     objective = Param("training objective", str, default="regression")
 
+    # -- static schema (SparkML transformSchema analogue) -------------------
+
+    # features: a dense vector column OR a sparse (indices, values) object
+    # column (the VW featurizer's output) — dtype class stays open
+    _FEATURES_SPEC = ColumnSpec("any", "vector")
+
+    def input_schema(self) -> TableSchema:
+        cols = {self.features_col: self._FEATURES_SPEC,
+                self.label_col: ColumnSpec("float", "scalar")}
+        if self.weight_col:
+            cols[self.weight_col] = ColumnSpec("float", "scalar")
+        if self.validation_indicator_col:
+            cols[self.validation_indicator_col] = ColumnSpec("any", "scalar")
+        if self.init_score_col:
+            cols[self.init_score_col] = ColumnSpec("float", "any")
+        return TableSchema(cols)
+
+    def _prediction_schema(self, schema: TableSchema) -> TableSchema:
+        """Columns every fitted model appends (subclasses add theirs)."""
+        out = schema.with_column(self.prediction_col,
+                                 ColumnSpec("float", "scalar"))
+        if self.leaf_prediction_col:
+            out = out.with_column(self.leaf_prediction_col,
+                                  ColumnSpec("float", "vector"))
+        if self.features_shap_col:
+            out = out.with_column(self.features_shap_col,
+                                  ColumnSpec("any", "any"))
+        return out
+
+    def transform_schema(self, schema: TableSchema) -> TableSchema:
+        self._check_schema(schema, self.input_schema())
+        return self._prediction_schema(schema)
+
     def _train_params(self) -> dict:
         return {
             "objective": self.objective,
@@ -283,6 +317,27 @@ class _LightGBMModelBase(Model):
                             int, default=18)
     booster = ComplexParam("trained GBDTBooster", object, default=None)
 
+    def input_schema(self) -> TableSchema:
+        return TableSchema({self.features_col:
+                            _LightGBMBase._FEATURES_SPEC})
+
+    def _prediction_schema(self, schema: TableSchema,
+                           prediction_spec=("float", "scalar")
+                           ) -> TableSchema:
+        out = schema.with_column(self.prediction_col,
+                                 ColumnSpec(*prediction_spec))
+        if self.leaf_prediction_col:
+            out = out.with_column(self.leaf_prediction_col,
+                                  ColumnSpec("float", "vector"))
+        if self.features_shap_col:
+            out = out.with_column(self.features_shap_col,
+                                  ColumnSpec("any", "any"))
+        return out
+
+    def transform_schema(self, schema: TableSchema) -> TableSchema:
+        self._check_schema(schema, self.input_schema())
+        return self._prediction_schema(schema)
+
     def _extra_outputs(self, out: Table, x: np.ndarray) -> Table:
         if self.leaf_prediction_col:
             out = out.with_column(self.leaf_prediction_col,
@@ -352,6 +407,20 @@ class LightGBMClassifier(_LightGBMBase):
     is_unbalance = Param("rescale grad of minority class (reference isUnbalance)",
                          bool, default=False)
 
+    def input_schema(self) -> TableSchema:
+        # classifier labels may be strings/anything unique-able
+        base = super().input_schema()
+        return base.with_column(self.label_col, ColumnSpec("any", "scalar"))
+
+    def _prediction_schema(self, schema: TableSchema) -> TableSchema:
+        out = super()._prediction_schema(schema)
+        # predictions carry the ORIGINAL label values (possibly strings)
+        out = out.with_column(self.prediction_col, ColumnSpec("any", "scalar"))
+        out = out.with_column(self.raw_prediction_col,
+                              ColumnSpec("float", "vector"))
+        return out.with_column(self.probability_col,
+                               ColumnSpec("float", "vector"))
+
     def _fit(self, table: Table) -> "LightGBMClassificationModel":
         self._validate_input(table, self.features_col, self.label_col)
         y_raw = table[self.label_col]
@@ -396,6 +465,15 @@ class LightGBMClassificationModel(_LightGBMModelBase):
     probability_col = Param("probability output column", str, default="probability")
     raw_prediction_col = Param("raw margin output column", str, default="rawPrediction")
     labels = ComplexParam("class label values in index order", object, default=None)
+
+    def transform_schema(self, schema: TableSchema) -> TableSchema:
+        self._check_schema(schema, self.input_schema())
+        out = self._prediction_schema(schema,
+                                      prediction_spec=("any", "scalar"))
+        out = out.with_column(self.raw_prediction_col,
+                              ColumnSpec("float", "vector"))
+        return out.with_column(self.probability_col,
+                               ColumnSpec("float", "vector"))
 
     def _transform(self, table: Table) -> Table:
         self._validate_input(table, self.features_col)
@@ -455,6 +533,11 @@ class LightGBMRanker(_LightGBMBase):
 
     objective = Param("ranking objective", str, default="lambdarank")
     group_col = Param("query/group id column", str, default="group")
+
+    def input_schema(self) -> TableSchema:
+        return super().input_schema().with_column(
+            self.group_col, ColumnSpec("any", "scalar"))
+
     ndcg_at = Param("NDCG truncation for eval", int, default=10)
     lambdarank_truncation_level = Param("pairs beyond this rank are ignored",
                                         int, default=30)

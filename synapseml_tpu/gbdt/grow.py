@@ -267,7 +267,10 @@ def grow_tree(binned, grad, hess, row_weight, feature_mask, cfg: TreeConfig,
                                    cat_mask if has_cat else None)  # (L, d, B)
         per_feat = local_gain.max(-1)                              # (L, d)
         topk_idx = lax.top_k(per_feat, k_local)[1]                 # (L, k)
-        votes = jnp.zeros((L, d)).at[jnp.arange(L)[:, None], topk_idx].add(1.0)
+        # dtype pinned: a bare zeros() is f64 under x64 and the psum /
+        # top_k chain inherits it (device lint SMT101)
+        votes = jnp.zeros((L, d), jnp.float32).at[
+            jnp.arange(L)[:, None], topk_idx].add(1.0)
         votes = lax.psum(votes, axis_name)
         # deterministic global selection on every shard
         sel = lax.top_k(votes, k_global)[1]                        # (L, 2k)
@@ -314,7 +317,10 @@ def grow_tree(binned, grad, hess, row_weight, feature_mask, cfg: TreeConfig,
             leaf_gain = jnp.where(depth < cfg.max_depth, leaf_gain, -jnp.inf)
         l = jnp.argmax(leaf_gain)
         g_best = leaf_gain[l]
-        ok = g_best > jnp.maximum(cfg.min_gain_to_split, 0.0)
+        # both operands are static config floats: a host-side max keeps the
+        # threshold out of the traced program (a traced jnp.maximum of two
+        # python floats is an f64 op under x64 — device lint SMT101)
+        ok = g_best > max(cfg.min_gain_to_split, 0.0)
         f_sel = leaf_f[l]
         b_sel = leaf_b[l]
         in_set, is_cat = split_detail(hists, l, f_sel, b_sel)
@@ -493,7 +499,8 @@ def _grow_tree_sparse(sb, grad, hess, row_weight, feature_mask,
         local_gain = numeric_gain(h2, feature_mask)        # (2, d, B)
         per_feat = local_gain.max(-1)                      # (2, d)
         topk_idx = lax.top_k(per_feat, k_local)[1]         # (2, k)
-        votes = jnp.zeros((2, d)).at[jnp.arange(2)[:, None], topk_idx].add(1.0)
+        votes = jnp.zeros((2, d), jnp.float32).at[
+            jnp.arange(2)[:, None], topk_idx].add(1.0)  # SMT101: pin dtype
         votes = lax.psum(votes, axis_name)
         sel = lax.top_k(votes, k_global)[1]                # (2, 2k)
         cand = jnp.take_along_axis(h2, sel[:, :, None, None], axis=1)
@@ -551,7 +558,10 @@ def _grow_tree_sparse(sb, grad, hess, row_weight, feature_mask,
             leaf_gain = jnp.where(depth < cfg.max_depth, leaf_gain, -jnp.inf)
         l = jnp.argmax(leaf_gain)
         g_best = leaf_gain[l]
-        ok = g_best > jnp.maximum(cfg.min_gain_to_split, 0.0)
+        # both operands are static config floats: a host-side max keeps the
+        # threshold out of the traced program (a traced jnp.maximum of two
+        # python floats is an f64 op under x64 — device lint SMT101)
+        ok = g_best > max(cfg.min_gain_to_split, 0.0)
         f_sel = best_feat[l]
         b_sel = best_bin[l]
         col = sparse_column(sb, f_sel, n)

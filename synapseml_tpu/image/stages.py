@@ -14,7 +14,8 @@ from typing import Any, Dict, List, Optional
 
 import numpy as np
 
-from ..core import Param, Table, Transformer, concat_tables
+from ..core import (ColumnSpec, Param, Table, TableSchema, Transformer,
+                    concat_tables)
 from ..core.params import ParamValidators
 from . import ops as iops
 
@@ -45,6 +46,15 @@ class ImageTransformer(Transformer):
     input_col = Param("input image column", str, default="image")
     output_col = Param("output image column", str, default="image")
     stages = Param("list of image op dicts with 'action' key", list, default=[])
+
+    def input_schema(self):
+        # tensor image columns OR ragged object columns of HWC arrays
+        return TableSchema({self.input_col: ColumnSpec("any", "any")})
+
+    def transform_schema(self, schema):
+        self._check_schema(schema, self.input_schema())
+        return schema.with_column(self.output_col,
+                                  ColumnSpec("any", "image"))
 
     # -- single-stage helpers, batched ------------------------------------------
 
@@ -124,6 +134,14 @@ class ResizeImageTransformer(Transformer):
     height = Param("target height", int, default=224, validator=ParamValidators.gt(0))
     width = Param("target width", int, default=224, validator=ParamValidators.gt(0))
 
+    def input_schema(self):
+        return TableSchema({self.input_col: ColumnSpec("any", "any")})
+
+    def transform_schema(self, schema):
+        self._check_schema(schema, self.input_schema())
+        return schema.with_column(self.output_col,
+                                  ColumnSpec("any", "image"))
+
     def _transform(self, table: Table) -> Table:
         self._validate_input(table, self.input_col)
         col = table[self.input_col]
@@ -146,6 +164,14 @@ class UnrollImage(Transformer):
     input_col = Param("input image column", str, default="image")
     output_col = Param("output vector column", str, default="features")
 
+    def input_schema(self):
+        return TableSchema({self.input_col: ColumnSpec("any", "image")})
+
+    def transform_schema(self, schema):
+        self._check_schema(schema, self.input_schema())
+        return schema.with_column(self.output_col,
+                                  ColumnSpec("float", "vector"))
+
     def _transform(self, table: Table) -> Table:
         self._validate_input(table, self.input_col)
         col = table[self.input_col]
@@ -167,6 +193,16 @@ class ImageSetAugmenter(Transformer):
     output_col = Param("output image column", str, default="image")
     flip_left_right = Param("add horizontal mirrors", bool, default=True)
     flip_up_down = Param("add vertical mirrors", bool, default=False)
+
+    def input_schema(self):
+        return TableSchema({self.input_col: ColumnSpec("any", "image")})
+
+    def transform_schema(self, schema):
+        self._check_schema(schema, self.input_schema())
+        out = schema.with_column(self.output_col, ColumnSpec("any", "image"))
+        if self.output_col != self.input_col:
+            out = out.drop(self.input_col)
+        return out
 
     def _transform(self, table: Table) -> Table:
         self._validate_input(table, self.input_col)
@@ -199,6 +235,15 @@ class UnrollBinaryImage(Transformer):
     width = Param("target width (resize when set)", int, default=None)
     height = Param("target height (resize when set)", int, default=None)
     n_channels = Param("target channel count", int, default=None)
+
+    def input_schema(self):
+        return TableSchema({self.input_col: ColumnSpec("object", "scalar")})
+
+    def transform_schema(self, schema):
+        self._check_schema(schema, self.input_schema())
+        # object column of per-row f32 vectors (None for undecodable rows)
+        return schema.with_column(self.output_col,
+                                  ColumnSpec("float", "vector"))
 
     def _transform(self, table: Table) -> Table:
         from ..io.binary import decode_image

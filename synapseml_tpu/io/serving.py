@@ -76,6 +76,12 @@ class ServingServer:
         self._latencies = deque(maxlen=4096)
         self.requests_received = 0  # JVMSharedServer request counters (:96-105)
         self.responses_sent = 0
+        # admission-time request validation: when an engine installs the
+        # pipeline's declared input schema here, malformed POST bodies are
+        # answered 400 WITH THE SCHEMA DIFF in the handler thread — they
+        # never occupy a batch slot or 500 deep inside a worker pipeline
+        self.admission_schema = None
+        self.admission_rejections = 0
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -99,6 +105,29 @@ class ServingServer:
                     return
                 length = int(self.headers.get("Content-Length") or 0)
                 body = self.rfile.read(length) if length else None
+                if method == "POST" and outer.admission_schema is not None:
+                    errs = admission_errors(outer.admission_schema, body)
+                    if errs:
+                        payload = json.dumps({
+                            "error": "request schema validation failed",
+                            "errors": errs,
+                            "expected_schema":
+                                outer.admission_schema.to_dict(),
+                        }).encode()
+                        with outer._lock:
+                            outer.requests_received += 1
+                            outer.admission_rejections += 1
+                        try:
+                            self.send_response(400)
+                            self.send_header("Content-Type",
+                                             "application/json")
+                            self.send_header("Content-Length",
+                                             str(len(payload)))
+                            self.end_headers()
+                            self.wfile.write(payload)
+                        except OSError:
+                            pass  # client went away
+                        return
                 req = HTTPRequestData(
                     url=self.path, method=method,
                     headers=dict(self.headers.items()), entity=body)
@@ -199,6 +228,10 @@ class ServingServer:
         self._m_latency = reg.histogram(
             "smt_serving_latency_seconds", "enqueue->reply latency",
             ("server",)).labels(self.server_label)
+        self._m_admission_rejects = reg.counter(
+            "smt_serving_admission_rejections_total",
+            "POST bodies answered 400 by schema admission",
+            ("server",)).labels(self.server_label)
         reg.register_collector(self._collect_metrics)
         # device-memory gauges sync at scrape time (graceful no-op until a
         # backend with allocator stats exists): every worker's /metrics
@@ -218,6 +251,7 @@ class ServingServer:
         registry (see the collector note in ``__init__``)."""
         self._m_requests.sync_total(self.requests_received)
         self._m_responses.sync_total(self.responses_sent)
+        self._m_admission_rejects.sync_total(self.admission_rejections)
 
     @property
     def address(self) -> str:
@@ -292,8 +326,54 @@ class ServingServer:
         # retire this server's series + collector: ephemeral ports mean a
         # churning process would otherwise grow the registry without bound
         self._reg.unregister_collector(self._collect_metrics)
-        for series in (self._m_requests, self._m_responses, self._m_latency):
+        for series in (self._m_requests, self._m_responses, self._m_latency,
+                       self._m_admission_rejects):
             series.remove()
+
+
+def admission_errors(schema, body: Optional[bytes]) -> List[str]:
+    """Validate a request body against the pipeline's declared input
+    schema (``core.schema.TableSchema``). Empty list = admit. The body
+    must be a JSON object (one row) or array of objects."""
+    if not body:
+        return [f"empty body; expected a JSON object with fields "
+                f"{schema.columns}"]
+    try:
+        payload = json.loads(body.decode("utf-8"))
+    except (UnicodeDecodeError, json.JSONDecodeError) as e:
+        return [f"body is not valid JSON ({e}); expected an object with "
+                f"fields {schema.columns}"]
+    return schema.validate_json_payload(payload)
+
+
+def resolve_admission_schema(pipeline, admission_schema):
+    """Resolve an engine's ``admission_schema`` knob to a TableSchema (or
+    None = admission off).
+
+    - ``"auto"`` (the default): the pipeline's declared JSON-body
+      contract, ``pipeline.request_schema()`` — the method a serving
+      stage uses to describe its request payload fields (distinct from
+      ``input_schema()``, which describes TABLE columns: the engine feeds
+      ``{id, request}`` tables, so table schemas are not body schemas).
+      Pipelines that don't declare a request schema keep admission off.
+    - a ``TableSchema`` or ``{name: "dtype:role"}`` dict: used as-is.
+    - ``None``: off.
+    """
+    from ..core.schema import TableSchema
+
+    if admission_schema is None:
+        return None
+    if isinstance(admission_schema, TableSchema):
+        return admission_schema if admission_schema.columns else None
+    if isinstance(admission_schema, dict):
+        return resolve_admission_schema(pipeline,
+                                        TableSchema(admission_schema))
+    if admission_schema == "auto":
+        get = getattr(pipeline, "request_schema", None)
+        schema = get() if callable(get) else None
+        return schema if schema is not None and schema.columns else None
+    raise ValueError(f"admission_schema must be 'auto', None, a "
+                     f"TableSchema or a dict; got {admission_schema!r}")
 
 
 def engine_metrics(reg, server_label: str, engine: str):
@@ -438,12 +518,16 @@ class MicroBatchServingEngine:
 
     def __init__(self, server: ServingServer, pipeline: Transformer,
                  reply_col: str = "reply", interval: float = 0.01,
-                 max_batch: int = 1024):
+                 max_batch: int = 1024, admission_schema="auto"):
         self.server = server
         self.pipeline = pipeline
         self.reply_col = reply_col
         self.interval = interval
         self.max_batch = max_batch
+        # install the pipeline's declared input schema for admission-time
+        # 400s (a schema diff at the door instead of a worker 500)
+        server.admission_schema = resolve_admission_schema(pipeline,
+                                                           admission_schema)
         self._stop = threading.Event()
         self._error: Optional[BaseException] = None
         self._thread = threading.Thread(target=self._run, name="serving-engine",
@@ -551,7 +635,8 @@ def _np_default(v):
 
 def serve(pipeline: Transformer, host: str = "127.0.0.1", port: int = 0,
           reply_col: str = "reply", shared: bool = False,
-          reply_timeout: float = 30.0) -> MicroBatchServingEngine:
+          reply_timeout: float = 30.0,
+          admission_schema="auto") -> MicroBatchServingEngine:
     """Fluent entry (the ``spark.readStream.server()...writeStream.server()``
     analogue). ``shared=True`` reuses one server per (host, port) process-wide
     via the SharedSingleton pool, like ``JVMSharedServer``."""
@@ -565,7 +650,9 @@ def serve(pipeline: Transformer, host: str = "127.0.0.1", port: int = 0,
             lambda: ServingServer(host, port, reply_timeout=reply_timeout))
     else:
         server = ServingServer(host, port, reply_timeout=reply_timeout)
-    return MicroBatchServingEngine(server, pipeline, reply_col=reply_col).start()
+    return MicroBatchServingEngine(
+        server, pipeline, reply_col=reply_col,
+        admission_schema=admission_schema).start()
 
 
 def request_to_string(req: HTTPRequestData) -> str:

@@ -40,9 +40,9 @@ from ..observability import (get_registry, histogram_quantile,
                              merge_snapshots, merge_traces, tracing)
 from .http_schema import HTTPResponseData
 from .serving import (MicroBatchServingEngine, ServingServer, engine_metrics,
-                      respond_batch, serve_metrics_exposition,
-                      serve_timeline_exposition, serve_traces_exposition,
-                      traced_batch)
+                      resolve_admission_schema, respond_batch,
+                      serve_metrics_exposition, serve_timeline_exposition,
+                      serve_traces_exposition, traced_batch)
 
 __all__ = ["ContinuousServingEngine", "DistributedServingEngine",
            "ProcessServingFleet", "ServiceRegistry", "RoutingServer",
@@ -55,11 +55,17 @@ class ContinuousServingEngine:
     """Push-mode drain -> transform -> reply loop (no micro-batch tick)."""
 
     def __init__(self, server: ServingServer, pipeline: Transformer,
-                 reply_col: str = "reply", max_batch: int = 1024):
+                 reply_col: str = "reply", max_batch: int = 1024,
+                 admission_schema="auto"):
         self.server = server
         self.pipeline = pipeline
         self.reply_col = reply_col
         self.max_batch = max_batch
+        # admission-time request validation against the pipeline's declared
+        # input schema (core.schema): a 400 with the schema diff at the
+        # door, not a worker 500 mid-batch
+        server.admission_schema = resolve_admission_schema(pipeline,
+                                                           admission_schema)
         self._work = threading.Event()
         self._stop = threading.Event()
         self._error: Optional[BaseException] = None
@@ -437,18 +443,21 @@ class DistributedServingEngine:
     def __init__(self, pipeline: Transformer, n_workers: int = 2,
                  service: str = "default", host: str = "127.0.0.1",
                  reply_col: str = "reply", mode: str = "continuous",
-                 interval: float = 0.01, reply_timeout: float = 30.0):
+                 interval: float = 0.01, reply_timeout: float = 30.0,
+                 admission_schema="auto"):
         self.registry = ServiceRegistry()
         self.workers = []
         for _ in range(n_workers):
             server = ServingServer(host, 0, reply_timeout=reply_timeout)
             if mode == "continuous":
-                eng = ContinuousServingEngine(server, pipeline,
-                                              reply_col=reply_col).start()
+                eng = ContinuousServingEngine(
+                    server, pipeline, reply_col=reply_col,
+                    admission_schema=admission_schema).start()
             else:
-                eng = MicroBatchServingEngine(server, pipeline,
-                                              reply_col=reply_col,
-                                              interval=interval).start()
+                eng = MicroBatchServingEngine(
+                    server, pipeline, reply_col=reply_col,
+                    interval=interval,
+                    admission_schema=admission_schema).start()
             self.workers.append(eng)
             self.registry.register(service, server.address)
         self.router = RoutingServer(self.registry, service, host, 0,
@@ -657,11 +666,14 @@ class ProcessServingFleet:
 
 def serve_continuous(pipeline: Transformer, host: str = "127.0.0.1",
                      port: int = 0, reply_col: str = "reply",
-                     reply_timeout: float = 30.0) -> ContinuousServingEngine:
+                     reply_timeout: float = 30.0,
+                     admission_schema="auto") -> ContinuousServingEngine:
     """Fluent entry for the low-latency path
     (``spark.readStream.continuousServer()`` analogue)."""
     server = ServingServer(host, port, reply_timeout=reply_timeout)
-    return ContinuousServingEngine(server, pipeline, reply_col=reply_col).start()
+    return ContinuousServingEngine(
+        server, pipeline, reply_col=reply_col,
+        admission_schema=admission_schema).start()
 
 
 def serve_distributed(pipeline: Transformer, n_workers: int = 2,

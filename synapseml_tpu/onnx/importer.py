@@ -27,15 +27,59 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from .ops import OPS
-from .wire import GraphProto, ModelProto, ValueInfo, parse_model, tensor_to_numpy
+from .wire import (DataType, GraphProto, ModelProto, ValueInfo, parse_model,
+                   tensor_to_numpy)
 
-__all__ = ["OnnxFunction", "load_model"]
+__all__ = ["OnnxFunction", "load_model", "model_io_specs"]
 
 _logger = logging.getLogger("synapseml_tpu.onnx")
 
 
 def _is_const(v) -> bool:
     return isinstance(v, np.ndarray) or np.isscalar(v)
+
+
+def _value_info_spec(vi: ValueInfo):
+    """(dtype_class, shape_role) of a graph ``value_info`` entry, in
+    :mod:`synapseml_tpu.core.schema` vocabulary. The leading dim is the
+    batch axis, so a rank-2 graph tensor is a per-row *vector* column, a
+    rank-3+ one a *tensor* column, rank-0/1 a *scalar* column. Unknown
+    element types / shapes degrade to ``any``."""
+    np_dtype = DataType._TO_NUMPY.get(vi.elem_type)
+    if np_dtype is None:
+        dtype_class = "any"
+    else:
+        from ..core.schema import dtype_class_of
+
+        dtype_class = dtype_class_of(np_dtype)
+    if vi.shape is None:
+        role = "any"
+    elif len(vi.shape) <= 1:
+        role = "scalar"
+    elif len(vi.shape) == 2:
+        role = "vector"
+    else:
+        role = "tensor"
+    return (dtype_class, role)
+
+
+def model_io_specs(model: "ModelProto | bytes"):
+    """Static (input specs, output specs) of an ONNX model, derived from
+    the graph's ``value_info`` — ``{name: (dtype_class, shape_role)}``
+    per side, initializers excluded from inputs.
+
+    Pure wire-format work: parses the protobuf only, NEVER imports jax —
+    this is what ``ONNXModel.transform_schema`` and ``Pipeline.validate``
+    run at plan time, and what serving admission derives its request
+    schema from."""
+    if isinstance(model, (bytes, bytearray, memoryview)):
+        model = parse_model(bytes(model))
+    graph = model.graph
+    init_names = {t.name for t in graph.initializer}
+    inputs = {vi.name: _value_info_spec(vi) for vi in graph.input
+              if vi.name not in init_names}
+    outputs = {vi.name: _value_info_spec(vi) for vi in graph.output}
+    return inputs, outputs
 
 
 class OnnxFunction:

@@ -18,9 +18,10 @@ from typing import Any, Dict, List, Optional
 
 import numpy as np
 
-from ..core import ComplexParam, Param, Table, Transformer
+from ..core import (ColumnSpec, ComplexParam, Param, Table, TableSchema,
+                    Transformer)
 from ..core.params import ParamValidators
-from .importer import OnnxFunction
+from .importer import OnnxFunction, model_io_specs
 
 __all__ = ["ONNXModel"]
 
@@ -48,13 +49,16 @@ class ONNXModel(Transformer):
     def __init__(self, uid=None, **kw):
         super().__init__(uid=uid, **kw)
         self._fn: Optional[OnnxFunction] = None
+        self._io_specs_cache = None
 
     def _post_load(self):
         self._fn = None
+        self._io_specs_cache = None
 
     def set_model(self, model_bytes: bytes) -> "ONNXModel":
         self.set("model_bytes", bytes(model_bytes))
         self._fn = None
+        self._io_specs_cache = None
         return self
 
     @property
@@ -64,6 +68,73 @@ class ONNXModel(Transformer):
                 raise ValueError(f"ONNXModel({self.uid}): model_bytes not set")
             self._fn = OnnxFunction(self.model_bytes, dtype_policy=self.dtype_policy)
         return self._fn
+
+    # -- static schema (derived from the graph's value_info; NO jax) --------------
+
+    def _io_specs(self):
+        """Graph input/output specs via :func:`model_io_specs` — protobuf
+        parsing only (so ``Pipeline.validate`` stays jax-free), cached:
+        real models carry hundreds of MB of initializers and must not be
+        re-parsed per validate() call. The cache is keyed on the current
+        ``model_bytes`` OBJECT, so replacing the model through the generic
+        ``Params.set`` path (not just :meth:`set_model`) invalidates it."""
+        mb = self.model_bytes
+        if mb is None:
+            raise ValueError(f"ONNXModel({self.uid}): model_bytes not set")
+        cache = self._io_specs_cache
+        if cache is None or cache[0] is not mb:
+            self._io_specs_cache = cache = (mb, model_io_specs(mb))
+        return cache[1]
+
+    def _input_schema_from(self, ins) -> TableSchema:
+        cols = {}
+        for onnx_in, col in self.feed_dict.items():
+            dc, role = ins.get(onnx_in, ("any", "any"))
+            # a rank-k graph tensor feeds from a per-row rank-(k-1) column,
+            # which may also arrive as an object column of arrays — keep
+            # the dtype class, relax the role (stacking is _gather_feed's
+            # job, the static contract is "this column exists & is dc")
+            cols[col] = ColumnSpec(dc, "any" if role == "tensor" else role)
+        return TableSchema(cols)
+
+    def input_schema(self) -> "TableSchema | None":
+        if not self.feed_dict or self.model_bytes is None:
+            return None
+        return self._input_schema_from(self._io_specs()[0])
+
+    def transform_schema(self, schema: TableSchema) -> "TableSchema | None":
+        # mis-wiring raises SchemaError so Pipeline.validate wraps it into
+        # its documented PipelineSchemaError (naming this stage) instead
+        # of letting a bare ValueError escape the plan-time gate
+        from ..core.schema import SchemaError
+
+        if self.model_bytes is None or not self.feed_dict \
+                or not self.fetch_dict:
+            raise SchemaError(
+                f"ONNXModel({self.uid}): model_bytes, feed_dict and "
+                f"fetch_dict must be set")
+        ins, outs = self._io_specs()
+        unknown = [k for k in self.feed_dict if k not in ins]
+        if unknown:
+            raise SchemaError(
+                f"ONNXModel({self.uid}): feed_dict keys {unknown} are not "
+                f"graph inputs; graph expects {sorted(ins)}")
+        missing_out = [n for n in self.fetch_dict.values() if n not in outs]
+        if missing_out:
+            raise SchemaError(
+                f"ONNXModel({self.uid}): fetch_dict outputs {missing_out} "
+                f"are not graph outputs; graph produces {sorted(outs)}")
+        self._check_schema(schema, self._input_schema_from(ins))
+        out = schema
+        for col, onnx_name in self.fetch_dict.items():
+            dc, role = outs.get(onnx_name, ("any", "any"))
+            out = out.with_column(col, ColumnSpec(dc, role))
+        for src, dst in self.softmax_dict.items():
+            out = out.with_column(dst, ColumnSpec(
+                "float", out[src].role if src in out else "any"))
+        for src, dst in self.argmax_dict.items():
+            out = out.with_column(dst, ColumnSpec("int", "any"))
+        return out
 
     # -- helpers -------------------------------------------------------------------
 

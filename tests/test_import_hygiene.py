@@ -20,8 +20,12 @@ _GATED_MODULES = [
     "synapseml_tpu",
     "synapseml_tpu.analysis",  # the linter itself runs pre-accelerator
     "synapseml_tpu.analysis.cli",
+    # device rules are LAZY: SMT1xx codes register at import for
+    # --select/--list-rules, jax is reached only at --device run time
+    "synapseml_tpu.analysis.rules_device",
     "synapseml_tpu.core.clock",
     "synapseml_tpu.core.lazyimport",
+    "synapseml_tpu.core.schema",  # Pipeline.validate must stay plan-time
     "synapseml_tpu.core.stage",
     "synapseml_tpu.core.telemetry",
     "synapseml_tpu.observability",

@@ -416,6 +416,143 @@ def test_smt008_absolute_self_import_resolved_from_filesystem(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# SMT009 — duplicate stage class name across modules
+# ---------------------------------------------------------------------------
+
+def test_smt009_true_positive(tmp_path):
+    (tmp_path / "mod_a.py").write_text(textwrap.dedent("""\
+        from synapseml_tpu.core import Transformer
+
+        class TokenCleaner(Transformer):
+            def _transform(self, table):
+                return table
+        """))
+    (tmp_path / "mod_b.py").write_text(textwrap.dedent("""\
+        from synapseml_tpu.core import Transformer
+
+        class TokenCleaner(Transformer):
+            def _transform(self, table):
+                return table
+        """))
+    report = analyze_paths([str(tmp_path)], select=["SMT009"],
+                           use_acks=False)
+    findings = report["findings"]
+    # one finding PER defining site, each naming the other module
+    assert len(findings) == 2
+    assert {f.path for f in findings} == {"mod_a.py", "mod_b.py"}
+    assert "mod_b.py" in findings[0].message
+    assert "load_stage" in findings[0].message
+
+
+def test_smt009_true_negative(tmp_path):
+    (tmp_path / "mod_a.py").write_text(textwrap.dedent("""\
+        from synapseml_tpu.core import Transformer
+
+        class TokenCleaner(Transformer):
+            def _transform(self, table):
+                return table
+
+        class _LocalHelper(Transformer):  # _-prefixed: never registered
+            def _transform(self, table):
+                return table
+        """))
+    (tmp_path / "mod_b.py").write_text(textwrap.dedent("""\
+        from synapseml_tpu.core import Estimator, Transformer
+
+        class OtherStage(Transformer):
+            def _transform(self, table):
+                return table
+
+        class TokenCleanerBase(Estimator):  # abstract: never registered
+            _abstract_stage = True
+
+        class _LocalHelper(Transformer):
+            def _transform(self, table):
+                return table
+        """))
+    report = analyze_paths([str(tmp_path)], select=["SMT009"],
+                           use_acks=False)
+    assert report["findings"] == []
+
+
+def test_smt009_state_resets_between_runs(tmp_path):
+    # a second analyze run over a DIFFERENT tree must not see the first
+    # run's class-name sites (begin() resets the cross-module state)
+    (tmp_path / "one").mkdir()
+    (tmp_path / "two").mkdir()
+    src = ("from synapseml_tpu.core import Transformer\n\n"
+           "class SameName(Transformer):\n"
+           "    def _transform(self, table):\n        return table\n")
+    (tmp_path / "one" / "mod.py").write_text(src)
+    (tmp_path / "two" / "mod.py").write_text(src)
+    r1 = analyze_paths([str(tmp_path / "one")], select=["SMT009"],
+                       use_acks=False)
+    r2 = analyze_paths([str(tmp_path / "two")], select=["SMT009"],
+                       use_acks=False)
+    assert r1["findings"] == [] and r2["findings"] == []
+
+
+def test_register_stage_records_runtime_collision():
+    from synapseml_tpu.core import stage as stage_mod
+
+    try:
+        type("CollisionProbeStage", (stage_mod.Transformer,),
+             {"__module__": "tests.fake_module_a"})
+        # a second definition of the SAME name from another module: the
+        # auto-registration path must record the collision
+        type("CollisionProbeStage", (stage_mod.Transformer,),
+             {"__module__": "tests.other_fake_module"})
+        assert "CollisionProbeStage" in stage_mod.STAGE_NAME_COLLISIONS
+        mods = stage_mod.STAGE_NAME_COLLISIONS["CollisionProbeStage"]
+        assert "tests.other_fake_module" in mods
+    finally:
+        stage_mod.STAGE_REGISTRY.pop("CollisionProbeStage", None)
+        stage_mod.STAGE_NAME_COLLISIONS.pop("CollisionProbeStage", None)
+
+
+# ---------------------------------------------------------------------------
+# SARIF output
+# ---------------------------------------------------------------------------
+
+def test_cli_sarif_format(tmp_path, capsys):
+    import json as _json
+
+    (tmp_path / "mod.py").write_text("import jax\n")
+    rc = lint_main([str(tmp_path), "--select", "SMT001", "--no-acks",
+                    "--format", "sarif"])
+    out = capsys.readouterr().out
+    assert rc == 1
+    doc = _json.loads(out)
+    assert doc["version"] == "2.1.0"
+    run = doc["runs"][0]
+    assert run["tool"]["driver"]["name"] == "synapseml_tpu-lint"
+    rule_ids = [r["id"] for r in run["tool"]["driver"]["rules"]]
+    assert "SMT001" in rule_ids
+    res = run["results"][0]
+    assert res["ruleId"] == "SMT001"
+    loc = res["locations"][0]["physicalLocation"]
+    assert loc["artifactLocation"]["uri"] == "mod.py"
+    assert loc["region"]["startLine"] == 1
+    assert "suppressions" not in res
+
+
+def test_cli_sarif_carries_waived_as_suppressed(tmp_path, capsys):
+    import json as _json
+
+    (tmp_path / "mod.py").write_text("import jax\n")
+    acks = tmp_path / "LINT_ACKS.md"
+    acks.write_text("| rule | file | match | reason |\n|---|---|---|---|\n"
+                    "| SMT001 | mod.py | - | fixture waiver |\n")
+    rc = lint_main([str(tmp_path), "--select", "SMT001",
+                    "--acks", str(acks), "--format", "sarif"])
+    out = capsys.readouterr().out
+    assert rc == 0  # waived findings keep the run green
+    doc = _json.loads(out)
+    res = doc["runs"][0]["results"]
+    assert len(res) == 1 and res[0]["suppressions"]
+
+
+# ---------------------------------------------------------------------------
 # waivers
 # ---------------------------------------------------------------------------
 

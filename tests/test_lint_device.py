@@ -1,0 +1,389 @@
+"""Jaxpr-level device lint (SMT101–106): per-rule TP/TN fixtures + the
+zero-unwaived device gate.
+
+Fixture entries are tiny synthetic ``DeviceEntry`` objects traced on CPU
+(``jax.make_jaxpr`` only — no compile, no execution), pinning each rule's
+detection shape. The gate traces the repo's REAL canonical entry points
+(flash kernel, ONNX graphs, gbdt growers incl. the voting-parallel
+sharded path) and must report zero findings — the voting-parallel f64
+leaks this pack originally caught (``grow.py`` dtype-less ``jnp.zeros``
+vote accumulators, a traced f64 config max) are FIXED in-tree, and this
+test keeps them fixed.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from synapseml_tpu.analysis.engine import RULES, apply_waivers
+from synapseml_tpu.analysis.rules_device import (DEVICE_RULES, DeviceEntry,
+                                                 default_device_entries,
+                                                 run_device_pack,
+                                                 trace_entry)
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+jax = pytest.importorskip("jax")
+
+
+def _findings(entry, code):
+    traced = trace_entry(entry, root=REPO_ROOT)
+    return list(DEVICE_RULES[code].check_entry(traced))
+
+
+def _entry(name, fn, args, **kw):
+    return DeviceEntry(name, lambda: {"fn": fn, "args": args}, **kw)
+
+
+def test_device_rules_registered_in_engine():
+    for code in ("SMT101", "SMT102", "SMT103", "SMT104", "SMT105",
+                 "SMT106"):
+        assert code in RULES and code in DEVICE_RULES
+        # the AST hook is inert: device rules never fire on source modules
+        assert RULES[code].check(object()) == []
+
+
+# ---------------------------------------------------------------------------
+# SMT101 — f64 leak (traced under enable_x64: latent leaks surface)
+# ---------------------------------------------------------------------------
+
+def test_smt101_true_positive_dtypeless_zeros():
+    import jax.numpy as jnp
+
+    def leaky(x):
+        return x + jnp.zeros(x.shape)  # dtype-less: f64 under x64
+
+    fs = _findings(_entry("fix.leaky", leaky,
+                          (np.ones(4, np.float32),)), "SMT101")
+    assert fs and fs[0].code == "SMT101"
+    assert "float64" in fs[0].message and "[fix.leaky]" in fs[0].message
+
+
+def test_smt101_true_positive_f64_closure_const():
+    import jax.numpy as jnp
+
+    big = np.ones(8)  # numpy default f64
+
+    def leaky(x):
+        return x * jnp.asarray(big)
+
+    fs = _findings(_entry("fix.const64", leaky,
+                          (np.ones(8, np.float32),)), "SMT101")
+    assert any("closure constant" in f.message for f in fs)
+
+
+def test_smt101_x64_trace_failure_is_a_finding_not_a_silent_downgrade():
+    from synapseml_tpu.analysis.rules_device import TracedEntry
+
+    def clean(x):
+        return x * 2
+
+    traced = trace_entry(_entry("fix.x64fail", clean,
+                                (np.ones(4, np.float32),)), root=REPO_ROOT)
+    assert traced.x64_error is None
+    # an entry that only traced with x64 OFF surfaces as a waivable
+    # SMT101 finding (visibility loss is never silent)
+    broken = TracedEntry(traced.entry, traced.closed, traced.anchor,
+                         x64_error="TypeError: dtype conflict")
+    fs = list(DEVICE_RULES["SMT101"].check_entry(broken))
+    assert fs and "could not trace under enable_x64" in fs[0].message
+
+
+def test_device_pack_skipped_when_selection_has_no_device_codes():
+    # --select SMT005 must not pay for (or fail on) jax traces
+    findings, errors = run_device_pack(
+        entries=[DeviceEntry("fix.never", lambda: 1 / 0)],
+        select=["SMT005"], root=REPO_ROOT)
+    assert findings == [] and errors == []
+
+
+def test_device_findings_relativize_without_explicit_root():
+    import jax.numpy as jnp
+
+    from synapseml_tpu.gbdt import grow
+
+    def leaky(x):
+        return x + jnp.zeros(x.shape)
+
+    # anchor at a real repo file; root=None must still produce the
+    # repo-relative path LINT_ACKS.md waivers match
+    entry = DeviceEntry("fix.rel", lambda: {
+        "fn": leaky, "args": (np.ones(4, np.float32),),
+        "anchor_obj": grow.grow_tree})
+    findings, errors = run_device_pack(entries=[entry], root=None)
+    assert errors == [] and findings
+    assert findings[0].path == "synapseml_tpu/gbdt/grow.py"
+
+
+def test_smt101_true_negative_pinned_dtypes():
+    import jax.numpy as jnp
+
+    def clean(x):
+        return x + jnp.zeros(x.shape, jnp.float32)
+
+    assert _findings(_entry("fix.clean", clean,
+                            (np.ones(4, np.float32),)), "SMT101") == []
+
+
+# ---------------------------------------------------------------------------
+# SMT102 — host callback in jit
+# ---------------------------------------------------------------------------
+
+def test_smt102_true_positive_debug_print():
+    def chatty(x):
+        jax.debug.print("x = {}", x)
+        return x * 2
+
+    fs = _findings(_entry("fix.chatty", chatty,
+                          (np.ones(4, np.float32),)), "SMT102")
+    # jax.debug.print lowers to the debug_callback primitive on this jax
+    assert fs and "callback" in fs[0].message
+
+
+def test_smt102_true_positive_pure_callback():
+    def hostly(x):
+        return jax.pure_callback(
+            lambda v: np.asarray(v) * 2,
+            jax.ShapeDtypeStruct(x.shape, x.dtype), x)
+
+    fs = _findings(_entry("fix.hostly", hostly,
+                          (np.ones(4, np.float32),)), "SMT102")
+    assert fs and "pure_callback" in fs[0].message
+
+
+def test_smt102_true_negative_plain_math_and_cold_entry():
+    def clean(x):
+        return x * 2
+
+    assert _findings(_entry("fix.clean", clean,
+                            (np.ones(4, np.float32),)), "SMT102") == []
+
+    def chatty(x):
+        jax.debug.print("x = {}", x)
+        return x
+
+    # entries marked NOT hot (debug tooling) are exempt
+    assert _findings(_entry("fix.cold", chatty,
+                            (np.ones(4, np.float32),), hot=False),
+                     "SMT102") == []
+
+
+# ---------------------------------------------------------------------------
+# SMT103 — transfers staged inside jit
+# ---------------------------------------------------------------------------
+
+def test_smt103_true_positive_device_put():
+    def putty(x):
+        return jax.device_put(x) + 1.0
+
+    fs = _findings(_entry("fix.putty", putty,
+                          (np.ones(4, np.float32),)), "SMT103")
+    assert fs and "device_put" in fs[0].message
+
+
+def test_smt103_true_negative():
+    def clean(x):
+        return x + 1.0
+
+    assert _findings(_entry("fix.clean", clean,
+                            (np.ones(4, np.float32),)), "SMT103") == []
+
+
+# ---------------------------------------------------------------------------
+# SMT104 — collective axis names vs declared mesh axes
+# ---------------------------------------------------------------------------
+
+def _sharded_psum_fn(axis_in_code):
+    from jax.sharding import Mesh
+    from jax.sharding import PartitionSpec as P
+
+    from synapseml_tpu.runtime.topology import shard_map_compat
+
+    mesh = Mesh(np.array(jax.devices("cpu")[:1]), (axis_in_code,))
+
+    def body(x):
+        return jax.lax.psum(x, axis_in_code)
+
+    return shard_map_compat(body, mesh=mesh, in_specs=(P(axis_in_code),),
+                            out_specs=P(), check=False)
+
+
+def test_smt104_true_positive_undeclared_axis():
+    fn = _sharded_psum_fn("data")
+    fs = _findings(_entry("fix.mismatch", fn, (np.ones(4, np.float32),),
+                          mesh_axes=("batch",)), "SMT104")
+    assert fs and "'data'" in fs[0].message and "batch" in fs[0].message
+
+
+def test_smt104_true_positive_collective_with_no_declared_mesh():
+    fn = _sharded_psum_fn("data")
+    fs = _findings(_entry("fix.nomesh", fn, (np.ones(4, np.float32),)),
+                   "SMT104")
+    assert fs and "NONE" in fs[0].message
+
+
+def test_smt104_true_negative_declared_axis():
+    fn = _sharded_psum_fn("data")
+    assert _findings(_entry("fix.ok", fn, (np.ones(4, np.float32),),
+                            mesh_axes=("data",)), "SMT104") == []
+
+
+# ---------------------------------------------------------------------------
+# SMT105 — HBM-bloat closure constants
+# ---------------------------------------------------------------------------
+
+def test_smt105_true_positive_big_const():
+    import jax.numpy as jnp
+
+    big = np.ones((256, 256), np.float32)  # 256 KiB
+
+    def bloated(x):
+        return x @ jnp.asarray(big)
+
+    fs = _findings(_entry("fix.bloat", bloated,
+                          (np.ones((4, 256), np.float32),),
+                          const_bytes_limit=64 << 10), "SMT105")
+    assert fs and "exceeds" in fs[0].message
+
+
+def test_smt105_true_negative_under_limit():
+    import jax.numpy as jnp
+
+    small = np.ones((8, 8), np.float32)
+
+    def fine(x):
+        return x @ jnp.asarray(small)
+
+    assert _findings(_entry("fix.fine", fine,
+                            (np.ones((4, 8), np.float32),)), "SMT105") == []
+
+
+# ---------------------------------------------------------------------------
+# SMT106 — weak-typed scalar args
+# ---------------------------------------------------------------------------
+
+def test_smt106_true_positive_python_scalar_arg():
+    def scaled(x, lr):
+        return x * lr
+
+    fs = _findings(_entry("fix.weak", scaled,
+                          (np.ones(4, np.float32), 0.1)), "SMT106")
+    assert fs and "weak-typed" in fs[0].message
+
+
+def test_smt106_true_negative_coerced_scalar():
+    def scaled(x, lr):
+        return x * lr
+
+    assert _findings(_entry("fix.strong", scaled,
+                            (np.ones(4, np.float32),
+                             np.float32(0.1))), "SMT106") == []
+
+
+def test_smt106_reports_live_churn_counts():
+    from synapseml_tpu.observability import get_registry
+
+    reg = get_registry()
+    series = reg.counter("smt_recompiles_total",
+                         "compilations by cause", ("fn", "cause")
+                         ).labels("fix.churny", "weak_type")
+    series.inc(3)
+    try:
+        def scaled(x, lr):
+            return x * lr
+
+        fs = _findings(_entry("fix.churny", scaled,
+                              (np.ones(4, np.float32), 0.5)), "SMT106")
+        assert fs and "recorded 3 weak_type recompile" in fs[0].message
+    finally:
+        series.remove()
+
+
+# ---------------------------------------------------------------------------
+# the device gate: real entries, zero findings, zero trace errors
+# ---------------------------------------------------------------------------
+
+def test_default_entries_cover_the_profiled_families():
+    names = [e.name for e in default_device_entries()]
+    assert any(n.startswith("flash.") for n in names)
+    assert any(n.startswith("onnx.") for n in names)
+    assert any(n.startswith("gbdt.") for n in names)
+    # at least one SHARDED entry so collective rules see a real mesh path
+    assert any(e.mesh_axes for e in default_device_entries())
+
+
+def test_device_pack_full_run_zero_unwaived():
+    """The acceptance gate: AST pack + device pack over the repo's real
+    entry points report zero unwaived findings. The voting-parallel f64
+    leaks in gbdt/grow.py were found by this pack and FIXED in-tree (not
+    waived) — a regression re-fails here with the entry + primitive
+    named."""
+    findings, errors = run_device_pack(root=REPO_ROOT)
+    assert errors == [], errors
+    assert findings == [], [f"{f.location}: {f.code} {f.message}"
+                            for f in findings]
+
+
+def test_device_findings_respect_waivers():
+    import jax.numpy as jnp
+
+    def leaky(x):
+        return x + jnp.zeros(x.shape)
+
+    findings, errors = run_device_pack(
+        entries=[_entry("fix.leak", leaky, (np.ones(4, np.float32),))],
+        root=REPO_ROOT)
+    assert errors == [] and findings
+    from synapseml_tpu.analysis.engine import Waiver
+
+    w = Waiver(rule="SMT101", file=findings[0].path, match="fix.leak",
+               reason="fixture", line=1)
+    unwaived, waived, unused = apply_waivers(findings, [w])
+    assert unwaived == [] and waived == findings and unused == []
+
+
+def test_trace_failure_is_an_error_not_a_silent_skip():
+    def broken():
+        raise RuntimeError("cannot build")
+
+    findings, errors = run_device_pack(
+        entries=[DeviceEntry("fix.broken", broken)], root=REPO_ROOT)
+    assert findings == []
+    assert len(errors) == 1 and "fix.broken" in errors[0]
+
+
+def test_analyze_paths_device_mode_merges_findings(tmp_path):
+    """engine.analyze_paths(device=True) runs both packs and routes
+    device findings through the ordinary waiver machinery."""
+    import jax.numpy as jnp
+
+    from synapseml_tpu.analysis import analyze_paths
+
+    (tmp_path / "clean.py").write_text("x = 1\n")
+
+    def leaky(x):
+        return x + jnp.zeros(x.shape)
+
+    report = analyze_paths(
+        [str(tmp_path)], use_acks=False, device=True,
+        device_entries=[_entry("fix.leak", leaky,
+                               (np.ones(4, np.float32),))])
+    assert any(f.code == "SMT101" for f in report["findings"])
+
+
+def test_cli_device_flag_runs_clean():
+    from synapseml_tpu.analysis.cli import main
+
+    assert main(["--device"]) == 0
+
+
+def test_selecting_only_device_rules_without_device_flag_is_config_error():
+    # `--select SMT101` without --device would print "0 findings" forever;
+    # a permanently-green gate must be a config error (exit 2), not a pass
+    from synapseml_tpu.analysis.cli import main
+
+    assert main(["--select", "SMT101"]) == 2
+    assert main(["--select", "SMT101,SMT105"]) == 2
+    # mixed selections still run their AST half; with --device it runs
+    assert main(["--select", "SMT101", "--device"]) == 0

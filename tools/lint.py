@@ -6,8 +6,11 @@ from a checkout without installing the package: it only puts the repo
 root on ``sys.path``. Relative path arguments stay caller-relative; with
 no paths the CLI lints the whole repo (defaults resolve against the
 package location, not the cwd). Same flags, same exit codes (0 clean, 1
-findings, 2 config error); stays jax-free (enforced by
-``tests/test_import_hygiene.py``).
+findings, 2 config error). ``--format sarif`` emits the GitHub
+code-scanning upload schema; ``--device`` additionally runs the
+jaxpr-level device pack (SMT1xx) over the canonical ``profiled_jit``
+entry points — the ONE mode that imports jax; the default run stays
+jax-free (enforced by ``tests/test_import_hygiene.py``).
 """
 
 import os
