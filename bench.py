@@ -545,6 +545,109 @@ def bench_serving(platform):
     }
 
 
+def bench_serving_overload(platform):
+    """Overload survival: offered load ~2x a worker's hard capacity, with
+    deadline-aware shedding ON (every request carries a 250ms
+    X-SMT-Deadline-Ms) vs OFF (no deadlines — the pre-resilience
+    behavior). The shedding-off control COLLAPSES: queued requests ride
+    the queue to the server's reply timeout. With shedding on, doomed
+    requests get fast 429/504s and in-deadline ones stay bounded —
+    ``p99_collapse_ratio`` (off/on, higher is better) is the primary the
+    ratchet gate watches."""
+    import threading
+    import urllib.error
+    import urllib.request
+
+    from synapseml_tpu.core.stage import Transformer
+    from synapseml_tpu.io.resilience import DEADLINE_HEADER
+    from synapseml_tpu.io.serving import ServingServer
+    from synapseml_tpu.io.serving_v2 import ContinuousServingEngine
+
+    per_req_s = 0.004  # hard capacity: 250 req/s
+
+    class _FixedCost(Transformer):
+        def _transform(self, table):
+            time.sleep(per_req_s * table.num_rows)
+            n = table.num_rows
+            out = np.empty(n, dtype=object)
+            out[:] = ["ok"] * n
+            return table.with_column("reply", out)
+
+    def drive(shed: bool, n_requests=400, deadline_ms=250.0,
+              reply_timeout=1.5):
+        srv = ServingServer(port=0, reply_timeout=reply_timeout)
+        eng = ContinuousServingEngine(srv, _FixedCost(), max_batch=8).start()
+        latencies, statuses = [], []
+        lock = threading.Lock()
+
+        def one():
+            t0 = time.perf_counter()
+            headers = {}
+            if shed:
+                headers[DEADLINE_HEADER] = str(int(
+                    (time.time() + deadline_ms / 1e3) * 1e3))
+            req = urllib.request.Request(srv.address, data=b"x",
+                                         method="POST", headers=headers)
+            try:
+                with urllib.request.urlopen(req, timeout=10) as r:
+                    status = r.status
+                    r.read()
+            except urllib.error.HTTPError as e:
+                status = e.code
+            except (urllib.error.URLError, OSError):
+                # transport-level failure under the open-loop hammer
+                # (accept-backlog refusal, reset): still a sample — a
+                # dropped one would skew the gated p99
+                status = 0
+            dt = time.perf_counter() - t0
+            with lock:
+                latencies.append(dt)
+                statuses.append(status)
+
+        try:
+            # warm one request so the service-time EWMA is seeded
+            urllib.request.urlopen(urllib.request.Request(
+                srv.address, data=b"w", method="POST"), timeout=10).read()
+            # OPEN loop: each request fires on schedule at 2x capacity
+            # regardless of completions (a closed loop would self-limit
+            # to exactly capacity and hide the collapse)
+            gap_s = per_req_s / 2.0
+            threads = []
+            next_t = time.perf_counter()
+            for _ in range(n_requests):
+                th = threading.Thread(target=one, daemon=True)
+                th.start()
+                threads.append(th)
+                next_t += gap_s
+                rest = next_t - time.perf_counter()
+                if rest > 0:
+                    time.sleep(rest)
+            for th in threads:
+                th.join(timeout=15)
+        finally:
+            eng.stop()
+        lat = np.array(latencies)
+        shed_n = sum(1 for s in statuses if s in (429, 504))
+        return {
+            "p50_ms": round(float(np.quantile(lat, 0.5)) * 1e3, 2),
+            "p99_ms": round(float(np.quantile(lat, 0.99)) * 1e3, 2),
+            "ok_fraction": round(statuses.count(200) / len(statuses), 3),
+            "shed_fraction": round(shed_n / len(statuses), 3),
+        }
+
+    on = drive(shed=True)
+    off = drive(shed=False)
+    return {
+        "offered_over_capacity": 2.0,
+        "shedding_on": on,
+        "shedding_off": off,
+        # the headline: how much p99 the deadline-aware path saves vs the
+        # collapse (bounded vs reply-timeout-bound)
+        "p99_collapse_ratio": round(off["p99_ms"] / max(on["p99_ms"], 1e-6),
+                                    2),
+    }
+
+
 def bench_span_overhead(platform):
     """Per-transform overhead of the observability stage spans.
 
@@ -924,6 +1027,7 @@ _PRIMARY = {
     "vit_to_gbdt_pipeline": "images_per_sec_end_to_end",
     "flash_attention_32k": "tflops_nominal",
     "flash_attention_gqa": "tflops_nominal",
+    "serving_overload": "p99_collapse_ratio",
 }
 
 
@@ -968,6 +1072,7 @@ def main() -> None:
         ("flash_attention_32k", lambda: bench_flash_attention(platform, peak)),
         ("flash_attention_gqa", lambda: bench_flash_gqa(platform, peak)),
         ("serving_latency", lambda: bench_serving(platform)),
+        ("serving_overload", lambda: bench_serving_overload(platform)),
         ("observability_span_overhead", lambda: bench_span_overhead(platform)),
         ("tracing_overhead", lambda: bench_tracing_overhead(platform)),
         ("profiling_overhead", lambda: bench_profiling_overhead(platform)),

@@ -656,6 +656,54 @@ class DuplicateStageName(Rule):
         return findings
 
 
+@register
+class UntimedNetworkCall(Rule):
+    """SMT011 — ``urlopen`` / ``socket.create_connection`` without an
+    explicit ``timeout=``.
+
+    The fault-injection harness (``io/faultinject.py``) makes the failure
+    mode concrete: under the wedged-socket plan an untimed call blocks
+    FOREVER — a handler thread, a scrape, or a prober that never comes
+    back. urllib's default is no timeout, so the only safe spelling is an
+    explicit one at every call site. The timeout may be positional
+    (``urlopen(url, data, t)`` / ``create_connection(addr, t)``) or a
+    keyword.
+    """
+
+    code = "SMT011"
+    name = "untimed-network-call"
+    rationale = ("an untimed urlopen/socket connect wedges forever when "
+                 "the peer stops answering; pass an explicit timeout=")
+
+    # callable terminal name -> number of positional args that implies the
+    # timeout was passed positionally
+    _CALLS = {"urlopen": 3, "create_connection": 2}
+
+    def check(self, module: Module) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if isinstance(node.func, ast.Attribute):
+                fname = node.func.attr
+            elif isinstance(node.func, ast.Name):
+                fname = node.func.id
+            else:
+                continue
+            pos_needed = self._CALLS.get(fname)
+            if pos_needed is None:
+                continue
+            if any(kw.arg == "timeout" for kw in node.keywords):
+                continue
+            if len(node.args) >= pos_needed:
+                continue  # timeout passed positionally
+            findings.append(self.finding(
+                module, node,
+                f"{fname}() without an explicit timeout= blocks forever "
+                f"on a wedged peer; pass a timeout"))
+        return findings
+
+
 # cache of "does this file use jax" verdicts, keyed by absolute path
 _JAX_USING_CACHE: Dict[str, bool] = {}
 

@@ -18,6 +18,7 @@ from typing import Iterable, Iterator, List, Optional, Sequence
 
 from ..core.telemetry import get_logger
 from ..observability import tracing
+from . import faultinject
 from .http_schema import HTTPRequestData, HTTPResponseData
 
 __all__ = ["send_request", "send_with_retries", "AsyncHTTPClient"]
@@ -51,6 +52,12 @@ def send_request(req: HTTPRequestData, timeout: float = 60.0,
                 attributes={"url": req.url, "method": req.method})
             tracing.inject_headers(headers, span)
     try:
+        # chaos seam (io/faultinject.py): a plan can refuse, delay, wedge,
+        # 5xx or disconnect this exchange — inside the try so every
+        # injected failure exercises the real handling paths below
+        rule = faultinject.act("client.send", f"{req.method} {req.url}")
+        if rule is not None:
+            faultinject.raise_transport_fault(rule, req.url, timeout=timeout)
         r = urllib.request.Request(
             req.url, data=req.entity, method=req.method, headers=headers,
         )

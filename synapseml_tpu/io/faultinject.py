@@ -1,0 +1,295 @@
+"""Deterministic fault injection for the serving stack.
+
+The resilience layer (``io/resilience.py`` + the routing/serving servers)
+makes claims — flapping workers are re-admitted, breakers open under 5xx
+bursts, hedges beat stragglers, expired work is shed — that real process
+kills alone cannot exercise repeatably in CI. This module is the seam-level
+chaos harness: a **seedable, import-pure fault plan** that perturbs the
+HTTP paths the stack actually takes, so every robustness behavior has a
+*deterministic* test (``tests/test_resilience.py``) instead of a flaky one.
+
+Seams (each names where the plan is consulted; ``key`` is what ``match``
+substring-filters on):
+
+- ``client.send``    — ``io/clients.py send_request``; key ``"METHOD url"``.
+- ``router.forward`` — one routing forward attempt
+  (``serving_v2.RoutingServer``); key ``"METHOD target+path"``.
+- ``router.probe``   — the re-admission health probe; key = target address.
+- ``server.handle``  — a worker request handler (``serving.ServingServer``);
+  key ``"host:port METHOD path"``.
+
+Fault kinds:
+
+- ``refuse``     — connection refused (the peer was never reached; always
+  safe to retry).
+- ``latency``    — sleep ``delay_ms`` then proceed (a straggler, not a
+  failure).
+- ``wedge``      — a socket that never answers: hold the caller for
+  ``delay_ms`` (bounded by its own timeout at client seams) then raise the
+  timeout. An *untimed* call would hang forever here — which is exactly
+  what lint rule SMT011 exists to prevent.
+- ``5xx``        — the peer answers an application error (``status``,
+  default 503). Client seams surface it as an ``HTTPError`` (a real
+  answered-error path); the server seam sends it.
+- ``disconnect`` — mid-body disconnect: client seams raise a reset; the
+  server seam writes a short body under a longer ``Content-Length`` and
+  closes the socket.
+
+Rules fire deterministically from per-rule counters (``after`` skips the
+first N eligible calls, ``every`` fires each k-th, ``times`` caps total
+fires); ``prob`` draws from the plan's seeded RNG instead (deterministic
+given a serial call order — concurrent tests should prefer the counters).
+
+Activation: :func:`install_plan` (tests, in-process engines) or the
+``SMT_FAULT_PLAN`` environment variable (a JSON spec, or ``@/path`` to a
+JSON file) — which is how ``ProcessServingFleet(fault_plan=...)`` reaches
+its worker *processes*. No plan installed (the default) means every seam is
+a no-op; this module never imports jax or anything heavy.
+"""
+
+from __future__ import annotations
+
+import io as _io
+import json
+import os
+import random
+import threading
+import time
+import urllib.error
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+__all__ = [
+    "FAULT_KINDS",
+    "FaultPlan",
+    "FaultRule",
+    "act",
+    "active_plan",
+    "apply_server_fault",
+    "clear_plan",
+    "install_plan",
+    "raise_transport_fault",
+]
+
+FAULT_KINDS = ("refuse", "latency", "wedge", "5xx", "disconnect")
+
+ENV_VAR = "SMT_FAULT_PLAN"
+
+
+class FaultRule:
+    """One perturbation: where (``site``/``match``), what (``kind``), and a
+    deterministic firing schedule (``after``/``every``/``times`` counters,
+    or seeded ``prob``)."""
+
+    __slots__ = ("site", "kind", "match", "after", "times", "every", "prob",
+                 "delay_ms", "status", "seen", "fired")
+
+    def __init__(self, site: str, kind: str, match: str = "",
+                 after: int = 0, times: Optional[int] = None, every: int = 1,
+                 prob: Optional[float] = None, delay_ms: float = 0.0,
+                 status: int = 503):
+        if kind not in FAULT_KINDS:
+            raise ValueError(f"unknown fault kind {kind!r}; "
+                             f"one of {FAULT_KINDS}")
+        if every < 1:
+            raise ValueError(f"every must be >= 1, got {every}")
+        self.site = site
+        self.kind = kind
+        self.match = match
+        self.after = int(after)
+        self.times = None if times is None else int(times)
+        self.every = int(every)
+        self.prob = None if prob is None else float(prob)
+        self.delay_ms = float(delay_ms)
+        self.status = int(status)
+        # counters are mutated under the owning plan's lock
+        self.seen = 0
+        self.fired = 0
+
+    def to_dict(self) -> Dict[str, Any]:
+        d: Dict[str, Any] = {"site": self.site, "kind": self.kind}
+        for k in ("match", "after", "times", "every", "prob", "delay_ms",
+                  "status"):
+            v = getattr(self, k)
+            if v not in ("", 0, None, 1) or k == "status":
+                d[k] = v
+        return d
+
+
+Spec = Union["FaultPlan", str, dict, Sequence[dict]]
+
+
+class FaultPlan:
+    """An ordered rule list plus the seeded RNG; ``decide`` is the only
+    entry seams call. Counter updates happen under one lock so the firing
+    sequence is a pure function of the per-site call order."""
+
+    def __init__(self, rules: Sequence[Union[FaultRule, dict]],
+                 seed: int = 0):
+        self.rules: List[FaultRule] = [
+            r if isinstance(r, FaultRule) else FaultRule(**r) for r in rules]
+        self.seed = int(seed)
+        self._rng = random.Random(self.seed)
+        self._lock = threading.Lock()
+
+    @classmethod
+    def from_spec(cls, spec: Spec) -> "FaultPlan":
+        """Build from a ``FaultPlan``, a ``{"seed":..,"rules":[...]}`` dict,
+        a bare rule list, a JSON string of either, or ``@/path/to.json``."""
+        if isinstance(spec, FaultPlan):
+            return spec
+        if isinstance(spec, str):
+            text = spec.strip()
+            if text.startswith("@"):
+                with open(text[1:], encoding="utf-8") as f:
+                    text = f.read()
+            spec = json.loads(text)
+        if isinstance(spec, dict):
+            return cls(spec.get("rules") or [], seed=spec.get("seed", 0))
+        return cls(list(spec))
+
+    def decide(self, site: str, key: str = "") -> Optional[FaultRule]:
+        """The first rule matching (site, key) whose schedule fires now;
+        None = no perturbation."""
+        with self._lock:
+            for r in self.rules:
+                if r.site != site:
+                    continue
+                if r.match and r.match not in key:
+                    continue
+                if r.prob is not None:
+                    if self._rng.random() >= r.prob:
+                        continue
+                    if r.times is not None and r.fired >= r.times:
+                        continue
+                    r.fired += 1
+                    return r
+                r.seen += 1
+                if r.seen <= r.after:
+                    continue
+                if (r.seen - r.after - 1) % r.every != 0:
+                    continue
+                if r.times is not None and r.fired >= r.times:
+                    continue
+                r.fired += 1
+                return r
+        return None
+
+    def counts(self) -> List[Dict[str, Any]]:
+        """Per-rule (seen, fired) for test assertions."""
+        with self._lock:
+            return [dict(r.to_dict(), seen=r.seen, fired=r.fired)
+                    for r in self.rules]
+
+
+_installed: Optional[FaultPlan] = None
+_env_cache: Optional[tuple] = None  # (env string, parsed plan)
+_state_lock = threading.Lock()
+
+
+def install_plan(spec: Spec) -> FaultPlan:
+    """Install a process-wide plan (overrides the environment); returns it
+    so tests can assert on ``counts()``."""
+    global _installed
+    plan = FaultPlan.from_spec(spec)
+    with _state_lock:
+        _installed = plan
+    return plan
+
+
+def clear_plan() -> None:
+    """Remove the installed plan AND forget the parsed-env cache (a test
+    that mutated ``SMT_FAULT_PLAN`` gets a fresh parse)."""
+    global _installed, _env_cache
+    with _state_lock:
+        _installed = None
+        _env_cache = None
+
+
+def active_plan() -> Optional[FaultPlan]:
+    """The installed plan, else the (cached) ``SMT_FAULT_PLAN`` env plan,
+    else None. The env parse is cached per env *value*, so the plan's
+    counters persist across calls within one process."""
+    global _env_cache
+    with _state_lock:
+        if _installed is not None:
+            return _installed
+        env = os.environ.get(ENV_VAR)
+        if not env:
+            return None
+        if _env_cache is not None and _env_cache[0] == env:
+            return _env_cache[1]
+    try:
+        plan = FaultPlan.from_spec(env)
+    except (ValueError, OSError, TypeError, KeyError):
+        return None  # a malformed plan must degrade to "no faults"
+    with _state_lock:
+        if _env_cache is None or _env_cache[0] != env:
+            _env_cache = (env, plan)
+        return _env_cache[1]
+
+
+def act(site: str, key: str = "") -> Optional[FaultRule]:
+    """The one-line seam hook: the rule that fires for this call, or None
+    (the overwhelmingly common case — one dict lookup when no plan)."""
+    plan = active_plan()
+    return plan.decide(site, key) if plan is not None else None
+
+
+def raise_transport_fault(rule: FaultRule, url: str,
+                          timeout: Optional[float] = None) -> None:
+    """Apply ``rule`` at a CLIENT seam (before the real ``urlopen``):
+    ``latency`` sleeps and returns (the exchange proceeds); every other
+    kind raises the exception the real network failure would produce, so
+    the caller's existing error handling is what gets exercised."""
+    if rule.kind == "latency":
+        time.sleep(rule.delay_ms / 1e3)
+        return
+    if rule.kind == "refuse":
+        raise urllib.error.URLError(
+            ConnectionRefusedError(f"injected connection refuse: {url}"))
+    if rule.kind == "wedge":
+        # a dead-but-open socket: hold the caller exactly as long as its
+        # own timeout allows (or delay_ms when shorter), then time out —
+        # an untimed caller would hang forever (lint SMT011's rationale)
+        hold = rule.delay_ms / 1e3 if rule.delay_ms else (timeout or 0.0)
+        if timeout is not None:
+            hold = min(hold, timeout)
+        if hold > 0:
+            time.sleep(hold)
+        raise TimeoutError(f"injected wedged socket: {url}")
+    if rule.kind == "5xx":
+        raise urllib.error.HTTPError(
+            url, rule.status, "injected fault", None,
+            _io.BytesIO(b"injected fault"))
+    if rule.kind == "disconnect":
+        raise ConnectionResetError(f"injected mid-body disconnect: {url}")
+
+
+def apply_server_fault(rule: FaultRule, handler) -> bool:
+    """Apply ``rule`` at the SERVER seam (``handler`` is a live
+    ``BaseHTTPRequestHandler``). Returns True when the request was fully
+    consumed by the fault (the caller must return without normal handling);
+    ``latency`` sleeps and returns False so handling proceeds."""
+    if rule.kind == "latency":
+        time.sleep(rule.delay_ms / 1e3)
+        return False
+    try:
+        if rule.kind == "5xx":
+            handler.send_error(rule.status, "injected fault")
+        elif rule.kind == "disconnect":
+            # promise more body than we send, then drop the connection:
+            # the client sees a mid-body disconnect (IncompleteRead/reset)
+            handler.send_response(200)
+            handler.send_header("Content-Length", "1048576")
+            handler.end_headers()
+            handler.wfile.write(b"injected partial body")
+            handler.wfile.flush()
+            handler.connection.close()
+        elif rule.kind in ("wedge", "refuse"):
+            # a wedged worker: hold the exchange open without answering
+            # until the client's own deadline/timeout gives up on us
+            time.sleep(rule.delay_ms / 1e3 if rule.delay_ms else 3600.0)
+            handler.connection.close()
+    except OSError:
+        pass  # the client gave up mid-fault; that's the point
+    return True

@@ -1,0 +1,563 @@
+"""Fault-tolerant serving control plane: the policy objects behind the
+routing front door.
+
+The observability PRs built the instruments (fleet-merged latency
+histograms, per-attempt traces, error counters); this module is the first
+subsystem that *consumes* them to keep serving dependable (ROADMAP open
+item 4). Design follows Dean & Barroso, *The Tail at Scale* (hedged
+requests against stragglers) and the SRE retry-budget / circuit-breaker
+literature (Nygard, *Release It!*):
+
+- :class:`FleetHealth` + :class:`HealthProber` — the per-worker state
+  machine ``healthy -> suspect -> evicted -> probing -> healthy``. Eviction
+  is no longer permanent: evicted workers are probed (``GET /metrics``, the
+  existing cheap liveness endpoint) on jittered exponential backoff and
+  re-admitted when they answer, so a worker restart heals the fleet
+  instead of shrinking it.
+- :class:`BreakerBoard` — per-worker circuit breakers
+  (``closed -> open -> half_open``) driven by the observed error rate over
+  a sliding window plus a slow-attempt criterion derived from the live
+  per-attempt latency histogram.
+- :class:`RetryBudget` — a fleet-wide sliding-window budget so failover
+  retries and hedges stay ≤ ``ratio`` × primary requests (plus a small
+  floor): brownout failover cannot amplify into a retry storm. Denied
+  retries fail fast with a distinct status + counter at the router.
+- :class:`HedgePolicy` — the hedge delay, derived from the live
+  per-attempt latency histogram (p95 by default, TTL-cached), clamped so a
+  cold histogram still hedges sensibly.
+- Deadline helpers — requests carry an **absolute** deadline in the
+  ``X-SMT-Deadline-Ms`` header (epoch milliseconds — wall clock on
+  purpose: it must mean the same thing in the router and in every worker
+  process on the host). The router defaults it from its own timeout and
+  propagates it; workers shed queued work whose deadline already passed
+  and 429 work they cannot finish in time (``io/serving.py``).
+
+Stdlib-only, import-pure (the no-jax-at-import gate covers this module);
+every knob is overridable via the ``SMT_*`` environment so fleets can be
+tuned without code changes (knob table: ``docs/serving.md``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import random
+import threading
+import time
+import urllib.request
+from collections import deque
+from typing import Callable, Dict, List, Mapping, Optional
+
+from ..core.telemetry import get_logger
+from . import faultinject
+
+__all__ = [
+    "BREAKER_STATES",
+    "BreakerBoard",
+    "DEADLINE_HEADER",
+    "FleetHealth",
+    "HealthProber",
+    "HedgePolicy",
+    "ResilienceConfig",
+    "RetryBudget",
+    "WORKER_STATES",
+    "inject_deadline",
+    "parse_deadline",
+    "remaining_s",
+]
+
+_logger = get_logger("io.resilience")
+
+# ---------------------------------------------------------------------------
+# deadlines
+# ---------------------------------------------------------------------------
+
+DEADLINE_HEADER = "X-SMT-Deadline-Ms"
+
+
+def parse_deadline(headers: Optional[Mapping[str, str]]) -> Optional[float]:
+    """The absolute deadline in epoch SECONDS from ``X-SMT-Deadline-Ms``
+    (epoch milliseconds), case-insensitively; None when absent/garbage —
+    a malformed deadline must degrade to "no deadline", never to an
+    error."""
+    if headers is None:
+        return None
+    value = None
+    for k in (DEADLINE_HEADER, DEADLINE_HEADER.lower()):
+        value = headers.get(k)
+        if value is not None:
+            break
+    if value is None:
+        low = DEADLINE_HEADER.lower()
+        for k, v in headers.items():
+            if k.lower() == low:
+                value = v
+                break
+    if value is None:
+        return None
+    try:
+        return float(value) / 1e3
+    except (TypeError, ValueError):
+        return None
+
+
+def remaining_s(deadline: Optional[float]) -> Optional[float]:
+    """Seconds left until ``deadline`` (may be negative); None for none."""
+    if deadline is None:
+        return None
+    return deadline - time.time()
+
+
+def inject_deadline(headers: Dict[str, str], deadline: float
+                    ) -> Dict[str, str]:
+    """Stamp the absolute deadline header (replacing any existing spelling
+    of it); returns ``headers`` for chaining."""
+    low = DEADLINE_HEADER.lower()
+    for k in [k for k in headers if k.lower() == low]:
+        del headers[k]
+    headers[DEADLINE_HEADER] = str(int(deadline * 1e3))
+    return headers
+
+
+# ---------------------------------------------------------------------------
+# configuration
+# ---------------------------------------------------------------------------
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, default))
+    except (TypeError, ValueError):
+        return default
+
+
+@dataclasses.dataclass
+class ResilienceConfig:
+    """Every control-plane knob in one bag (env spellings in
+    :meth:`from_env`; the routing server builds one per instance so tests
+    can pin aggressive values without touching the process environment)."""
+
+    # health / re-admission probing
+    evict_after: int = 2            # consecutive contact failures -> evicted
+    probe_base_s: float = 0.5       # first probe backoff after eviction
+    probe_max_s: float = 15.0       # backoff cap
+    probe_jitter: float = 0.2       # +/- fraction of jitter per backoff
+    probe_timeout_s: float = 2.0    # GET /metrics liveness probe timeout
+    # circuit breakers
+    breaker_threshold: float = 0.5  # error fraction that opens the breaker
+    breaker_window_s: float = 10.0  # sliding outcome window
+    breaker_min_volume: int = 8     # outcomes required before judging
+    breaker_open_s: float = 1.0     # first open cooldown
+    breaker_open_max_s: float = 30.0
+    breaker_slow_factor: float = 8.0  # attempt slower than factor*p95 = fail
+    # retry budget (failover re-sends AND hedges draw from it)
+    retry_budget_ratio: float = 0.2
+    retry_budget_window_s: float = 10.0
+    retry_budget_floor: int = 10    # always-allowed retries per window
+    # hedged requests (idempotent methods only)
+    hedge_enabled: bool = True
+    hedge_quantile: float = 0.95
+    hedge_delay_s: Optional[float] = None  # fixed override; None = derive
+    hedge_min_delay_s: float = 0.005
+    hedge_ttl_s: float = 1.0        # quantile cache TTL
+    seed: Optional[int] = None      # pins probe jitter for tests
+
+    @classmethod
+    def from_env(cls) -> "ResilienceConfig":
+        c = cls()
+        c.evict_after = int(_env_float("SMT_EVICT_AFTER", c.evict_after))
+        c.probe_base_s = _env_float("SMT_PROBE_BASE_S", c.probe_base_s)
+        c.probe_max_s = _env_float("SMT_PROBE_MAX_S", c.probe_max_s)
+        c.breaker_threshold = _env_float("SMT_BREAKER_THRESHOLD",
+                                         c.breaker_threshold)
+        c.breaker_open_s = _env_float("SMT_BREAKER_OPEN_S", c.breaker_open_s)
+        c.retry_budget_ratio = _env_float("SMT_RETRY_BUDGET",
+                                          c.retry_budget_ratio)
+        c.retry_budget_floor = int(_env_float("SMT_RETRY_BUDGET_FLOOR",
+                                              c.retry_budget_floor))
+        c.hedge_enabled = _env_float("SMT_HEDGE", 1.0) != 0.0
+        c.hedge_quantile = _env_float("SMT_HEDGE_QUANTILE", c.hedge_quantile)
+        delay_ms = _env_float("SMT_HEDGE_DELAY_MS", -1.0)
+        if delay_ms >= 0:
+            c.hedge_delay_s = delay_ms / 1e3
+        return c
+
+
+# ---------------------------------------------------------------------------
+# worker health state machine + re-admission prober
+# ---------------------------------------------------------------------------
+
+HEALTHY, SUSPECT, EVICTED, PROBING = ("healthy", "suspect", "evicted",
+                                      "probing")
+WORKER_STATES = (HEALTHY, SUSPECT, EVICTED, PROBING)
+
+
+class FleetHealth:
+    """Per-worker contact-health state machine.
+
+    ``healthy -> suspect`` on a contact failure (connection refused/reset —
+    NOT timeouts or 5xx: an answering worker is alive), ``suspect ->
+    evicted`` after ``evict_after`` consecutive failures, ``evicted ->
+    probing -> healthy`` through the :class:`HealthProber`. Success from
+    any routed attempt snaps the worker back to healthy."""
+
+    def __init__(self, cfg: ResilienceConfig):
+        self.cfg = cfg
+        self._lock = threading.Lock()
+        # target -> {state, failures, backoff_s, next_probe (monotonic)}
+        self._workers: Dict[str, dict] = {}
+        self._rng = random.Random(cfg.seed)
+
+    def _entry(self, target: str) -> dict:
+        w = self._workers.get(target)
+        if w is None:
+            w = self._workers[target] = {
+                "state": HEALTHY, "failures": 0,
+                "backoff_s": self.cfg.probe_base_s, "next_probe": 0.0}
+        return w
+
+    def _jittered(self, backoff: float) -> float:
+        j = self.cfg.probe_jitter
+        return backoff * (1.0 + j * (2.0 * self._rng.random() - 1.0))
+
+    def state(self, target: str) -> str:
+        with self._lock:
+            w = self._workers.get(target)
+            return w["state"] if w else HEALTHY
+
+    def states(self) -> Dict[str, str]:
+        with self._lock:
+            return {t: w["state"] for t, w in self._workers.items()}
+
+    def record_success(self, target: str) -> None:
+        with self._lock:
+            w = self._entry(target)
+            w["state"] = HEALTHY
+            w["failures"] = 0
+            w["backoff_s"] = self.cfg.probe_base_s
+
+    def record_failure(self, target: str) -> bool:
+        """A contact failure; True exactly when this one transitions the
+        worker to EVICTED (the caller unregisters it and bumps the
+        eviction counter)."""
+        with self._lock:
+            w = self._entry(target)
+            if w["state"] in (EVICTED, PROBING):
+                return False
+            w["failures"] += 1
+            if w["failures"] >= self.cfg.evict_after:
+                w["state"] = EVICTED
+                w["backoff_s"] = self.cfg.probe_base_s
+                w["next_probe"] = time.monotonic() + \
+                    self._jittered(w["backoff_s"])
+                return True
+            w["state"] = SUSPECT
+            return False
+
+    def due_probes(self, now: Optional[float] = None) -> List[str]:
+        """Evicted targets whose backoff elapsed; they move to PROBING and
+        belong to the caller until ``probe_failed``/``readmit``."""
+        if now is None:
+            now = time.monotonic()
+        due = []
+        with self._lock:
+            for t, w in self._workers.items():
+                if w["state"] == EVICTED and w["next_probe"] <= now:
+                    w["state"] = PROBING
+                    due.append(t)
+        return due
+
+    def probe_failed(self, target: str) -> None:
+        with self._lock:
+            w = self._entry(target)
+            w["state"] = EVICTED
+            w["backoff_s"] = min(w["backoff_s"] * 2.0, self.cfg.probe_max_s)
+            w["next_probe"] = time.monotonic() + self._jittered(w["backoff_s"])
+
+    def readmit(self, target: str) -> None:
+        self.record_success(target)
+
+
+class HealthProber:
+    """Background re-admission loop: probes due evicted workers with a
+    cheap ``GET /metrics`` and hands successes to ``on_readmit`` (the
+    router re-registers, resets the breaker, counts). One daemon thread
+    per router; probes run serially — a wedged probe costs its own
+    ``probe_timeout_s``, never a request's."""
+
+    def __init__(self, health: FleetHealth, cfg: ResilienceConfig,
+                 on_readmit: Callable[[str], None], tick_s: float = 0.1):
+        self.health = health
+        self.cfg = cfg
+        self.on_readmit = on_readmit
+        self.tick_s = tick_s
+        self._stop = threading.Event()
+        self.thread = threading.Thread(target=self._run,
+                                       name="routing-prober", daemon=True)
+
+    def start(self) -> "HealthProber":
+        self.thread.start()
+        return self
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.tick_s):
+            for target in self.health.due_probes():
+                if self._stop.is_set():
+                    return
+                self._probe(target)
+
+    def _probe(self, target: str) -> None:
+        rule = faultinject.act("router.probe", target)
+        try:
+            if rule is not None:
+                faultinject.raise_transport_fault(
+                    rule, target, timeout=self.cfg.probe_timeout_s)
+            with urllib.request.urlopen(
+                    target + "/metrics",
+                    timeout=self.cfg.probe_timeout_s) as r:
+                r.read()
+        except Exception:
+            self.health.probe_failed(target)
+            return
+        self.health.readmit(target)
+        try:
+            self.on_readmit(target)
+        except Exception:  # a broken callback must not kill the prober
+            _logger.exception("re-admission callback failed for %s", target)
+
+    def request_stop(self) -> None:
+        """Signal the loop to exit; the caller joins ``self.thread`` (the
+        router routes the join through ``serving.join_or_leak`` so a
+        wedged prober is logged + counted, never silently leaked)."""
+        self._stop.set()
+
+    def stop(self, join_timeout: float = 2.0) -> bool:
+        """Stop and join; False when the thread failed to exit."""
+        self.request_stop()
+        self.thread.join(join_timeout)
+        return not self.thread.is_alive()
+
+
+# ---------------------------------------------------------------------------
+# circuit breakers
+# ---------------------------------------------------------------------------
+
+CLOSED, OPEN, HALF_OPEN = "closed", "open", "half_open"
+BREAKER_STATES = (CLOSED, OPEN, HALF_OPEN)
+
+
+class _Breaker:
+    __slots__ = ("state", "window", "opened_at", "open_for_s", "trial")
+
+    def __init__(self, open_s: float):
+        self.state = CLOSED
+        self.window: deque = deque()  # (monotonic ts, ok)
+        self.opened_at = 0.0
+        self.open_for_s = open_s
+        self.trial = False  # a half-open trial request is in flight
+
+
+class BreakerBoard:
+    """Per-worker circuit breakers over a sliding outcome window.
+
+    An attempt counts as a failure when it errored (5xx / timeout /
+    contact failure) OR took longer than ``slow_s()`` (a callable the
+    router wires to the live per-attempt latency histogram —
+    ``breaker_slow_factor`` × p95). ``closed`` opens at
+    ``breaker_threshold`` error fraction with at least
+    ``breaker_min_volume`` outcomes; after the (exponentially growing)
+    cooldown exactly one half-open trial runs — success closes, failure
+    re-opens."""
+
+    def __init__(self, cfg: ResilienceConfig,
+                 slow_s: Optional[Callable[[], Optional[float]]] = None,
+                 on_transition: Optional[Callable[[str, str], None]] = None):
+        self.cfg = cfg
+        self._slow_s = slow_s
+        self._on_transition = on_transition
+        self._lock = threading.Lock()
+        self._breakers: Dict[str, _Breaker] = {}
+
+    def _transition(self, target: str, b: _Breaker, state: str) -> None:
+        b.state = state
+        if self._on_transition is not None:
+            self._on_transition(target, state)
+
+    def allow(self, target: str) -> bool:
+        """May a request be sent to ``target`` right now? (Open breakers
+        let ONE trial through per cooldown expiry.)"""
+        now = time.monotonic()
+        with self._lock:
+            b = self._breakers.get(target)
+            if b is None or b.state == CLOSED:
+                return True
+            if b.state == OPEN:
+                if now - b.opened_at >= b.open_for_s:
+                    self._transition(target, b, HALF_OPEN)
+                    b.trial = True
+                    return True
+                return False
+            # half-open: exactly one in-flight trial at a time
+            if b.trial:
+                return False
+            b.trial = True
+            return True
+
+    def on_result(self, target: str, ok: bool,
+                  latency_s: Optional[float] = None) -> None:
+        if ok and latency_s is not None and self._slow_s is not None:
+            slow = self._slow_s()
+            if slow is not None and latency_s > slow:
+                ok = False  # answered, but tail-toxically late
+        now = time.monotonic()
+        with self._lock:
+            b = self._breakers.get(target)
+            if b is None:
+                b = self._breakers[target] = _Breaker(
+                    self.cfg.breaker_open_s)
+            if b.state == HALF_OPEN:
+                b.trial = False
+                if ok:
+                    b.window.clear()
+                    b.open_for_s = self.cfg.breaker_open_s
+                    self._transition(target, b, CLOSED)
+                else:
+                    b.opened_at = now
+                    b.open_for_s = min(b.open_for_s * 2.0,
+                                       self.cfg.breaker_open_max_s)
+                    self._transition(target, b, OPEN)
+                return
+            b.window.append((now, ok))
+            horizon = now - self.cfg.breaker_window_s
+            while b.window and b.window[0][0] < horizon:
+                b.window.popleft()
+            if b.state != CLOSED:
+                return
+            n = len(b.window)
+            if n < self.cfg.breaker_min_volume:
+                return
+            errs = sum(1 for _, o in b.window if not o)
+            if errs / n >= self.cfg.breaker_threshold:
+                b.opened_at = now
+                self._transition(target, b, OPEN)
+
+    def release(self, target: str) -> None:
+        """Return an UNUSED half-open trial slot: the caller consumed
+        ``allow()`` but never actually sent the attempt (retry-budget
+        denial, deadline expiry before send, a hedge leg cancelled before
+        it started). No outcome is recorded — the breaker stays half-open
+        awaiting a real trial. Without this, a leaked trial token would
+        make ``allow()`` return False forever and black the worker out
+        permanently (it was never contact-evicted, so the prober would
+        never touch it either)."""
+        with self._lock:
+            b = self._breakers.get(target)
+            if b is not None and b.state == HALF_OPEN:
+                b.trial = False
+
+    def reset(self, target: str) -> None:
+        """Forget a worker's history (a freshly re-admitted worker starts
+        with a clean closed breaker)."""
+        with self._lock:
+            self._breakers.pop(target, None)
+
+    def state(self, target: str) -> str:
+        with self._lock:
+            b = self._breakers.get(target)
+            return b.state if b else CLOSED
+
+    def states(self) -> Dict[str, str]:
+        with self._lock:
+            return {t: b.state for t, b in self._breakers.items()}
+
+
+# ---------------------------------------------------------------------------
+# retry budget
+# ---------------------------------------------------------------------------
+
+class RetryBudget:
+    """Fleet-wide sliding-window retry budget (the SRE pattern): at any
+    moment, retries-plus-hedges spent in the last ``window_s`` stay ≤
+    ``ratio`` × primary requests in the same window + ``floor``. The floor
+    keeps small fleets functional (a 3-request test must still fail over);
+    the ratio is what stops a brownout from amplifying offered load."""
+
+    def __init__(self, cfg: ResilienceConfig):
+        self.cfg = cfg
+        self._lock = threading.Lock()
+        self._primaries: deque = deque()
+        self._retries: deque = deque()
+
+    def _prune(self, now: float) -> None:
+        horizon = now - self.cfg.retry_budget_window_s
+        for q in (self._primaries, self._retries):
+            while q and q[0] < horizon:
+                q.popleft()
+
+    def note_primary(self) -> None:
+        now = time.monotonic()
+        with self._lock:
+            self._primaries.append(now)
+            self._prune(now)
+
+    def try_spend(self) -> bool:
+        """Reserve one retry/hedge token; False = denied (the caller fails
+        fast with the distinct budget status + counter)."""
+        now = time.monotonic()
+        with self._lock:
+            self._prune(now)
+            allowed = (self.cfg.retry_budget_ratio * len(self._primaries)
+                       + self.cfg.retry_budget_floor)
+            if len(self._retries) + 1 > allowed:
+                return False
+            self._retries.append(now)
+            return True
+
+    def spent(self) -> int:
+        with self._lock:
+            self._prune(time.monotonic())
+            return len(self._retries)
+
+
+# ---------------------------------------------------------------------------
+# hedging
+# ---------------------------------------------------------------------------
+
+class HedgePolicy:
+    """The hedge-fire delay, derived from the LIVE per-attempt latency
+    histogram the router records (``smt_routing_attempt_latency_seconds``):
+    p95 by default, cached for ``hedge_ttl_s``, clamped to
+    [``hedge_min_delay_s``, timeout/2]. A cold histogram (no attempts yet)
+    falls back to ``min(0.05, timeout/4)``. Also derives the breaker's
+    slow-attempt criterion (``breaker_slow_factor`` × p95)."""
+
+    def __init__(self, cfg: ResilienceConfig, series):
+        self.cfg = cfg
+        self._series = series  # a metrics histogram series (.quantile)
+        self._lock = threading.Lock()
+        self._cached: Optional[float] = None  # the raw quantile
+        self._cached_at = 0.0
+
+    def _quantile(self) -> Optional[float]:
+        now = time.monotonic()
+        with self._lock:
+            if now - self._cached_at < self.cfg.hedge_ttl_s:
+                return self._cached
+        q = self._series.quantile(self.cfg.hedge_quantile)
+        with self._lock:
+            self._cached = q
+            self._cached_at = now
+            return q
+
+    def delay_s(self, timeout: float) -> float:
+        if self.cfg.hedge_delay_s is not None:
+            return self.cfg.hedge_delay_s
+        q = self._quantile()
+        if q is None:
+            return min(0.05, timeout / 4.0)
+        return min(max(q, self.cfg.hedge_min_delay_s), timeout / 2.0)
+
+    def slow_s(self) -> Optional[float]:
+        q = self._quantile()
+        if q is None:
+            return None
+        return max(q * self.cfg.breaker_slow_factor, 1.0)

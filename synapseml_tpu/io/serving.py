@@ -35,24 +35,31 @@ from ..observability import OPENMETRICS_CONTENT_TYPE as \
 from ..observability import (get_registry, render_openmetrics,
                              render_prometheus, tracing)
 from ..runtime.shared import shared_singleton
+from . import faultinject
 from .http_schema import HTTPRequestData, HTTPResponseData
+from .resilience import parse_deadline, remaining_s
 
 __all__ = ["ServingServer", "MicroBatchServingEngine", "serve",
            "serve_metrics_exposition", "serve_traces_exposition",
-           "serve_timeline_exposition", "request_to_string",
+           "serve_timeline_exposition", "join_or_leak", "request_to_string",
            "string_to_response"]
 
 _logger = get_logger("io.serving")
 
 
 class _Pending:
-    __slots__ = ("request", "response", "event", "t_enqueue", "trace")
+    __slots__ = ("request", "response", "event", "t_enqueue", "trace",
+                 "deadline")
 
-    def __init__(self, request: HTTPRequestData):
+    def __init__(self, request: HTTPRequestData,
+                 deadline: Optional[float] = None):
         self.request = request
         self.response: Optional[HTTPResponseData] = None
         self.event = threading.Event()
         self.t_enqueue = time.perf_counter()
+        # absolute deadline (epoch seconds) parsed from X-SMT-Deadline-Ms;
+        # None = the request carries no deadline (legacy clients)
+        self.deadline = deadline
         # server-side request span (enqueue -> reply); begun in the handler
         # thread, ended in respond() — continues the client's traceparent
         # when one arrived, else roots a fresh trace
@@ -82,11 +89,26 @@ class ServingServer:
         # never occupy a batch slot or 500 deep inside a worker pipeline
         self.admission_schema = None
         self.admission_rejections = 0
+        # deadline-aware shedding state: a per-request service-time EWMA
+        # (reported by the engines per processed batch) drives the
+        # queue-wait estimate behind the 429 admission check. Written only
+        # by the single engine thread; read lock-free in handler threads
+        # (a stale float makes the estimate slightly stale, never wrong).
+        self._svc_ewma_s: Optional[float] = None
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
             def _handle(self, method: str):
                 op_path = self.path.partition("?")[0]
+                # chaos seam: a fault plan (io/faultinject.py) can wedge,
+                # 5xx, disconnect or delay THIS worker's handling — how the
+                # router's breakers/hedges/failover are exercised in CI
+                rule = faultinject.act(
+                    "server.handle",
+                    f"{outer.server_label} {method} {op_path}")
+                if rule is not None and faultinject.apply_server_fault(
+                        rule, self):
+                    return
                 if method == "GET" and op_path == "/metrics":
                     # answered by the SERVER, not the pipeline: scrapes must
                     # work even when the engine is wedged, and must never
@@ -105,6 +127,38 @@ class ServingServer:
                     return
                 length = int(self.headers.get("Content-Length") or 0)
                 body = self.rfile.read(length) if length else None
+                # deadline-aware load shedding AT THE DOOR: work that
+                # cannot possibly answer in time must never occupy a batch
+                # slot. Requests without the deadline header (legacy
+                # clients talking straight to a worker) keep the old
+                # behavior; the routing front door always stamps one.
+                deadline = parse_deadline(self.headers)
+                if deadline is not None:
+                    rem = remaining_s(deadline)
+                    if rem <= 0:
+                        outer._shed("expired", count_received=True)
+                        try:
+                            self.send_error(504, "deadline already expired")
+                        except OSError:
+                            pass
+                        return
+                    est = outer.estimated_queue_wait_s()
+                    if est > rem:
+                        # the queue ahead of this request already costs
+                        # more than its remaining deadline: answer 429 now
+                        # with honest backpressure instead of a doomed 504
+                        # at the deadline — bounded p99 under overload
+                        outer._shed("overload", count_received=True)
+                        try:
+                            self.send_response(429)
+                            self.send_header(
+                                "Retry-After",
+                                str(max(1, int(est - rem) + 1)))
+                            self.send_header("Content-Length", "0")
+                            self.end_headers()
+                        except OSError:
+                            pass
+                        return
                 if method == "POST" and outer.admission_schema is not None:
                     errs = admission_errors(outer.admission_schema, body)
                     if errs:
@@ -132,7 +186,7 @@ class ServingServer:
                     url=self.path, method=method,
                     headers=dict(self.headers.items()), entity=body)
                 rid = uuid.uuid4().hex
-                slot = _Pending(req)
+                slot = _Pending(req, deadline=deadline)
                 if tracing.is_enabled():
                     slot.trace = tracing.get_tracer().begin_span(
                         "request",
@@ -144,7 +198,12 @@ class ServingServer:
                     outer._queue.append(rid)
                     outer.requests_received += 1
                 outer._on_enqueue()
-                if not slot.event.wait(outer.reply_timeout):
+                # never park past the request's own deadline: a client with
+                # 200ms left gets its 504 in 200ms, not reply_timeout later
+                wait_s = outer.reply_timeout
+                if deadline is not None:
+                    wait_s = max(0.0, min(wait_s, remaining_s(deadline)))
+                if not slot.event.wait(wait_s):
                     # the pop decides the race: whoever removes the slot
                     # (this handler or a concurrent respond()) owns its
                     # finalization — both ending the trace span would let
@@ -153,6 +212,13 @@ class ServingServer:
                     with outer._lock:
                         won = outer._pending.pop(rid, None) is not None
                     if won:
+                        if (deadline is not None
+                                and time.time() >= deadline):
+                            # the 504 below is the DEADLINE firing (the
+                            # wait was deadline-bounded): count the shed
+                            # here — the drain-time path only sees slots
+                            # this handler has not already reclaimed
+                            outer._shed("expired")
                         if slot.trace is not None:
                             slot.trace.set_attribute("status", 504)
                             slot.trace.end(error="serving engine timed out")
@@ -232,6 +298,13 @@ class ServingServer:
             "smt_serving_admission_rejections_total",
             "POST bodies answered 400 by schema admission",
             ("server",)).labels(self.server_label)
+        # deadline shedding: "expired" = the deadline passed (504 at the
+        # door or in the queue), "overload" = the queue-wait estimate
+        # exceeded the remaining deadline (429 + Retry-After)
+        self._m_shed = reg.counter(
+            "smt_serving_shed_total",
+            "requests shed by deadline-aware admission",
+            ("server", "reason"))
         reg.register_collector(self._collect_metrics)
         # device-memory gauges sync at scrape time (graceful no-op until a
         # backend with allocator stats exists): every worker's /metrics
@@ -257,14 +330,62 @@ class ServingServer:
     def address(self) -> str:
         return f"http://{self.host}:{self.port}"
 
+    def _shed(self, reason: str, count_received: bool = False) -> None:
+        """Count one shed request (and, for door-side sheds, the receive —
+        handler threads that return early never hit the normal counters)."""
+        if count_received:
+            with self._lock:
+                self.requests_received += 1
+        self._m_shed.labels(self.server_label, reason).inc()
+
+    def note_batch(self, n_requests: int, seconds: float) -> None:
+        """Engines report each processed batch here; feeds the per-request
+        service-time EWMA behind ``estimated_queue_wait_s``."""
+        if n_requests <= 0 or seconds < 0:
+            return
+        per = seconds / n_requests
+        cur = self._svc_ewma_s
+        self._svc_ewma_s = per if cur is None else 0.8 * cur + 0.2 * per
+
+    def estimated_queue_wait_s(self) -> float:
+        """Queue depth × observed per-request service time (from the
+        engines' per-batch reports): what a request admitted NOW would wait
+        before its reply starts. 0.0 until the first batch completes — the
+        estimator must never shed on ignorance."""
+        svc = self._svc_ewma_s
+        if svc is None:
+            return 0.0
+        return len(self._queue) * svc
+
     def get_requests(self, max_n: Optional[int] = None
                      ) -> List[Tuple[str, HTTPRequestData]]:
-        """Drain up to ``max_n`` queued request ids (the getBatch analogue)."""
+        """Drain up to ``max_n`` queued request ids (the getBatch analogue).
+
+        Queued work whose deadline already passed is shed HERE — answered
+        504 immediately and never handed to the engine, so an expired
+        request cannot occupy a batch slot ahead of in-deadline work."""
+        now = time.time()
+        expired: List[_Pending] = []
+        out: List[Tuple[str, HTTPRequestData]] = []
         with self._lock:
             take = self._queue if max_n is None else self._queue[:max_n]
-            out = [(rid, self._pending[rid].request) for rid in take
-                   if rid in self._pending]
+            for rid in take:
+                slot = self._pending.get(rid)
+                if slot is None:
+                    continue
+                if slot.deadline is not None and slot.deadline <= now:
+                    # claim the slot HERE (the pop decides the race, same
+                    # rule as respond vs the handler timeout): whoever
+                    # pops owns finalization, so the shed is counted once
+                    self._pending.pop(rid)
+                    expired.append(slot)
+                else:
+                    out.append((rid, slot.request))
             del self._queue[:len(take)]
+        for slot in expired:
+            self._shed("expired")
+            self._finish(slot, HTTPResponseData(
+                504, "deadline expired in queue"))
         return out
 
     def _trace_slots(self, rids) -> List[_Pending]:
@@ -280,6 +401,11 @@ class ServingServer:
         if slot is None:
             _logger.warning("respond: unknown or timed-out request %s", rid)
             return
+        self._finish(slot, response)
+
+    def _finish(self, slot: _Pending, response: HTTPResponseData) -> None:
+        """Finalize an already-claimed slot (the caller popped it from
+        ``_pending``): release the handler thread, record latency + trace."""
         slot.response = response
         slot.event.set()
         lat = time.perf_counter() - slot.t_enqueue
@@ -329,6 +455,28 @@ class ServingServer:
         for series in (self._m_requests, self._m_responses, self._m_latency,
                        self._m_admission_rejects):
             series.remove()
+        for reason in ("expired", "overload"):
+            self._m_shed.remove(self.server_label, reason)
+
+
+def join_or_leak(thread: threading.Thread, timeout: float,
+                 component: str) -> bool:
+    """Join ``thread``; when it fails to exit within ``timeout`` (a wedged
+    dispatcher/accept loop), LOG it and count it in
+    ``smt_thread_leaks_total{component}`` instead of silently leaking —
+    the process-fleet tests assert clean shutdown by this family staying
+    empty. Returns True on a clean join."""
+    thread.join(timeout)
+    if not thread.is_alive():
+        return True
+    get_registry().counter(
+        "smt_thread_leaks_total",
+        "threads that failed to join at shutdown",
+        ("component",)).labels(component).inc()
+    _logger.warning("thread %s (%s) failed to join within %.1fs at "
+                    "shutdown; leaking it as a daemon", thread.name,
+                    component, timeout)
+    return False
 
 
 def admission_errors(schema, body: Optional[bytes]) -> List[str]:
@@ -566,6 +714,7 @@ class MicroBatchServingEngine:
             reqs = np.empty(len(batch), dtype=object)
             reqs[:] = [r for _, r in batch]
             table = Table({"id": np.array(ids, dtype=object), "request": reqs})
+            t0 = time.perf_counter()
             try:
                 with traced_batch(self.server, ids, "microbatch"):
                     out = self.pipeline.transform(table)
@@ -582,13 +731,28 @@ class MicroBatchServingEngine:
                 self._error = e
                 self._m_pipeline_errors.inc()
                 continue
-            respond_batch(self.server, ids, out_ids, replies)
+            try:
+                respond_batch(self.server, ids, out_ids, replies)
+            except Exception as e:
+                # the REPLY path failed (bad output table shape): the
+                # drained requests must still be answered, and the
+                # dispatcher thread must survive — a dead loop would leave
+                # every future request hanging to its reply timeout
+                _logger.exception("serving reply path failed")
+                for rid in ids:  # respond() ignores already-answered ids
+                    self.server.respond(rid, HTTPResponseData(
+                        500, "reply path error", entity=str(e).encode()))
+                self._error = e
+                self._m_pipeline_errors.inc()
+                continue
+            self.server.note_batch(len(batch), time.perf_counter() - t0)
             self.batches_processed += 1
 
     def stop(self) -> None:
         self._stop.set()
         self._work.set()
-        self._thread.join(timeout=5)
+        join_or_leak(self._thread, 5.0,
+                     f"serving-engine:{self.server.server_label}")
         self.server.close()
         self._m_reg.unregister_collector(self._collect_metrics)
         for series in (self._m_batches, self._m_batch_size,
@@ -601,10 +765,19 @@ class MicroBatchServingEngine:
 def respond_batch(server, batch_ids, out_ids, replies) -> None:
     """Reply to every request in the batch: pipeline outputs get their reply;
     rows the pipeline dropped/filtered get 204 immediately instead of leaving
-    the client blocked until reply_timeout -> 504."""
+    the client blocked until reply_timeout -> 504. One un-coercible reply
+    (e.g. a non-JSON-serializable object) 500s ITS row — it must not take
+    down the rest of the batch or the dispatcher loop."""
     answered = set()
     for rid, rep in zip(out_ids, replies):
-        server.respond(rid, _coerce_response(rep))
+        try:
+            resp = _coerce_response(rep)
+        except Exception as e:
+            _logger.exception("reply coercion failed for request %s", rid)
+            resp = HTTPResponseData(
+                500, "reply coercion failed",
+                entity=f"{type(e).__name__}: {e}".encode())
+        server.respond(rid, resp)
         answered.add(rid)
     for rid in batch_ids:
         if rid not in answered:

@@ -25,12 +25,17 @@ TPU-native design:
 
 from __future__ import annotations
 
+import http.client
 import json
 import threading
+import time
 import urllib.request
+from concurrent.futures import FIRST_COMPLETED
+from concurrent.futures import TimeoutError as FutureTimeout
+from concurrent.futures import ThreadPoolExecutor, wait as futures_wait
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from itertools import count
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -38,9 +43,14 @@ from ..core import Table, Transformer
 from ..core.telemetry import get_logger
 from ..observability import (get_registry, histogram_quantile,
                              merge_snapshots, merge_traces, tracing)
+from . import faultinject
 from .http_schema import HTTPResponseData
+from .resilience import (BreakerBoard, FleetHealth, HEALTHY, HealthProber,
+                         HedgePolicy, ResilienceConfig, RetryBudget,
+                         WORKER_STATES, inject_deadline, parse_deadline,
+                         remaining_s)
 from .serving import (MicroBatchServingEngine, ServingServer, engine_metrics,
-                      resolve_admission_schema, respond_batch,
+                      join_or_leak, resolve_admission_schema, respond_batch,
                       serve_metrics_exposition, serve_timeline_exposition,
                       serve_traces_exposition, traced_batch)
 
@@ -104,6 +114,7 @@ class ContinuousServingEngine:
         reqs = np.empty(len(batch), dtype=object)
         reqs[:] = [r for _, r in batch]
         table = Table({"id": np.array(ids, dtype=object), "request": reqs})
+        t0 = time.perf_counter()
         try:
             with traced_batch(self.server, ids, "continuous"):
                 out = self.pipeline.transform(table)
@@ -119,7 +130,20 @@ class ContinuousServingEngine:
             self._error = e
             self._m_pipeline_errors.inc()
             return
-        respond_batch(self.server, ids, out_ids, replies)
+        try:
+            respond_batch(self.server, ids, out_ids, replies)
+        except Exception as e:
+            # reply-path failure (malformed output table): the drained
+            # requests still get 500s NOW instead of hanging to their
+            # reply timeout, and the dispatcher loop survives
+            _logger.exception("continuous serving reply path failed")
+            for rid in ids:  # respond() ignores already-answered ids
+                self.server.respond(rid, HTTPResponseData(
+                    500, "reply path error", entity=str(e).encode()))
+            self._error = e
+            self._m_pipeline_errors.inc()
+            return
+        self.server.note_batch(len(batch), time.perf_counter() - t0)
         self.batches_processed += 1
         self.requests_processed += len(batch)
 
@@ -129,7 +153,10 @@ class ContinuousServingEngine:
     def stop(self) -> None:
         self._stop.set()
         self._work.set()
-        self._thread.join(timeout=5)
+        # a dispatcher wedged inside the pipeline would previously leak
+        # silently; now it is logged + counted (smt_thread_leaks_total)
+        join_or_leak(self._thread, 5.0,
+                     f"serving-engine:{self.server.server_label}")
         self.server.close()
         self._m_reg.unregister_collector(self._collect_metrics)
         for series in (self._m_batches, self._m_batch_size,
@@ -146,8 +173,12 @@ class ServiceRegistry:
         self._lock = threading.Lock()
 
     def register(self, name: str, address: str) -> None:
+        """Idempotent: re-registering a live address (a re-admission probe
+        racing a concurrent one) must not double its routing weight."""
         with self._lock:
-            self._services.setdefault(name, []).append(address)
+            addrs = self._services.setdefault(name, [])
+            if address not in addrs:
+                addrs.append(address)
 
     def unregister(self, name: str, address: str) -> None:
         with self._lock:
@@ -164,28 +195,57 @@ class ServiceRegistry:
 
 
 class RoutingServer:
-    """Public front door forwarding to workers round-robin (the reference's
-    load-balancer + routing-table path; round-robin per
-    ``MultiChannelMap:24-85``)."""
+    """Public front door: resilient routing over the worker fleet.
+
+    Round-robin forwarding (the reference's load-balancer + routing-table
+    path, ``MultiChannelMap:24-85``) hardened with the control plane from
+    ``io/resilience.py`` — the first consumer of the observability stack:
+
+    - **Health-probing eviction with re-admission**: a contact failure
+      marks a worker suspect; ``evict_after`` consecutive failures evict
+      it from the routing table, and a background prober re-admits it when
+      its ``/metrics`` answers again (jittered exponential backoff) — a
+      worker restart heals the fleet instead of shrinking it permanently.
+    - **Per-worker circuit breakers** over the observed error rate and
+      per-attempt latency; an open breaker skips the worker, a half-open
+      one lets a single trial through.
+    - **A fleet-wide retry budget**: failover re-sends and hedges together
+      stay ≤ ``retry_budget_ratio`` × primaries (+ floor); denied retries
+      fail fast with 503 ``retry budget exhausted`` and a counter.
+    - **Hedged requests** (idempotent methods only): when the primary has
+      not answered within the live-p95-derived hedge delay, a second
+      attempt races on another worker; the first answer wins and both
+      attempts are tagged in the trace (``hedged``/``hedge_winner``).
+    - **Deadline propagation**: every forwarded request carries an
+      absolute ``X-SMT-Deadline-Ms`` (the client's, or now + the router
+      timeout), so workers can shed work that cannot answer in time.
+    """
 
     def __init__(self, registry: ServiceRegistry, service: str,
-                 host: str = "127.0.0.1", port: int = 0, timeout: float = 30.0):
+                 host: str = "127.0.0.1", port: int = 0, timeout: float = 30.0,
+                 resilience: Optional[ResilienceConfig] = None):
         self.registry = registry
         self.service = service
         self.timeout = timeout
+        self.resilience = (resilience if resilience is not None
+                           else ResilienceConfig.from_env())
         # handler threads are concurrent (ThreadingHTTPServer): bare += on
         # these from multiple threads loses updates, so every mutation
         # takes the lock (lint SMT006 enforces the discipline from here on)
         self.requests_routed = 0
         self.workers_evicted = 0
+        self.workers_readmitted = 0
+        self.retries_denied = 0
+        self.hedges_sent = 0
+        self.hedge_wins = 0
+        self.deadline_rejected = 0
         self._lock = threading.Lock()
         self._rr = count()
+        self._state_targets: set = set()
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
             def _forward(self, method: str):
-                import socket as _socket
-
                 op_path = self.path.partition("?")[0]
                 if method == "GET" and op_path == "/metrics":
                     # the FLEET view: this front door scrapes every worker's
@@ -212,11 +272,25 @@ class RoutingServer:
                     return
                 length = int(self.headers.get("Content-Length") or 0)
                 body = self.rfile.read(length) if length else None
-                start = next(outer._rr)
+                # DEADLINE: the client's absolute X-SMT-Deadline-Ms, or
+                # now + the router timeout; propagated to the worker so
+                # its queue can shed work that cannot answer in time
+                deadline = parse_deadline(self.headers)
+                if deadline is None:
+                    deadline = time.time() + outer.timeout
+                if remaining_s(deadline) <= 0:
+                    with outer._lock:
+                        outer.deadline_rejected += 1
+                        outer.requests_routed += 1
+                    try:
+                        self.send_error(504, "deadline already expired")
+                    except OSError:
+                        pass
+                    return
                 # the ROUTED trace's root (or, when the client sent its own
                 # traceparent, the local root continuing the client trace):
-                # every worker-side span hangs off this via the header the
-                # forward loop injects
+                # every worker-side span hangs off this via the header each
+                # forward attempt injects
                 route_span = None
                 if tracing.is_enabled():
                     route_span = tracing.get_tracer().begin_span(
@@ -224,113 +298,51 @@ class RoutingServer:
                         parent=tracing.extract_context(self.headers),
                         attributes={"server": f"{outer.host}:{outer.port}",
                                     "method": method, "path": self.path})
-                # FAILOVER: a DEAD worker (connection refused/reset) is
-                # dropped from the routing table and the request retries the
-                # next one — a worker death mid-stream must not surface to
-                # clients (the reference's serving tier survives exactly
-                # this, ``HTTPv2Suite.scala:328``). A TIMEOUT merely fails
-                # over without eviction — but ONLY for idempotent methods:
-                # a timed-out worker may still complete the original
-                # request, so re-sending a POST would execute its side
-                # effects twice. Non-idempotent requests surface 504 after
-                # one timeout instead of at-least-once semantics (and the
-                # client never waits more than one timeout). Connection
-                # REFUSED is always safe to retry: the request was never
-                # received. Delivery contract: exactly-once for timeouts;
-                # AT-LEAST-ONCE when a worker DIES mid-request (a crash
-                # after execution but before the response is
-                # indistinguishable from one before it, and the reference's
-                # kill-a-worker contract requires the retry —
-                # ``HTTPv2Suite.scala:328``); worker-side request-id dedup
-                # is the escalation path if a pipeline needs strict
-                # exactly-once across crashes.
+                # Delivery contract (unchanged from the plain failover
+                # router): a DEAD worker (refused/reset) never received the
+                # request — always safe to retry; a TIMEOUT may still
+                # complete, so only idempotent methods fail over past one
+                # (hedges are idempotent-only for the same reason);
+                # AT-LEAST-ONCE when a worker dies mid-request
+                # (``HTTPv2Suite.scala:328``), worker-side request-id
+                # dedup being the escalation path for strict exactly-once.
                 idempotent = method in ("GET", "HEAD")
-                timed_out = False
-                reply = None  # (status, content_type, entity)
                 # hop-by-hop-ish headers the ROUTER owns. When tracing is
-                # ON, traceparent is replaced with the per-attempt forward
-                # span's context so the worker's spans nest under THIS hop;
-                # when tracing is OFF the client's own traceparent passes
-                # through untouched — a disabled router must not sever the
-                # client->worker trace.
+                # ON, traceparent is replaced per-attempt with the forward
+                # span's context; when tracing is OFF the client's own
+                # traceparent passes through untouched — a disabled router
+                # must not sever the client->worker trace.
                 drop = {"host", "content-length"}
                 if route_span is not None:
                     drop.add("traceparent")
                 fwd_headers = {k: v for k, v in self.headers.items()
                                if k.lower() not in drop}
-                for k in range(len(targets)):
-                    target = targets[(start + k) % len(targets)]
-                    fwd_span = None
-                    if route_span is not None:
-                        fwd_span = route_span.tracer.begin_span(
-                            "forward", parent=route_span,
-                            attributes={"target": target, "attempt": k})
-                        tracing.inject_headers(fwd_headers, fwd_span)
-                    fwd = urllib.request.Request(
-                        target + self.path, data=body, method=method,
-                        headers=dict(fwd_headers))
-                    try:
-                        with urllib.request.urlopen(
-                                fwd, timeout=outer.timeout) as r:
-                            reply = (r.status,
-                                     r.headers.get("Content-Type"), r.read())
-                        if fwd_span is not None:
-                            fwd_span.set_attribute("status", reply[0])
-                            fwd_span.end()
-                        break
-                    except urllib.error.HTTPError as e:
-                        # the worker ANSWERED (an application error): relay
-                        # it, this is not a routing fault
-                        reply = (e.code, None, e.read())
-                        if fwd_span is not None:
-                            fwd_span.set_attribute("status", e.code)
-                            fwd_span.end()
-                        break
-                    except (TimeoutError, _socket.timeout) as e:
-                        if fwd_span is not None:
-                            fwd_span.end(error=e)
-                        if not idempotent:
-                            timed_out = True
-                            break
-                        continue  # alive but slow: fail over, keep it
-                    except urllib.error.URLError as e:
-                        if fwd_span is not None:
-                            fwd_span.end(error=e)
-                        if isinstance(e.reason, (TimeoutError,
-                                                 _socket.timeout)):
-                            if not idempotent:
-                                timed_out = True
-                                break
-                            continue
-                        outer._evict(target)
-                        continue
-                    except OSError as e:
-                        if fwd_span is not None:
-                            fwd_span.end(error=e)
-                        outer._evict(target)
-                        continue
+                inject_deadline(fwd_headers, deadline)
+                start = next(outer._rr)
+                order = [targets[(start + k) % len(targets)]
+                         for k in range(len(targets))]
+                reply, fail = outer._route(order, method, self.path, body,
+                                           fwd_headers, deadline, idempotent,
+                                           route_span)
                 if route_span is not None:
                     if reply is None:
-                        route_span.set_attribute(
-                            "status", 504 if timed_out else 502)
-                        route_span.end(
-                            error="worker timed out (not retried)"
-                            if timed_out else "no reachable workers")
+                        status = {"timeout": 504, "deadline": 504,
+                                  "budget": 503}.get(fail, 502)
+                        route_span.set_attribute("status", status)
+                        route_span.end(error={
+                            "timeout": "worker timed out (not retried)",
+                            "deadline": "deadline expired during routing",
+                            "budget": "retry budget exhausted",
+                        }.get(fail, "no reachable workers"))
                     else:
                         route_span.set_attribute("status", reply[0])
                         route_span.end(error=f"HTTP {reply[0]}"
                                        if reply[0] >= 500 else None)
-                # client write OUTSIDE the failover loop: a client that
-                # hung up must not evict a healthy worker or re-send the
-                # request (duplicate side effects)
+                # client write OUTSIDE the routing machinery: a client
+                # that hung up must not evict a healthy worker or re-send
+                # the request (duplicate side effects)
                 try:
-                    if reply is None and timed_out:
-                        self.send_error(
-                            504, "worker timed out; not retried "
-                                 "(non-idempotent method)")
-                    elif reply is None:
-                        self.send_error(502, "no reachable workers")
-                    else:
+                    if reply is not None:
                         status, ct, ent = reply
                         self.send_response(status)
                         if ct:
@@ -338,6 +350,17 @@ class RoutingServer:
                         self.send_header("Content-Length", str(len(ent)))
                         self.end_headers()
                         self.wfile.write(ent)
+                    elif fail == "timeout":
+                        self.send_error(
+                            504, "worker timed out; not retried "
+                                 "(non-idempotent method)")
+                    elif fail == "deadline":
+                        self.send_error(504, "deadline expired during "
+                                             "routing")
+                    elif fail == "budget":
+                        self.send_error(503, "retry budget exhausted")
+                    else:
+                        self.send_error(502, "no reachable workers")
                 except OSError:
                     pass  # client went away; the reply is simply dropped
                 with outer._lock:
@@ -357,7 +380,8 @@ class RoutingServer:
 
         self._httpd = Server((host, port), Handler)
         self.host, self.port = self._httpd.server_address[:2]
-        label = f"{self.host}:{self.port}"
+        label = self.server_label = f"{self.host}:{self.port}"
+        cfg = self.resilience
         reg = self._m_reg = get_registry()
         self._m_routed = reg.counter(
             "smt_routing_requests_total", "requests forwarded to workers",
@@ -365,23 +389,330 @@ class RoutingServer:
         self._m_evicted = reg.counter(
             "smt_routing_evictions_total", "workers evicted as unreachable",
             ("server",)).labels(label)
+        self._m_readmitted = reg.counter(
+            "smt_routing_readmissions_total",
+            "evicted workers re-admitted after a successful probe",
+            ("server",)).labels(label)
+        self._m_budget_denied = reg.counter(
+            "smt_routing_retry_budget_denied_total",
+            "retries/hedges denied by the fleet retry budget",
+            ("server",)).labels(label)
+        self._m_hedges = reg.counter(
+            "smt_routing_hedges_total", "hedge requests issued",
+            ("server",)).labels(label)
+        self._m_hedge_wins = reg.counter(
+            "smt_routing_hedge_wins_total",
+            "hedged requests won by the hedge attempt",
+            ("server",)).labels(label)
+        self._m_deadline_rejected = reg.counter(
+            "smt_routing_deadline_rejected_total",
+            "requests 504'd at the door for an already-expired deadline",
+            ("server",)).labels(label)
+        # the LIVE per-attempt latency distribution: drives the hedge
+        # delay (p95) and the breaker's slow-attempt criterion — the
+        # router's own merged view over every worker it talks to
+        self._m_attempt_lat = reg.histogram(
+            "smt_routing_attempt_latency_seconds",
+            "per-forward-attempt latency",
+            ("server",)).labels(label)
+        self._m_breaker_trans = reg.counter(
+            "smt_routing_breaker_transitions_total",
+            "circuit-breaker state transitions",
+            ("server", "state"))
+        self._m_worker_state = reg.gauge(
+            "smt_routing_worker_state",
+            "per-worker health state (1 = the worker's current state)",
+            ("server", "target", "state"), merge="max")
+        # control-plane policy objects (io/resilience.py), created before
+        # the accept thread starts so handlers never race them
+        self._health = FleetHealth(cfg)
+        self._hedge_policy = HedgePolicy(cfg, self._m_attempt_lat)
+        self._breakers = BreakerBoard(cfg, slow_s=self._hedge_policy.slow_s,
+                                      on_transition=self._breaker_transition)
+        self._budget = RetryBudget(cfg)
+        self._pool = ThreadPoolExecutor(
+            max_workers=16, thread_name_prefix=f"routing-hedge-{self.port}")
         # synced from the plain ints at snapshot time (hot-path-free)
         reg.register_collector(self._collect_metrics)
         self._thread = threading.Thread(target=self._httpd.serve_forever,
                                         name=f"routing-{self.port}", daemon=True)
         self._thread.start()
+        self._prober = HealthProber(self._health, cfg, self._readmit).start()
 
-    def _evict(self, target: str) -> None:
-        """Drop an unreachable worker from the routing table (called from
-        concurrent handler threads — the counter bump takes the lock)."""
-        self.registry.unregister(self.service, target)
+    # -- control-plane callbacks -------------------------------------------
+    def _note_dead(self, target: str) -> None:
+        """A contact failure (refused/reset — the request never ran).
+        Eviction is NO LONGER permanent: the prober re-admits the worker
+        when its /metrics answers again."""
+        if self._health.record_failure(target):
+            self.registry.unregister(self.service, target)
+            with self._lock:
+                self.workers_evicted += 1
+            _logger.warning("evicted unreachable worker %s "
+                            "(probing for re-admission)", target)
+
+    def _readmit(self, target: str) -> None:
+        """Prober callback: the evicted worker answered its liveness probe
+        — put it back in the routing table with a clean breaker."""
+        self.registry.register(self.service, target)
+        self._breakers.reset(target)
         with self._lock:
-            self.workers_evicted += 1
-        _logger.warning("evicted unreachable worker %s", target)
+            self.workers_readmitted += 1
+        _logger.info("re-admitted worker %s after a successful probe", target)
+
+    def _breaker_transition(self, target: str, state: str) -> None:
+        self._m_breaker_trans.labels(self.server_label, state).inc()
+        _logger.info("circuit breaker for %s -> %s", target, state)
+
+    # -- routing core ------------------------------------------------------
+    def _route(self, order: List[str], method: str, path: str,
+               body: Optional[bytes], headers: Dict[str, str],
+               deadline: float, idempotent: bool, route_span
+               ) -> Tuple[Optional[tuple], Optional[str]]:
+        """Walk the candidates with breaker-gated, budget-limited failover
+        (and a hedged first attempt for idempotent methods). Returns
+        ``(reply, fail)``: a ``(status, content_type, entity)`` reply, or
+        ``fail`` in ``timeout | budget | deadline | unreachable``."""
+        cfg = self.resilience
+        attempted = 0
+        tried_as_hedge: set = set()
+        for i, target in enumerate(order):
+            if target in tried_as_hedge:
+                # already attempted (and failed) as a hedge leg — a second
+                # send would waste budget on a known-bad worker
+                continue
+            rem = remaining_s(deadline)
+            if rem is not None and rem <= 0:
+                return None, "deadline"
+            if not self._breakers.allow(target):
+                continue  # skipped, never sent: costs no budget
+            if attempted == 0:
+                self._budget.note_primary()
+            elif not self._budget.try_spend():
+                # fleet-wide retry budget exhausted: fail FAST — failover
+                # under brownout must not amplify offered load into a
+                # retry storm (the distinct 503 + counter is the signal).
+                # The allow() slot was consumed but nothing will be sent.
+                self._breakers.release(target)
+                with self._lock:
+                    self.retries_denied += 1
+                return None, "budget"
+            alternates = order[i + 1:]
+            if (attempted == 0 and idempotent and cfg.hedge_enabled
+                    and alternates):
+                kind, reply = self._hedged_attempt(
+                    target, alternates, method, path, body, headers,
+                    deadline, route_span, tried_as_hedge)
+            else:
+                kind, reply = self._attempt(target, method, path, body,
+                                            headers, deadline, route_span,
+                                            attempted)
+            attempted += 1
+            if kind == "reply":
+                return reply, None
+            if kind == "deadline":
+                # the attempt was never sent (deadline expired first): the
+                # accurate answer is 504-deadline, NOT 504-timeout — a
+                # non-idempotent client must not be told its request may
+                # have executed when nothing went on the wire
+                return None, "deadline"
+            if kind == "timeout" and not idempotent:
+                return None, "timeout"
+            # timeout (idempotent) or dead: fail over to the next candidate
+        return None, "unreachable"
+
+    def _attempt(self, target: str, method: str, path: str,
+                 body: Optional[bytes], headers: Dict[str, str],
+                 deadline: float, route_span, attempt: int,
+                 hedge: bool = False) -> Tuple[str, Optional[tuple]]:
+        """One forward attempt; records the breaker outcome, the health
+        transition, the attempt-latency sample, and a ``forward`` span.
+        Returns ``(kind, reply)``: ``reply`` (the worker answered —
+        application errors are relayed, 5xx feeding the breaker),
+        ``timeout`` (alive but slow; no eviction), ``dead`` (contact
+        failure; may evict), or ``deadline`` (expired before anything was
+        sent — no worker was contacted)."""
+        import socket as _socket
+
+        rem = remaining_s(deadline)
+        if rem is not None and rem <= 0:
+            # never sent: hand back the breaker trial slot allow() may
+            # have reserved, and report the accurate outcome
+            self._breakers.release(target)
+            return ("deadline", None)
+        per_attempt = max(0.001, min(self.timeout, rem))
+        fwd_span = None
+        if route_span is not None:
+            attrs = {"target": target, "attempt": attempt}
+            if hedge:
+                attrs["hedge"] = True
+            fwd_span = route_span.tracer.begin_span(
+                "forward", parent=route_span, attributes=attrs)
+            # per-attempt copy: concurrent hedge attempts must not fight
+            # over one traceparent header dict
+            headers = dict(headers)
+            tracing.inject_headers(headers, fwd_span)
+        kind: str = "dead"
+        ok = False
+        reply = None
+        error: Optional[BaseException] = None
+        t0 = time.perf_counter()
+        try:
+            rule = faultinject.act("router.forward",
+                                   f"{method} {target}{path}")
+            if rule is not None:
+                faultinject.raise_transport_fault(rule, target + path,
+                                                  timeout=per_attempt)
+            fwd = urllib.request.Request(
+                target + path, data=body, method=method,
+                headers=dict(headers))
+            with urllib.request.urlopen(fwd, timeout=per_attempt) as r:
+                reply = (r.status, r.headers.get("Content-Type"), r.read())
+            kind, ok = "reply", True
+        except urllib.error.HTTPError as e:
+            # the worker ANSWERED (an application error): relay it — not
+            # a routing fault, but 5xx counts against its breaker
+            reply = (e.code, None, e.read())
+            kind, ok = "reply", e.code < 500
+        except (TimeoutError, _socket.timeout) as e:
+            kind, error = "timeout", e
+        except urllib.error.URLError as e:
+            if isinstance(e.reason, (TimeoutError, _socket.timeout)):
+                kind, error = "timeout", e
+            else:
+                kind, error = "dead", e
+        except (OSError, http.client.HTTPException) as e:
+            # connection resets and mid-body disconnects land here
+            kind, error = "dead", e
+        latency = time.perf_counter() - t0
+        self._m_attempt_lat.observe(latency)
+        self._breakers.on_result(target, ok, latency)
+        if kind == "reply":
+            self._health.record_success(target)  # it answered: alive
+        elif kind == "dead":
+            self._note_dead(target)
+        if fwd_span is not None:
+            if kind == "reply":
+                fwd_span.set_attribute("status", reply[0])
+                fwd_span.end()
+            else:
+                fwd_span.end(error=error)
+        return (kind, reply)
+
+    def _hedged_attempt(self, primary: str, alternates: List[str],
+                        method: str, path: str, body: Optional[bytes],
+                        headers: Dict[str, str], deadline: float, route_span,
+                        tried: set) -> Tuple[str, Optional[tuple]]:
+        """Tail-at-scale hedging (Dean & Barroso): when the primary has
+        not answered within the live-p95 hedge delay, race one hedge on
+        the next breaker-allowed worker; the first worker ANSWER wins, the
+        loser is cancelled/abandoned, and both attempts are tagged in the
+        trace (``hedge`` on the attempt span, ``hedge_winner`` on the
+        route span) so ``tools/trace_dump.py`` can prove who won. Hedges
+        draw from the same retry budget as failover; the hedge target is
+        added to ``tried`` so a failed race does not re-attempt it."""
+        delay = self._hedge_policy.delay_s(self.timeout)
+        try:
+            f1 = self._pool.submit(self._attempt, primary, method, path,
+                                   body, headers, deadline, route_span,
+                                   0, False)
+        except RuntimeError:
+            # the pool is shut down (router closing with traffic in
+            # flight): degrade to a plain inline attempt, never a crash
+            return self._attempt(primary, method, path, body, headers,
+                                 deadline, route_span, 0)
+        rem = remaining_s(deadline)
+        try:
+            return f1.result(timeout=min(delay, max(rem, 0.001)))
+        except FutureTimeout:
+            pass  # the primary is straggling ... OR never started
+        if f1.cancel():
+            # the pool is saturated — the "straggler" was never even sent.
+            # Hedging a queued request is pure amplification; run the
+            # attempt inline on this handler thread instead.
+            return self._attempt(primary, method, path, body, headers,
+                                 deadline, route_span, 0)
+        hedge_target = next(
+            (t for t in alternates if self._breakers.allow(t)), None)
+        if hedge_target is None or not self._budget.try_spend():
+            if hedge_target is not None:
+                # allow() reserved a (possibly half-open) trial slot but
+                # the budget denied the send: hand the slot back
+                self._breakers.release(hedge_target)
+            # no affordable hedge: wait the primary out (bounded by the
+            # deadline plus the attempt's own timeout slack)
+            try:
+                return f1.result(
+                    timeout=max(remaining_s(deadline), 0.001) + 1.0)
+            except FutureTimeout:
+                return ("timeout", None)
+        try:
+            f2 = self._pool.submit(self._attempt, hedge_target, method,
+                                   path, body, headers, deadline,
+                                   route_span, 1, True)
+        except RuntimeError:
+            self._breakers.release(hedge_target)
+            try:
+                return f1.result(
+                    timeout=max(remaining_s(deadline), 0.001) + 1.0)
+            except FutureTimeout:
+                return ("timeout", None)
+        tried.add(hedge_target)
+        with self._lock:
+            self.hedges_sent += 1
+        if route_span is not None:
+            route_span.set_attribute("hedged", True)
+        by_future = {f1: (primary, False), f2: (hedge_target, True)}
+        pending = set(by_future)
+        last: Tuple[str, Optional[tuple]] = ("timeout", None)
+        while pending:
+            rem = remaining_s(deadline)
+            if rem is not None and rem <= 0:
+                break
+            done, pending = futures_wait(pending, timeout=rem,
+                                         return_when=FIRST_COMPLETED)
+            if not done:
+                break  # deadline expired with both legs still in flight
+            for f in done:
+                kind, reply = f.result()
+                target, was_hedge = by_future[f]
+                if kind != "reply":
+                    last = (kind, reply)
+                    continue
+                if route_span is not None:
+                    route_span.set_attribute("hedge_winner", target)
+                if was_hedge:
+                    with self._lock:
+                        self.hedge_wins += 1
+                for p in pending:
+                    # best-effort cancel; a cancelled leg never ran, so
+                    # hand back any breaker trial slot it reserved — an
+                    # in-flight loser just runs out its own attempt
+                    # timeout, abandoned, and reports its own outcome
+                    if p.cancel():
+                        self._breakers.release(by_future[p][0])
+                return (kind, reply)
+        return last
 
     def _collect_metrics(self) -> None:
         self._m_routed.sync_total(self.requests_routed)
         self._m_evicted.sync_total(self.workers_evicted)
+        self._m_readmitted.sync_total(self.workers_readmitted)
+        self._m_budget_denied.sync_total(self.retries_denied)
+        self._m_hedges.sync_total(self.hedges_sent)
+        self._m_hedge_wins.sync_total(self.hedge_wins)
+        self._m_deadline_rejected.sync_total(self.deadline_rejected)
+        # one-hot worker-state gauges: the scrape-time view of the state
+        # machine (registered-but-never-failed workers show as healthy)
+        states = self._health.states()
+        for t in self.registry.lookup(self.service):
+            states.setdefault(t, HEALTHY)
+        with self._lock:
+            self._state_targets.update(states)
+        for t, st in states.items():
+            for s in WORKER_STATES:
+                self._m_worker_state.labels(self.server_label, t, s).set(
+                    1.0 if s == st else 0.0)
 
     @property
     def address(self) -> str:
@@ -430,11 +761,32 @@ class RoutingServer:
                             + self._scrape_workers("/traces"))
 
     def close(self) -> None:
+        self._prober.request_stop()
+        join_or_leak(self._prober.thread, 2.0,
+                     f"routing-prober:{self.server_label}")
+        # stop accepting BEFORE shutting the hedge pool: handler threads
+        # already inside _forward may still submit attempts (and the
+        # submit paths degrade to inline on a closed pool regardless)
         self._httpd.shutdown()
         self._httpd.server_close()
+        # the accept loop previously leaked silently when wedged; now a
+        # failed join is logged + counted (smt_thread_leaks_total)
+        join_or_leak(self._thread, 5.0,
+                     f"routing-server:{self.server_label}")
+        self._pool.shutdown(wait=False)
         self._m_reg.unregister_collector(self._collect_metrics)
-        self._m_routed.remove()
-        self._m_evicted.remove()
+        for series in (self._m_routed, self._m_evicted, self._m_readmitted,
+                       self._m_budget_denied, self._m_hedges,
+                       self._m_hedge_wins, self._m_deadline_rejected,
+                       self._m_attempt_lat):
+            series.remove()
+        for state in ("closed", "open", "half_open"):
+            self._m_breaker_trans.remove(self.server_label, state)
+        with self._lock:
+            targets = set(self._state_targets)
+        for t in targets:
+            for s in WORKER_STATES:
+                self._m_worker_state.remove(self.server_label, t, s)
 
 
 class DistributedServingEngine:
@@ -444,7 +796,8 @@ class DistributedServingEngine:
                  service: str = "default", host: str = "127.0.0.1",
                  reply_col: str = "reply", mode: str = "continuous",
                  interval: float = 0.01, reply_timeout: float = 30.0,
-                 admission_schema="auto"):
+                 admission_schema="auto",
+                 resilience: Optional[ResilienceConfig] = None):
         self.registry = ServiceRegistry()
         self.workers = []
         for _ in range(n_workers):
@@ -461,7 +814,8 @@ class DistributedServingEngine:
             self.workers.append(eng)
             self.registry.register(service, server.address)
         self.router = RoutingServer(self.registry, service, host, 0,
-                                    timeout=reply_timeout)
+                                    timeout=reply_timeout,
+                                    resilience=resilience)
 
     @property
     def address(self) -> str:
@@ -512,9 +866,12 @@ class ProcessServingFleet:
                  mode: str = "continuous", reply_timeout: float = 30.0,
                  startup_timeout: float = 60.0,
                  import_modules: Optional[List[str]] = None,
-                 trace_knobs: Optional[Dict[str, float]] = None):
+                 trace_knobs: Optional[Dict[str, float]] = None,
+                 resilience: Optional[ResilienceConfig] = None,
+                 fault_plan=None):
+        import json as _json
         import os
-        import subprocess
+        import shutil
         import sys
         import tempfile
 
@@ -525,14 +882,24 @@ class ProcessServingFleet:
         save_stage(pipeline, stage_path)
         self.registry = ServiceRegistry()
         self.service = service
+        self.startup_timeout = startup_timeout
         self.procs = []
         self.addresses = []
         repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
             os.path.abspath(__file__))))
         env = dict(os.environ)
         env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+        if fault_plan is not None:
+            # the deterministic chaos plan reaches the worker PROCESSES
+            # through the environment (io/faultinject.py reads it lazily);
+            # router-side seams take an in-process install_plan instead
+            env[faultinject.ENV_VAR] = (
+                fault_plan if isinstance(fault_plan, str)
+                else _json.dumps(fault_plan))
+        self._env = env
         cmd = [sys.executable, "-m", "synapseml_tpu.io.serving_worker",
-               stage_path, "--host", host, "--mode", mode]
+               stage_path, "--host", host, "--mode", mode,
+               "--reply-timeout", str(reply_timeout)]
         for mod in (import_modules or []):
             cmd += ["--import-module", mod]
         # tail-sampling knobs for the worker processes' flight recorders
@@ -544,50 +911,23 @@ class ProcessServingFleet:
                                  lambda v: str(int(v)))):
             if trace_knobs and trace_knobs.get(key) is not None:
                 cmd += [flag, conv(trace_knobs[key])]
-        import select
-        import shutil
-        import time
+        self._cmd = cmd
+        import time as _time
 
         try:
+            # launch ALL workers first, then handshake: each interpreter
+            # pays its import/pipeline-load cost concurrently, and
+            # startup_timeout stays a shared total budget
             for _ in range(n_workers):
-                p = subprocess.Popen(cmd, stdout=subprocess.PIPE,
-                                     stderr=subprocess.DEVNULL, text=True,
-                                     env=env)
-                self.procs.append(p)
-            deadline = time.monotonic() + startup_timeout
+                self.procs.append(self._launch_worker())
+            handshake_deadline = _time.monotonic() + startup_timeout
             for p in self.procs:
-                line = ""
-                while True:
-                    remaining = deadline - time.monotonic()
-                    if remaining <= 0:
-                        raise TimeoutError(
-                            "serving worker did not announce its address "
-                            f"within {startup_timeout}s")
-                    # select enforces the deadline even when the worker
-                    # prints NOTHING (a bare readline would block forever)
-                    ready, _, _ = select.select([p.stdout], [], [],
-                                                min(remaining, 0.5))
-                    if not ready:
-                        if p.poll() is not None:
-                            raise RuntimeError(
-                                "serving worker died during startup")
-                        continue
-                    line = p.stdout.readline()
-                    if line.startswith("ADDRESS "):
-                        break
-                    if not line and p.poll() is not None:
-                        raise RuntimeError(
-                            "serving worker died during startup")
-                addr = line.split(None, 1)[1].strip()
+                addr = self._handshake(p, handshake_deadline)
                 self.addresses.append(addr)
                 self.registry.register(service, addr)
-                # drain further worker stdout forever: a pipeline stage that
-                # print()s would otherwise fill the 64KB pipe and wedge the
-                # worker mid-request
-                threading.Thread(target=self._drain, args=(p.stdout,),
-                                 daemon=True).start()
             self.router = RoutingServer(self.registry, service, host, 0,
-                                        timeout=reply_timeout)
+                                        timeout=reply_timeout,
+                                        resilience=resilience)
         except BaseException:
             # failed startup must not orphan already-spawned workers or
             # leak the saved-pipeline tempdir (stop() is unreachable when
@@ -597,6 +937,54 @@ class ProcessServingFleet:
                     p.kill()
             shutil.rmtree(self._tmp, ignore_errors=True)
             raise
+
+    def _launch_worker(self, port: int = 0):
+        """Popen one worker process (no handshake yet). ``port`` pins the
+        listen port — how ``restart_worker`` resurrects a kill victim at
+        its old address so the router's prober can re-admit it."""
+        import subprocess
+
+        cmd = list(self._cmd)
+        if port:
+            cmd += ["--port", str(port)]
+        return subprocess.Popen(cmd, stdout=subprocess.PIPE,
+                                stderr=subprocess.DEVNULL, text=True,
+                                env=self._env)
+
+    def _handshake(self, p, deadline: float) -> str:
+        """Read the worker's ``ADDRESS ...`` announcement (bounded by the
+        monotonic ``deadline``) and start the forever-drain; returns the
+        address."""
+        import select
+        import time
+
+        line = ""
+        while True:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise TimeoutError(
+                    "serving worker did not announce its address "
+                    f"within {self.startup_timeout}s")
+            # select enforces the deadline even when the worker prints
+            # NOTHING (a bare readline would block forever)
+            ready, _, _ = select.select([p.stdout], [], [],
+                                        min(remaining, 0.5))
+            if not ready:
+                if p.poll() is not None:
+                    raise RuntimeError("serving worker died during startup")
+                continue
+            line = p.stdout.readline()
+            if line.startswith("ADDRESS "):
+                break
+            if not line and p.poll() is not None:
+                raise RuntimeError("serving worker died during startup")
+        addr = line.split(None, 1)[1].strip()
+        # drain further worker stdout forever: a pipeline stage that
+        # print()s would otherwise fill the 64KB pipe and wedge the
+        # worker mid-request
+        threading.Thread(target=self._drain, args=(p.stdout,),
+                         daemon=True).start()
+        return addr
 
     @staticmethod
     def _drain(pipe):
@@ -648,6 +1036,30 @@ class ProcessServingFleet:
         self.procs[i].kill()
         self.procs[i].wait()
         return self.addresses[i]
+
+    def restart_worker(self, i: int) -> str:
+        """Respawn a (killed) worker at its OLD address; returns it. The
+        replacement is deliberately NOT re-registered here — the router's
+        health prober must discover it via the liveness probe and re-admit
+        it, which is exactly the kill -> failover -> re-admission round
+        trip ``tests/test_serving_process_fleet.py`` proves."""
+        import time
+
+        addr = self.addresses[i]
+        port = int(addr.rsplit(":", 1)[1])
+        if self.procs[i].poll() is None:
+            self.procs[i].kill()
+            self.procs[i].wait()
+        p = self._launch_worker(port=port)
+        try:
+            new_addr = self._handshake(
+                p, time.monotonic() + self.startup_timeout)
+        except BaseException:
+            p.kill()
+            raise
+        assert new_addr == addr, (new_addr, addr)
+        self.procs[i] = p
+        return addr
 
     def stop(self) -> None:
         import shutil

@@ -29,6 +29,11 @@ def main(argv=None) -> int:
     ap.add_argument("--mode", default="continuous",
                     choices=["continuous", "microbatch"])
     ap.add_argument("--reply-col", default="reply")
+    ap.add_argument("--reply-timeout", type=float, default=30.0,
+                    help="seconds a handler holds an exchange open for the "
+                         "engine's reply (requests carrying "
+                         "X-SMT-Deadline-Ms are bounded by the tighter of "
+                         "the two)")
     ap.add_argument("--import-module", action="append", default=[],
                     help="module(s) to import before loading the stage "
                          "(registers user-defined stage classes)")
@@ -69,7 +74,8 @@ def main(argv=None) -> int:
                                  else None)))
 
     pipeline = load_stage(args.stage_path)
-    server = ServingServer(args.host, args.port)
+    server = ServingServer(args.host, args.port,
+                           reply_timeout=args.reply_timeout)
     if args.mode == "continuous":
         engine = ContinuousServingEngine(server, pipeline,
                                          reply_col=args.reply_col).start()

@@ -35,6 +35,8 @@ _GATED_MODULES = [
     "synapseml_tpu.observability.profiling",
     "synapseml_tpu.observability.spans",
     "synapseml_tpu.observability.tracing",
+    "synapseml_tpu.io.faultinject",
+    "synapseml_tpu.io.resilience",
     "synapseml_tpu.io.serving",
     "synapseml_tpu.io.serving_v2",
     "synapseml_tpu.io.serving_worker",

@@ -511,6 +511,53 @@ def test_register_stage_records_runtime_collision():
 
 
 # ---------------------------------------------------------------------------
+# SMT011 — urlopen / socket connect without an explicit timeout
+# ---------------------------------------------------------------------------
+
+def test_smt011_true_positive(tmp_path):
+    findings = run_rule(tmp_path, "SMT011", """\
+        import socket
+        import urllib.request
+
+        def scrape(url):
+            with urllib.request.urlopen(url) as r:
+                return r.read()
+
+        def connect(host, port):
+            return socket.create_connection((host, port))
+        """)
+    assert [f.line for f in findings] == [5, 9]
+    assert all("timeout" in f.message for f in findings)
+
+
+def test_smt011_true_negative(tmp_path):
+    findings = run_rule(tmp_path, "SMT011", """\
+        import socket
+        import urllib.request
+        from urllib.request import urlopen
+
+        def scrape(url):
+            with urllib.request.urlopen(url, timeout=5.0) as r:
+                return r.read()
+
+        def scrape_positional(url, data):
+            # urlopen(url, data, timeout): timeout passed positionally
+            return urlopen(url, data, 10.0).read()
+
+        def connect(host, port):
+            return socket.create_connection((host, port), timeout=2.0)
+
+        def connect_positional(host, port):
+            return socket.create_connection((host, port), 2.0)
+
+        def unrelated(registry):
+            # other calls that merely share a name shape are not flagged
+            return registry.lookup("svc")
+        """)
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
 # SARIF output
 # ---------------------------------------------------------------------------
 

@@ -1,0 +1,673 @@
+"""Fault-plan-driven chaos suite for the serving resilience layer.
+
+Every robustness claim the control plane makes (``io/resilience.py`` +
+the routing/serving servers) is exercised here by DETERMINISTIC fault
+injection (``io/faultinject.py``) instead of real process kills alone:
+flapping workers are re-admitted, breakers open/half-open/close, the
+retry budget caps amplification, a hedge wins a seeded straggler race
+(proved via the trace), expired-deadline work is shed without ever
+occupying a batch slot, and a seeded chaos run serves every in-deadline
+request exactly once. Runs on CPU (``JAX_PLATFORMS=cpu``) — nothing here
+touches a device.
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from synapseml_tpu.core import Table, Transformer
+from synapseml_tpu.io import faultinject
+from synapseml_tpu.io.http_schema import HTTPRequestData, HTTPResponseData
+from synapseml_tpu.io.resilience import (DEADLINE_HEADER, ResilienceConfig,
+                                         parse_deadline)
+from synapseml_tpu.io.serving import ServingServer, join_or_leak
+from synapseml_tpu.io.serving_v2 import (ContinuousServingEngine,
+                                         RoutingServer, ServiceRegistry)
+from synapseml_tpu.observability import get_registry, tracing
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_fault_plan(monkeypatch):
+    monkeypatch.delenv(faultinject.ENV_VAR, raising=False)
+    faultinject.clear_plan()
+    yield
+    faultinject.clear_plan()
+
+
+@pytest.fixture
+def fresh_tracer():
+    prev = tracing.set_tracer(tracing.Tracer(sample_rate=1.0,
+                                             latency_threshold_s=60.0))
+    tracing.enable()
+    try:
+        yield tracing.get_tracer()
+    finally:
+        tracing.set_tracer(prev)
+
+
+class _TagReply(Transformer):
+    """Replies 200 with a per-engine tag so tests can SEE which in-process
+    worker served (the in-process analogue of PidEchoReply)."""
+
+    def __init__(self, tag: str = "w", **kw):
+        super().__init__(**kw)
+        self.tag = tag
+
+    def _transform(self, table):
+        n = table.num_rows
+        replies = np.empty(n, dtype=object)
+        replies[:] = [HTTPResponseData(200, "OK", entity=self.tag.encode())
+                      for _ in range(n)]
+        return table.with_column("reply", replies)
+
+
+class _CountingReply(Transformer):
+    """Replies with its tag AND counts each request body exactly as seen —
+    the exactly-once ledger for the chaos test."""
+
+    def __init__(self, tag: str, counts: dict, lock: threading.Lock, **kw):
+        super().__init__(**kw)
+        self.tag = tag
+        self.counts = counts
+        self.count_lock = lock
+
+    def _transform(self, table):
+        reqs = table["request"]
+        replies = np.empty(len(reqs), dtype=object)
+        for i, r in enumerate(reqs):
+            body = (r.entity or b"").decode()
+            with self.count_lock:
+                self.counts[body] = self.counts.get(body, 0) + 1
+            replies[i] = HTTPResponseData(200, "OK", entity=body.encode())
+        return table.with_column("reply", replies)
+
+
+class _GateReply(Transformer):
+    """Blocks inside transform until its event is set (wedges the engine
+    on demand), then replies 200."""
+
+    def __init__(self, gate: threading.Event, seen: list, **kw):
+        super().__init__(**kw)
+        self.gate = gate
+        self.seen = seen
+
+    def _transform(self, table):
+        self.seen.extend((r.entity or b"").decode() for r in table["request"])
+        self.gate.wait(10.0)
+        n = table.num_rows
+        replies = np.empty(n, dtype=object)
+        replies[:] = [HTTPResponseData(200, "OK", entity=b"ok")
+                      for _ in range(n)]
+        return table.with_column("reply", replies)
+
+
+def _fleet(stages, reply_timeout=10.0, resilience=None, service="svc"):
+    """N in-process workers (one engine per stage) behind a RoutingServer."""
+    registry = ServiceRegistry()
+    engines = []
+    for stage in stages:
+        srv = ServingServer("127.0.0.1", 0, reply_timeout=reply_timeout)
+        engines.append(ContinuousServingEngine(srv, stage).start())
+        registry.register(service, srv.address)
+    router = RoutingServer(registry, service, timeout=reply_timeout,
+                           resilience=resilience)
+    return registry, engines, router
+
+
+def _teardown(engines, router):
+    router.close()
+    for e in engines:
+        e.stop()
+
+
+def _post(addr, body=b"x", timeout=15, headers=None):
+    req = urllib.request.Request(addr + "/", data=body, method="POST",
+                                 headers=headers or {})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, r.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode(errors="replace")
+
+
+def _get(addr, timeout=15, headers=None):
+    req = urllib.request.Request(addr + "/", headers=headers or {})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, r.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode(errors="replace")
+
+
+def _poll(predicate, timeout_s=10.0, tick_s=0.05):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(tick_s)
+    return predicate()
+
+
+# ---------------------------------------------------------------------------
+# the fault plan itself
+# ---------------------------------------------------------------------------
+
+def test_fault_plan_counters_are_deterministic():
+    plan = faultinject.FaultPlan([
+        {"site": "s", "kind": "5xx", "after": 2, "every": 3, "times": 2},
+    ])
+    fires = [plan.decide("s") is not None for _ in range(12)]
+    # skip 2, then fire every 3rd eligible call, capped at 2 fires
+    assert fires == [False, False, True, False, False, True,
+                     False, False, False, False, False, False]
+    counts = plan.counts()[0]
+    assert counts["fired"] == 2 and counts["seen"] == 12
+
+
+def test_fault_plan_match_filters_by_key():
+    plan = faultinject.FaultPlan(
+        [{"site": "s", "kind": "refuse", "match": "worker-a"}])
+    assert plan.decide("s", "GET http://worker-b/") is None
+    assert plan.decide("s", "GET http://worker-a/") is not None
+    assert plan.decide("other", "worker-a") is None
+
+
+def test_fault_plan_env_activation(monkeypatch):
+    spec = {"seed": 7, "rules": [{"site": "client.send", "kind": "refuse",
+                                  "times": 1}]}
+    monkeypatch.setenv(faultinject.ENV_VAR, json.dumps(spec))
+    faultinject.clear_plan()  # drop the parsed-env cache
+    assert faultinject.act("client.send", "GET x") is not None
+    # counters persist across act() calls (the env plan is cached)
+    assert faultinject.act("client.send", "GET x") is None
+
+
+def test_client_seam_wedge_times_out_fast():
+    from synapseml_tpu.io.clients import send_request
+
+    faultinject.install_plan([{"site": "client.send", "kind": "wedge"}])
+    t0 = time.perf_counter()
+    resp = send_request(HTTPRequestData(url="http://127.0.0.1:9/",
+                                        method="GET"), timeout=0.2)
+    elapsed = time.perf_counter() - t0
+    # the wedge holds exactly the caller's timeout, then surfaces as a
+    # connection error — an UNTIMED call would hang forever (SMT011)
+    assert resp.status_code == 0
+    assert 0.1 < elapsed < 2.0
+
+
+def test_client_seam_5xx_is_an_answered_response():
+    from synapseml_tpu.io.clients import send_request
+
+    faultinject.install_plan([{"site": "client.send", "kind": "5xx",
+                               "status": 503, "times": 1}])
+    resp = send_request(HTTPRequestData(url="http://127.0.0.1:9/",
+                                        method="GET"), timeout=1.0)
+    assert resp.status_code == 503
+
+
+# ---------------------------------------------------------------------------
+# health-probing router: eviction is no longer permanent
+# ---------------------------------------------------------------------------
+
+def test_flapping_worker_is_evicted_then_readmitted():
+    cfg = ResilienceConfig(probe_base_s=0.05, probe_max_s=0.5, seed=0)
+    registry, engines, router = _fleet([_TagReply("w0"), _TagReply("w1")],
+                                       resilience=cfg)
+    addr0 = engines[0].server.address
+    try:
+        # two injected refusals against w0: suspect on the first, evicted
+        # on the second (evict_after=2) — every client request still 200s
+        faultinject.install_plan([{"site": "router.forward", "kind": "refuse",
+                                   "match": addr0, "times": 2}])
+        codes = [_post(router.address)[0] for _ in range(6)]
+        assert codes == [200] * 6
+        assert router.workers_evicted == 1
+        assert _poll(lambda: addr0 in registry.lookup("svc"), timeout_s=5.0), \
+            "evicted worker was not re-admitted by the probe loop"
+        assert router.workers_readmitted >= 1
+        # and it actually serves again
+        assert _poll(lambda: any(
+            _post(router.address)[1] == "w0" for _ in range(4)))
+        # the state machine is visible in the registry
+        snap = get_registry().snapshot()
+        fam = snap["families"]["smt_routing_worker_state"]
+        labelsets = {tuple(s["labels"]) for s in fam["series"]}
+        assert (router.server_label, addr0, "healthy") in labelsets
+        readmits = snap["families"]["smt_routing_readmissions_total"]
+        mine = [s for s in readmits["series"]
+                if s["labels"][0] == router.server_label]
+        assert mine and mine[0]["value"] >= 1
+    finally:
+        _teardown(engines, router)
+
+
+def test_kill_all_workers_stays_dead_until_probe_succeeds():
+    cfg = ResilienceConfig(probe_base_s=0.05, probe_max_s=0.2, seed=1)
+    registry, engines, router = _fleet([_TagReply("w0")], resilience=cfg)
+    addr0 = engines[0].server.address
+    try:
+        # refuse forever: the worker flaps out and probes also fail
+        faultinject.install_plan([
+            {"site": "router.forward", "kind": "refuse", "match": addr0},
+            {"site": "router.probe", "kind": "refuse", "match": addr0},
+        ])
+        codes = [_post(router.address)[0] for _ in range(3)]
+        assert codes[-1] in (502, 503)
+        assert addr0 not in registry.lookup("svc")
+        time.sleep(0.5)  # several probe cycles, all refused
+        assert addr0 not in registry.lookup("svc")
+        assert router.workers_readmitted == 0
+    finally:
+        _teardown(engines, router)
+
+
+# ---------------------------------------------------------------------------
+# circuit breakers
+# ---------------------------------------------------------------------------
+
+def test_breaker_opens_on_5xx_burst_half_opens_and_closes():
+    cfg = ResilienceConfig(breaker_min_volume=4, breaker_threshold=0.5,
+                           breaker_open_s=0.3, hedge_enabled=False,
+                           probe_base_s=30.0, seed=2)
+    registry, engines, router = _fleet([_TagReply("w0"), _TagReply("w1")],
+                                       resilience=cfg)
+    addr0 = engines[0].server.address
+    try:
+        faultinject.install_plan([{"site": "router.forward", "kind": "5xx",
+                                   "match": addr0, "status": 503,
+                                   "times": 5}])
+        results = [_post(router.address) for _ in range(16)]
+        codes = [c for c, _ in results]
+        # the worker ANSWERED its 5xxs (relayed, not evicted) ...
+        assert 3 <= codes.count(503) <= 5, codes
+        assert addr0 in registry.lookup("svc")
+        # ... and its breaker opened: once open, every request lands on w1
+        assert router._breakers.state(addr0) == "open"
+        assert all(c == 200 for c in codes[-4:]), codes
+        assert all(body == "w1" for c, body in results[-4:] if c == 200)
+        # cooldown -> half-open trial (faults exhausted, so it succeeds)
+        # -> closed, and w0 serves again
+        time.sleep(0.35)
+        assert _poll(lambda: any(
+            _post(router.address)[1] == "w0" for _ in range(4)))
+        assert router._breakers.state(addr0) == "closed"
+        snap = get_registry().snapshot()
+        trans = snap["families"]["smt_routing_breaker_transitions_total"]
+        by_state = {tuple(s["labels"]): s["value"] for s in trans["series"]
+                    if s["labels"][0] == router.server_label}
+        assert by_state[(router.server_label, "open")] >= 1
+        assert by_state[(router.server_label, "closed")] >= 1
+    finally:
+        _teardown(engines, router)
+
+
+# ---------------------------------------------------------------------------
+# retry budget
+# ---------------------------------------------------------------------------
+
+def _dead_address():
+    """An address that refuses connections (bound once, then closed)."""
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return f"http://127.0.0.1:{port}"
+
+
+def test_retry_budget_caps_amplification_and_fails_fast():
+    cfg = ResilienceConfig(retry_budget_ratio=0.0, retry_budget_floor=1,
+                           breaker_min_volume=100, probe_base_s=60.0,
+                           hedge_enabled=False, seed=3)
+    registry = ServiceRegistry()
+    registry.register("svc", _dead_address())
+    registry.register("svc", _dead_address())
+    router = RoutingServer(registry, "svc", timeout=5.0, resilience=cfg)
+    try:
+        # request 1: primary refused, the ONE budgeted retry also refused
+        # -> 502; request 2: primary refused, the budget is spent -> the
+        # distinct fail-fast 503
+        c1, _ = _post(router.address)
+        c2, body2 = _post(router.address)
+        assert c1 == 502
+        assert c2 == 503 and "retry budget" in body2
+        assert router.retries_denied == 1
+        snap = get_registry().snapshot()
+        denied = snap["families"]["smt_routing_retry_budget_denied_total"]
+        mine = [s for s in denied["series"]
+                if s["labels"][0] == router.server_label]
+        assert mine and mine[0]["value"] == 1
+    finally:
+        router.close()
+
+
+def test_breaker_released_trial_slot_is_not_leaked():
+    """A consumed-but-never-sent half-open trial (budget denial, deadline
+    expiry, cancelled hedge leg) must hand its slot back via release() —
+    a leaked token would make allow() return False FOREVER for a worker
+    the prober will never probe (it was never contact-evicted)."""
+    from synapseml_tpu.io.resilience import BreakerBoard
+
+    cfg = ResilienceConfig(breaker_min_volume=2, breaker_threshold=0.5,
+                           breaker_open_s=0.05)
+    board = BreakerBoard(cfg)
+    board.on_result("w", False)
+    board.on_result("w", False)
+    assert board.state("w") == "open"
+    time.sleep(0.06)
+    assert board.allow("w")           # half-open: the one trial slot
+    assert not board.allow("w")       # ... is exclusive
+    board.release("w")                # the attempt was never sent
+    assert board.state("w") == "half_open"
+    assert board.allow("w")           # the slot is available again
+    board.on_result("w", True)
+    assert board.state("w") == "closed"
+    # release on a closed/unknown breaker is a harmless no-op
+    board.release("w")
+    board.release("unknown")
+    assert board.allow("w")
+
+
+def test_retry_budget_unit_floor_and_ratio():
+    from synapseml_tpu.io.resilience import RetryBudget
+
+    cfg = ResilienceConfig(retry_budget_ratio=0.5, retry_budget_floor=0,
+                           retry_budget_window_s=60.0)
+    budget = RetryBudget(cfg)
+    assert not budget.try_spend()  # no primaries yet, floor 0
+    for _ in range(4):
+        budget.note_primary()
+    assert budget.try_spend() and budget.try_spend()  # 0.5 * 4 = 2 tokens
+    assert not budget.try_spend()
+    assert budget.spent() == 2
+
+
+# ---------------------------------------------------------------------------
+# hedged requests
+# ---------------------------------------------------------------------------
+
+def test_hedge_wins_seeded_straggler_race(fresh_tracer):
+    cfg = ResilienceConfig(hedge_delay_s=0.05, probe_base_s=30.0, seed=4)
+    registry, engines, router = _fleet([_TagReply("w0"), _TagReply("w1")],
+                                       resilience=cfg)
+    addr0 = engines[0].server.address
+    addr1 = engines[1].server.address
+    try:
+        # the seeded straggler: the FIRST forward attempt to w0 stalls
+        # 600ms at the router seam; the hedge fires at 50ms and w1 wins
+        faultinject.install_plan([{"site": "router.forward",
+                                   "kind": "latency", "match": addr0,
+                                   "delay_ms": 600, "times": 1}])
+        t0 = time.perf_counter()
+        code, body = _get(router.address)
+        elapsed = time.perf_counter() - t0
+        assert code == 200 and body == "w1"
+        assert elapsed < 0.5, f"hedge did not win: {elapsed:.3f}s"
+        assert router.hedges_sent == 1 and router.hedge_wins == 1
+        # the trace PROVES it: the route span is tagged hedged with the
+        # winner, and the two forward attempts are distinguishable
+        assert _poll(lambda: any(
+            s.get("name") == "route" and s["attributes"].get("hedged")
+            for t in fresh_tracer.snapshot()["traces"]
+            for s in t["spans"]), timeout_s=3.0)
+        route = next(s for t in fresh_tracer.snapshot()["traces"]
+                     for s in t["spans"]
+                     if s["name"] == "route"
+                     and s["attributes"].get("hedged"))
+        assert route["attributes"]["hedge_winner"] == addr1
+
+        def _forward_spans():
+            trace = next(t for t in fresh_tracer.snapshot()["traces"]
+                         if t["trace_id"] == route["trace_id"])
+            return [s for s in trace["spans"] if s["name"] == "forward"]
+
+        # the LOSER's span lands late (it is still stalling when the
+        # client reply goes out) and joins the retained trace entry
+        assert _poll(lambda: len(_forward_spans()) == 2, timeout_s=3.0)
+        fwd = _forward_spans()
+        assert sorted(bool(s["attributes"].get("hedge"))
+                      for s in fwd) == [False, True]
+    finally:
+        _teardown(engines, router)
+
+
+def test_hedge_not_fired_for_non_idempotent_post():
+    cfg = ResilienceConfig(hedge_delay_s=0.02, probe_base_s=30.0, seed=5)
+    registry, engines, router = _fleet([_TagReply("w0"), _TagReply("w1")],
+                                       resilience=cfg)
+    addr0 = engines[0].server.address
+    try:
+        faultinject.install_plan([{"site": "router.forward",
+                                   "kind": "latency", "match": addr0,
+                                   "delay_ms": 150, "times": 1}])
+        code, body = _post(router.address)
+        # the POST waits out its (slow) primary instead of re-sending
+        assert code == 200 and body == "w0"
+        assert router.hedges_sent == 0
+    finally:
+        _teardown(engines, router)
+
+
+# ---------------------------------------------------------------------------
+# deadlines: propagation + shedding
+# ---------------------------------------------------------------------------
+
+def _deadline_headers(ms_from_now: float):
+    return {DEADLINE_HEADER: str(int((time.time() + ms_from_now / 1e3)
+                                     * 1e3))}
+
+
+def test_router_rejects_already_expired_deadline():
+    registry, engines, router = _fleet([_TagReply("w0")])
+    try:
+        code, _ = _post(router.address, headers=_deadline_headers(-1000))
+        assert code == 504
+        assert router.deadline_rejected == 1
+    finally:
+        _teardown(engines, router)
+
+
+def test_expired_deadline_is_shed_in_queue_without_a_batch_slot():
+    gate = threading.Event()
+    seen: list = []
+    srv = ServingServer("127.0.0.1", 0, reply_timeout=10.0)
+    eng = ContinuousServingEngine(srv, _GateReply(gate, seen)).start()
+    try:
+        # request 1 wedges the engine inside transform
+        t1 = threading.Thread(target=_post, args=(srv.address, b"first"),
+                              daemon=True)
+        t1.start()
+        assert _poll(lambda: seen == ["first"], timeout_s=5.0)
+        # request 2 queues behind it with a 150ms deadline; the handler
+        # returns its 504 AT the deadline, not at reply_timeout
+        t0 = time.perf_counter()
+        code, _ = _post(srv.address, b"second",
+                        headers=_deadline_headers(150))
+        elapsed = time.perf_counter() - t0
+        assert code == 504
+        assert elapsed < 2.0, f"client waited past its deadline: {elapsed}"
+        # release the engine: the drain must SHED the expired request —
+        # the pipeline never sees it
+        gate.set()
+        t1.join(timeout=5)
+        code3, _ = _post(srv.address, b"third")
+        assert code3 == 200
+        assert seen == ["first", "third"], seen
+        snap = get_registry().snapshot()
+        shed = snap["families"]["smt_serving_shed_total"]
+        mine = {tuple(s["labels"]): s["value"] for s in shed["series"]
+                if s["labels"][0] == srv.server_label}
+        assert mine.get((srv.server_label, "expired"), 0) >= 1
+    finally:
+        eng.stop()
+
+
+def test_overload_sheds_429_with_retry_after():
+    class _SlowReply(Transformer):
+        def _transform(self, table):
+            time.sleep(0.05 * table.num_rows)
+            n = table.num_rows
+            replies = np.empty(n, dtype=object)
+            replies[:] = [HTTPResponseData(200, "OK", entity=b"ok")
+                          for _ in range(n)]
+            return table.with_column("reply", replies)
+
+    srv = ServingServer("127.0.0.1", 0, reply_timeout=10.0)
+    eng = ContinuousServingEngine(srv, _SlowReply(), max_batch=1).start()
+    try:
+        # one completed batch seeds the service-time EWMA
+        assert _post(srv.address, b"warm")[0] == 200
+        assert srv.estimated_queue_wait_s() == 0.0
+        # fill the queue with background work (no deadlines)
+        threads = [threading.Thread(target=_post,
+                                    args=(srv.address, b"bg"),
+                                    daemon=True) for _ in range(6)]
+        for t in threads:
+            t.start()
+        assert _poll(lambda: len(srv._queue) >= 3, timeout_s=5.0)
+        # a request that cannot possibly meet its 60ms deadline gets an
+        # honest 429 + Retry-After instead of a doomed 504 later
+        req = urllib.request.Request(srv.address + "/", data=b"tight",
+                                     headers=_deadline_headers(60),
+                                     method="POST")
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=15)
+        assert ei.value.code == 429
+        assert int(ei.value.headers["Retry-After"]) >= 1
+        for t in threads:
+            t.join(timeout=10)
+    finally:
+        eng.stop()
+
+
+def test_deadline_header_parsing_is_forgiving():
+    assert parse_deadline({DEADLINE_HEADER: "notanumber"}) is None
+    assert parse_deadline({}) is None
+    assert parse_deadline(None) is None
+    got = parse_deadline({DEADLINE_HEADER.lower(): "1500"})
+    assert got == pytest.approx(1.5)
+
+
+# ---------------------------------------------------------------------------
+# engine bugfixes + thread-leak accounting
+# ---------------------------------------------------------------------------
+
+def test_uncoercible_reply_500s_its_row_and_loop_survives():
+    class _BadReply(Transformer):
+        def _transform(self, table):
+            n = table.num_rows
+            replies = np.empty(n, dtype=object)
+            # a dict whose value json.dumps cannot serialize: coercion
+            # raises for THIS row only
+            replies[:] = [{"x": object()} for _ in range(n)]
+            return table.with_column("reply", replies)
+
+    srv = ServingServer("127.0.0.1", 0, reply_timeout=5.0)
+    eng = ContinuousServingEngine(srv, _BadReply()).start()
+    try:
+        code, body = _post(srv.address, b"one")
+        assert code == 500 and "serializable" in body
+        # the dispatcher loop survived: the next request is also answered
+        # promptly (500 again), not hung to the reply timeout
+        t0 = time.perf_counter()
+        code2, _ = _post(srv.address, b"two")
+        assert code2 == 500
+        assert time.perf_counter() - t0 < 2.0
+    finally:
+        eng.stop()
+
+
+def test_join_or_leak_counts_wedged_threads():
+    wedge = threading.Event()
+    t = threading.Thread(target=wedge.wait, args=(5.0,), daemon=True)
+    t.start()
+    try:
+        assert not join_or_leak(t, 0.05, "test-wedged-component")
+        snap = get_registry().snapshot()
+        fam = snap["families"]["smt_thread_leaks_total"]
+        mine = [s for s in fam["series"]
+                if s["labels"] == ["test-wedged-component"]]
+        assert mine and mine[0]["value"] == 1
+        # a clean join is not counted
+        ok_t = threading.Thread(target=lambda: None)
+        ok_t.start()
+        assert join_or_leak(ok_t, 1.0, "test-clean-component")
+        snap2 = get_registry().snapshot()
+        comps = {s["labels"][0] for s in
+                 snap2["families"]["smt_thread_leaks_total"]["series"]}
+        assert "test-clean-component" not in comps
+    finally:
+        wedge.set()
+
+
+# ---------------------------------------------------------------------------
+# the seeded chaos acceptance run: exactly-once within deadlines
+# ---------------------------------------------------------------------------
+
+def test_chaos_run_serves_every_in_deadline_request_exactly_once():
+    counts: dict = {}
+    lock = threading.Lock()
+    cfg = ResilienceConfig(probe_base_s=0.05, probe_max_s=0.5,
+                           hedge_enabled=False, seed=6)
+    registry, engines, router = _fleet(
+        [_CountingReply("w0", counts, lock),
+         _CountingReply("w1", counts, lock)], resilience=cfg)
+    try:
+        # the seeded plan: refusals (safe to retry — the request never
+        # ran), latency spikes, and worker-side 5xx-free chaos; every
+        # POST must be answered 200 exactly once despite all of it
+        faultinject.install_plan({"seed": 6, "rules": [
+            {"site": "router.forward", "kind": "refuse", "every": 5,
+             "times": 4},
+            {"site": "router.forward", "kind": "latency", "every": 7,
+             "delay_ms": 30},
+        ]})
+        n = 30
+        results = [_post(router.address, f"req-{i}".encode())
+                   for i in range(n)]
+        assert [c for c, _ in results] == [200] * n
+        # the exactly-once ledger: every request body processed once, by
+        # exactly one worker — refused attempts never reached a pipeline
+        with lock:
+            assert counts == {f"req-{i}": 1 for i in range(n)}
+        # the replies round-tripped their own body (no cross-wiring)
+        assert all(body == f"req-{i}"
+                   for i, (_, body) in enumerate(results))
+        # flapping healed: any evicted worker is back by now
+        assert _poll(lambda: len(registry.lookup("svc")) == 2)
+    finally:
+        _teardown(engines, router)
+
+
+def test_chaos_hedged_gets_reply_exactly_once_per_trace(fresh_tracer):
+    cfg = ResilienceConfig(hedge_delay_s=0.03, probe_base_s=30.0, seed=7)
+    registry, engines, router = _fleet([_TagReply("w0"), _TagReply("w1")],
+                                       resilience=cfg)
+    try:
+        faultinject.install_plan([{"site": "router.forward",
+                                   "kind": "latency", "every": 3,
+                                   "delay_ms": 120}])
+        results = [_get(router.address) for _ in range(12)]
+        assert all(c == 200 for c, _ in results)
+        # each routed trace carries exactly ONE route span and exactly one
+        # client reply — hedging may duplicate worker-side WORK (tagged
+        # and counted), never client-visible replies
+        traces = fresh_tracer.snapshot()["traces"]
+        routes = [s for t in traces for s in t["spans"]
+                  if s["name"] == "route"]
+        by_trace: dict = {}
+        for s in routes:
+            by_trace[s["trace_id"]] = by_trace.get(s["trace_id"], 0) + 1
+        assert by_trace and all(v == 1 for v in by_trace.values())
+        hedged = [s for s in routes if s["attributes"].get("hedged")]
+        assert len(hedged) == router.hedges_sent
+        assert router.hedges_sent >= 1
+    finally:
+        _teardown(engines, router)

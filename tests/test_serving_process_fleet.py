@@ -93,6 +93,85 @@ def test_front_door_metrics_aggregate_worker_processes(fleet):
     assert p50 is not None and p50 > 0
 
 
+def test_kill_then_restart_worker_is_readmitted():
+    """The full fault ROUND TRIP (not just failover): kill a worker, the
+    router evicts it; restart a replacement at the same address, the
+    health prober re-admits it within its backoff, and traffic flows to
+    the NEW process — a worker restart heals the fleet instead of
+    shrinking it forever."""
+    import time
+
+    from synapseml_tpu.io.resilience import ResilienceConfig
+
+    sys.path.insert(0, _REPO)
+    from tests.serving_fault_stage import PidEchoReply
+
+    fleet = ProcessServingFleet(
+        PidEchoReply(), n_workers=2,
+        import_modules=["tests.serving_fault_stage"], reply_timeout=15.0,
+        resilience=ResilienceConfig(probe_base_s=0.2, probe_max_s=1.0,
+                                    seed=0))
+    try:
+        dead_addr = fleet.kill_worker(0)
+        # failover keeps answering and the router evicts the dead worker
+        pids = [_hit(fleet.address) for _ in range(6)]
+        assert str(fleet.procs[1].pid) in pids
+        assert dead_addr not in fleet.routing_table()["default"]
+        assert fleet.router.workers_evicted >= 1
+        # resurrect it at the SAME address; restart_worker deliberately
+        # does NOT re-register — only the prober may do that
+        assert fleet.restart_worker(0) == dead_addr
+        new_pid = str(fleet.procs[0].pid)
+        deadline = time.monotonic() + 20.0
+        while time.monotonic() < deadline:
+            if dead_addr in fleet.routing_table()["default"]:
+                break
+            time.sleep(0.1)
+        assert dead_addr in fleet.routing_table()["default"], \
+            "restarted worker was not re-admitted"
+        assert fleet.router.workers_readmitted >= 1
+        # and the NEW process actually serves routed traffic again
+        deadline = time.monotonic() + 10.0
+        seen = set()
+        while time.monotonic() < deadline and new_pid not in seen:
+            seen.add(_hit(fleet.address))
+        assert new_pid in seen, (new_pid, seen)
+    finally:
+        fleet.stop()
+
+
+def test_fault_plan_reaches_worker_processes():
+    """`ProcessServingFleet(fault_plan=...)` ships the deterministic chaos
+    plan to the worker PROCESSES via SMT_FAULT_PLAN: every 4th handled
+    request per worker answers an injected 500, relayed by the router —
+    the cross-process half of the fault-injection contract
+    (`tests/test_resilience.py` covers the in-process seams)."""
+    sys.path.insert(0, _REPO)
+    from tests.serving_fault_stage import PidEchoReply
+
+    fleet = ProcessServingFleet(
+        PidEchoReply(), n_workers=2,
+        import_modules=["tests.serving_fault_stage"], reply_timeout=10.0,
+        fault_plan={"rules": [{"site": "server.handle", "kind": "5xx",
+                               "status": 500, "every": 4}]})
+    codes = []
+    try:
+        for _ in range(12):
+            req = urllib.request.Request(fleet.address + "/", data=b"x",
+                                         method="POST")
+            try:
+                with urllib.request.urlopen(req, timeout=10) as r:
+                    codes.append(r.status)
+            except urllib.error.HTTPError as e:
+                codes.append(e.code)
+    finally:
+        fleet.stop()
+    # injected worker-side 5xx are RELAYED (application errors — the
+    # worker is alive, so no eviction), interleaved with real 200s
+    assert 500 in codes and 200 in codes, codes
+    assert codes.count(500) == 4, codes  # 2 workers x fires at seen 1, 5
+
+
 def test_kill_all_workers_returns_5xx(fleet):
     for i in range(3):
         fleet.kill_worker(i)

@@ -81,7 +81,10 @@ def _bar(offset_s: float, dur_s: float, total_s: float) -> str:
 def _attrs_summary(s: Dict[str, Any]) -> str:
     attrs = s.get("attributes") or {}
     keep = []
+    # hedge/hedged/hedge_winner: the router tags both attempts of a
+    # hedged request and which target won the race
     for k in ("stage", "target", "server", "status", "engine", "batch_size",
+              "hedge", "hedged", "hedge_winner", "attempt",
               "error", "url", "trace_dir", "bytes"):
         if k in attrs:
             v = str(attrs[k])
