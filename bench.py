@@ -648,6 +648,169 @@ def bench_serving_overload(platform):
     }
 
 
+def bench_swap_under_load(platform):
+    """Zero-downtime hot swap: p99 during a rolling ``swap()`` vs steady
+    state, at sustained offered load over a 3-worker in-process fleet.
+
+    The lane is ledger-enforced: every request body must be answered
+    EXACTLY once with 200 — a swap that drops or duplicates a reply (or
+    leaks a 5xx) raises and the lane records an error instead of a
+    number. Primary: ``swap_p99_ratio`` = steady p99 / during-swap p99
+    (1.0 = the swap is invisible to the tail; higher is better)."""
+    import threading
+    import urllib.error
+    import urllib.request
+
+    from synapseml_tpu.core.stage import Transformer
+    from synapseml_tpu.io.http_schema import HTTPResponseData
+    from synapseml_tpu.io.lifecycle import LifecycleConfig
+    from synapseml_tpu.io.resilience import ResilienceConfig
+    from synapseml_tpu.io.serving_v2 import DistributedServingEngine
+
+    class _TagEcho(Transformer):
+        def __init__(self, tag):
+            super().__init__()
+            self._tag = tag
+
+        def _transform(self, table):
+            time.sleep(0.001 * table.num_rows)  # a real (tiny) service time
+            n = table.num_rows
+            reqs = table["request"]
+            out = np.empty(n, dtype=object)
+            for i, r in enumerate(reqs):
+                body = (r.entity or b"").decode()
+                out[i] = HTTPResponseData(
+                    200, "OK", entity=f"{self._tag}:{body}".encode())
+            return table.with_column("reply", out)
+
+    eng = DistributedServingEngine(
+        _TagEcho("g1"), n_workers=3,
+        resilience=ResilienceConfig(hedge_enabled=False, seed=0))
+    ledger = {}
+    lock = threading.Lock()
+    stop = threading.Event()
+    phase = {"name": "steady"}
+
+    def client(k):
+        i = 0
+        while not stop.is_set():
+            body = f"c{k}-{i}"
+            i += 1
+            t0 = time.perf_counter()
+            req = urllib.request.Request(eng.address + "/",
+                                         data=body.encode(), method="POST")
+            try:
+                with urllib.request.urlopen(req, timeout=15) as r:
+                    entry = (r.status, time.perf_counter() - t0,
+                             phase["name"])
+            except urllib.error.HTTPError as e:
+                entry = (e.code, time.perf_counter() - t0, phase["name"])
+            except Exception:
+                entry = (0, time.perf_counter() - t0, phase["name"])
+            with lock:
+                ledger.setdefault(body, []).append(entry)
+            time.sleep(0.002)
+
+    threads = [threading.Thread(target=client, args=(k,), daemon=True)
+               for k in range(4)]
+    try:
+        for th in threads:
+            th.start()
+        time.sleep(1.5)                      # steady state on g1
+        phase["name"] = "swap"
+        t_swap0 = time.perf_counter()
+        eng.swap(_TagEcho("g2"),
+                 cfg=LifecycleConfig(drain_timeout_s=5.0,
+                                     swap_timeout_s=30.0))
+        swap_s = time.perf_counter() - t_swap0
+        phase["name"] = "post"
+        time.sleep(0.5)                      # settle on g2
+    finally:
+        stop.set()
+        for th in threads:
+            th.join(timeout=15)
+        eng.stop()
+    # THE LEDGER: exactly-once, all 200 — a violation fails the lane
+    bad = {b: r for b, r in ledger.items()
+           if len(r) != 1 or r[0][0] != 200}
+    if bad:
+        raise ValueError(f"swap ledger violation: "
+                         f"{dict(list(bad.items())[:3])!r}")
+    by_phase = {}
+    for (status, dt, ph), in ledger.values():
+        by_phase.setdefault(ph, []).append(dt)
+    steady = np.array(by_phase.get("steady") or [0.0])
+    during = np.array(by_phase.get("swap") or steady)
+    steady_p99 = float(np.quantile(steady, 0.99))
+    swap_p99 = float(np.quantile(during, 0.99))
+    return {
+        "workers": 3,
+        "requests_total": len(ledger),
+        "requests_during_swap": len(during),
+        "rolling_swap_s": round(swap_s, 3),
+        "steady_p99_ms": round(steady_p99 * 1e3, 2),
+        "swap_p99_ms": round(swap_p99 * 1e3, 2),
+        "dropped_or_duplicated": 0,  # enforced above
+        "swap_p99_ratio": round(steady_p99 / max(swap_p99, 1e-6), 3),
+    }
+
+
+def bench_worker_warm_start(platform):
+    """Persisted-AOT warm start: time-to-first-served-reply for a FRESH
+    worker process, cold (empty cache — the first reply pays the XLA
+    compile) vs warm (the fleet's shared on-disk cache was pre-warmed
+    before the worker registered).
+
+    Primary: ``warm_start_speedup`` = cold first-reply / warm first-reply
+    (the warm denominator floored at 25 ms so sub-millisecond jitter in
+    an already-instant reply cannot whip the ratchet ratio around).
+    The warm figure is the median over 3 scale-up workers."""
+    import os
+    import urllib.request
+
+    from synapseml_tpu.io.serving_v2 import ProcessServingFleet
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from tests.serving_fault_stage import JitBurnReply
+
+    fleet = ProcessServingFleet(
+        JitBurnReply(), n_workers=1, aot_cache_dir="auto",
+        import_modules=["tests.serving_fault_stage"],
+        reply_timeout=60.0, startup_timeout=180.0)
+    try:
+        # worker 0's FIRST reply pays the cold compile (and persists it)
+        t0 = time.perf_counter()
+        with urllib.request.urlopen(fleet.addresses[0] + "/", data=b"cold",
+                                    timeout=120) as r:
+            assert r.status == 200
+        cold_s = time.perf_counter() - t0
+        warm = []
+        for _ in range(3):
+            addr = fleet.add_worker()
+            if addr is None:
+                raise RuntimeError("scale-up worker failed to start")
+            t0 = time.perf_counter()
+            with urllib.request.urlopen(addr + "/", data=b"warm",
+                                        timeout=120) as r:
+                assert r.status == 200
+            warm.append(time.perf_counter() - t0)
+        snap = fleet.metrics_snapshot()
+        hits = sum(
+            s["value"] for s in (snap["families"].get(
+                "smt_aot_cache_hits_total") or {}).get("series", []))
+    finally:
+        fleet.stop()
+    warm_s = float(np.median(warm))
+    return {
+        "cold_first_reply_s": round(cold_s, 3),
+        "warm_first_reply_s": round(warm_s, 4),
+        "warm_samples": [round(w, 4) for w in warm],
+        "aot_cache_hits": hits,
+        "warm_start_time_saved_s": round(cold_s - warm_s, 3),
+        "warm_start_speedup": round(cold_s / max(warm_s, 0.025), 2),
+    }
+
+
 def bench_span_overhead(platform):
     """Per-transform overhead of the observability stage spans.
 
@@ -1028,6 +1191,8 @@ _PRIMARY = {
     "flash_attention_32k": "tflops_nominal",
     "flash_attention_gqa": "tflops_nominal",
     "serving_overload": "p99_collapse_ratio",
+    "swap_under_load": "swap_p99_ratio",
+    "worker_warm_start": "warm_start_speedup",
 }
 
 
@@ -1073,6 +1238,8 @@ def main() -> None:
         ("flash_attention_gqa", lambda: bench_flash_gqa(platform, peak)),
         ("serving_latency", lambda: bench_serving(platform)),
         ("serving_overload", lambda: bench_serving_overload(platform)),
+        ("swap_under_load", lambda: bench_swap_under_load(platform)),
+        ("worker_warm_start", lambda: bench_worker_warm_start(platform)),
         ("observability_span_overhead", lambda: bench_span_overhead(platform)),
         ("tracing_overhead", lambda: bench_tracing_overhead(platform)),
         ("profiling_overhead", lambda: bench_profiling_overhead(platform)),

@@ -704,6 +704,95 @@ class UntimedNetworkCall(Rule):
         return findings
 
 
+@register
+class SilentExceptionSwallow(Rule):
+    """SMT012 — silent exception swallowing in ``io/`` and
+    ``observability/``.
+
+    These packages are built from long-lived thread loops (dispatchers,
+    probers, collectors, control loops). A bare ``except:`` — or a broad
+    ``except Exception:`` whose body is only ``pass``/``continue`` inside
+    a loop — makes such a loop eat its own death: the thread looks alive
+    while serving nothing, which is the exact silent-failure mode the
+    resilience layer exists to prevent. Swallowing deliberately is fine —
+    say so by logging (or counting) what was swallowed; the handler then
+    has a body and the rule passes. A bare ``except:`` that re-raises is
+    also allowed (the narrow cleanup-then-reraise idiom).
+    """
+
+    code = "SMT012"
+    name = "silent-exception-swallow"
+    rationale = ("a swallowed exception in a serving/observability thread "
+                 "loop turns a crash into a silent hang; log or count "
+                 "what was swallowed")
+
+    _SCOPES = (os.sep + os.path.join("synapseml_tpu", "io") + os.sep,
+               os.sep + os.path.join("synapseml_tpu", "observability")
+               + os.sep,
+               # fixture paths: any io/ or observability/ directory
+               os.sep + "io" + os.sep,
+               os.sep + "observability" + os.sep)
+
+    def _in_scope(self, module: Module) -> bool:
+        path = os.path.abspath(module.path)
+        return any(s in path for s in self._SCOPES)
+
+    @staticmethod
+    def _trivial_body(handler: ast.ExceptHandler) -> bool:
+        return all(isinstance(s, (ast.Pass, ast.Continue))
+                   for s in handler.body)
+
+    @staticmethod
+    def _reraises(handler: ast.ExceptHandler) -> bool:
+        return any(isinstance(s, ast.Raise) for s in ast.walk(handler))
+
+    def check(self, module: Module) -> Iterable[Finding]:
+        if not self._in_scope(module):
+            return []
+        findings: List[Finding] = []
+
+        class V(ast.NodeVisitor):
+            def __init__(self):
+                self.loops = 0
+
+            def _loop(self, node):
+                self.loops += 1
+                self.generic_visit(node)
+                self.loops -= 1
+
+            visit_For = visit_While = _loop
+
+            def visit_FunctionDef(self, node):
+                # a handler inside a nested def is not "inside" the outer
+                # loop — the function body runs whenever it is called
+                saved, self.loops = self.loops, 0
+                self.generic_visit(node)
+                self.loops = saved
+
+            visit_AsyncFunctionDef = visit_FunctionDef
+
+            def visit_ExceptHandler(inner, node):
+                bare = node.type is None
+                broad = (isinstance(node.type, ast.Name)
+                         and node.type.id in ("Exception", "BaseException"))
+                if bare and not self._reraises(node):
+                    findings.append(self.finding(
+                        module, node,
+                        "bare 'except:' swallows SystemExit/KeyboardInterrupt "
+                        "too; catch Exception and log (or count) what was "
+                        "swallowed"))
+                elif broad and self._trivial_body(node) and inner.loops:
+                    findings.append(self.finding(
+                        module, node,
+                        "'except Exception: pass' inside a loop lets a "
+                        "thread loop eat its own death silently; log or "
+                        "count the swallowed exception"))
+                inner.generic_visit(node)
+
+        V().visit(module.tree)
+        return findings
+
+
 # cache of "does this file use jax" verdicts, keyed by absolute path
 _JAX_USING_CACHE: Dict[str, bool] = {}
 

@@ -1,0 +1,594 @@
+"""Zero-downtime fleet lifecycle: hot swap, SLO autoscaling, warm routing.
+
+PR 7 shipped the *defensive* half of the serving control plane (health
+probing, breakers, hedging, shedding); this module is the *lifecycle*
+half — the predictable, drain-based transitions in the spirit of
+Clockwork's predictability-first serving (Gujarati et al., OSDI '20) and
+Autopilot's workload autoscaling (Rzadca et al., EuroSys '20):
+
+- :class:`WorkerLifecycle` — the generation-tagged pipeline slot every
+  serving engine reads per batch. ``swap_async`` loads + pre-warms a new
+  pipeline OFF the request path and flips the slot atomically between
+  batches; ``begin_drain``/``resume`` drive the worker's advertised
+  ``serving | warming | draining`` state (``GET /healthz``), which the
+  router's re-admission prober respects (a draining worker is never
+  re-admitted mid-roll).
+- :class:`LoadAwareBalancer` — weighted pick-2 routing (Mitzenmacher's
+  power of two choices) scored by observed per-worker attempt p99 × the
+  live in-flight count; degrades to round-robin while the latency window
+  is cold, so an empty fleet is routed exactly as before.
+- :class:`Autoscaler` — the SLO control loop: watches the fleet's
+  windowed p99 (merged histogram bucket DELTAS, not lifetime quantiles)
+  and worker queue-wait estimates, scales up on a sustained SLO breach
+  and down (always via drain, never kill) when sustainedly idle.
+  Hysteresis (``breach_ticks``/``idle_ticks`` consecutive observations)
+  plus per-direction cooldowns make a noisy signal unable to flap the
+  fleet; every decision lands in the telemetry ring with the triggering
+  metric values and in ``smt_autoscale_decisions_total{direction}``.
+
+Stdlib-only and import-pure (the no-jax-at-import gate covers this
+module); every knob is env-overridable via :meth:`LifecycleConfig.from_env`
+(knob table: ``docs/serving.md``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import random
+import threading
+import time
+import urllib.request
+from collections import deque
+from time import perf_counter as _perf_counter
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ..core.telemetry import get_logger, log_event
+from ..observability import get_registry
+from ..observability.metrics import bucket_quantile
+
+__all__ = [
+    "Autoscaler",
+    "DRAINING",
+    "FleetObservation",
+    "LifecycleConfig",
+    "LoadAwareBalancer",
+    "ProcessFleetAdapter",
+    "SERVING",
+    "WARMING",
+    "WorkerLifecycle",
+    "healthz",
+    "post_control",
+    "wait_until",
+]
+
+_logger = get_logger("io.lifecycle")
+
+SERVING, WARMING, DRAINING = "serving", "warming", "draining"
+LIFECYCLE_STATES = (SERVING, WARMING, DRAINING)
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, default))
+    except (TypeError, ValueError):
+        return default
+
+
+@dataclasses.dataclass
+class LifecycleConfig:
+    """Every lifecycle knob in one bag (env spellings in :meth:`from_env`;
+    tests pin aggressive values without touching the environment)."""
+
+    # rolling swap / drain
+    drain_timeout_s: float = 10.0    # bound on waiting a worker's inflight->0
+    swap_timeout_s: float = 120.0    # bound on one worker's load+prewarm+flip
+    healthz_timeout_s: float = 2.0   # per /healthz poll
+    poll_interval_s: float = 0.05    # drain/swap poll cadence
+    # SLO-driven autoscaling
+    slo_p99_ms: float = 250.0        # windowed fleet p99 above this = breach
+    queue_wait_slo_s: float = 0.25   # any worker's queue-wait above = breach
+    eval_interval_s: float = 1.0     # control-loop tick
+    breach_ticks: int = 3            # consecutive breaches before scale-up
+    idle_ticks: int = 5              # consecutive idles before scale-down
+    cooldown_up_s: float = 15.0      # min gap after ANY transition -> next up
+    cooldown_down_s: float = 30.0    # min gap after ANY transition -> next down
+    idle_p99_fraction: float = 0.5   # p99 below fraction*SLO counts as idle
+    min_workers: int = 1
+    max_workers: int = 8
+    # load-aware routing
+    pick2_min_samples: int = 8       # per-worker latency samples before pick-2
+    latency_window: int = 128        # recent attempt latencies kept per worker
+    seed: Optional[int] = None       # pins the pick-2 RNG for tests
+
+    @classmethod
+    def from_env(cls) -> "LifecycleConfig":
+        c = cls()
+        c.drain_timeout_s = _env_float("SMT_DRAIN_TIMEOUT_S", c.drain_timeout_s)
+        c.swap_timeout_s = _env_float("SMT_SWAP_TIMEOUT_S", c.swap_timeout_s)
+        c.slo_p99_ms = _env_float("SMT_SLO_P99_MS", c.slo_p99_ms)
+        c.queue_wait_slo_s = _env_float("SMT_QUEUE_WAIT_SLO_S",
+                                        c.queue_wait_slo_s)
+        c.eval_interval_s = _env_float("SMT_AUTOSCALE_INTERVAL_S",
+                                       c.eval_interval_s)
+        c.breach_ticks = int(_env_float("SMT_AUTOSCALE_BREACH_TICKS",
+                                        c.breach_ticks))
+        c.idle_ticks = int(_env_float("SMT_AUTOSCALE_IDLE_TICKS",
+                                      c.idle_ticks))
+        c.cooldown_up_s = _env_float("SMT_AUTOSCALE_COOLDOWN_UP_S",
+                                     c.cooldown_up_s)
+        c.cooldown_down_s = _env_float("SMT_AUTOSCALE_COOLDOWN_DOWN_S",
+                                       c.cooldown_down_s)
+        c.min_workers = int(_env_float("SMT_MIN_WORKERS", c.min_workers))
+        c.max_workers = int(_env_float("SMT_MAX_WORKERS", c.max_workers))
+        c.pick2_min_samples = int(_env_float("SMT_PICK2_MIN_SAMPLES",
+                                             c.pick2_min_samples))
+        return c
+
+
+# ---------------------------------------------------------------------------
+# generation-tagged pipeline slot (the hot-swap mechanism)
+# ---------------------------------------------------------------------------
+
+class WorkerLifecycle:
+    """The worker's generation-tagged pipeline slot + lifecycle state.
+
+    Serving engines read ``current()`` once per batch, so ``install()``
+    flips the pipeline atomically BETWEEN batches — a batch never sees two
+    generations. ``swap_async`` runs the expensive half (deserialize +
+    pre-warm compile) on its own thread, entirely off the request path;
+    only the final slot assignment takes the lock.
+
+    The advertised state (``GET /healthz``) is ``draining`` > ``warming``
+    > ``serving``: a worker mid-roll is both draining (the router stopped
+    sending) and warming (the next generation is compiling) — draining is
+    the one the re-admission prober must see.
+    """
+
+    def __init__(self, pipeline, generation: int = 0,
+                 on_swap: Optional[Callable[[Any], None]] = None):
+        self._lock = threading.Lock()
+        self._pipeline = pipeline
+        self._generation = int(generation)
+        self._draining = False
+        self._swap_thread: Optional[threading.Thread] = None
+        self._swap_error: Optional[str] = None
+        # engine hook: re-resolve admission schema etc. for the new pipeline
+        self.on_swap = on_swap
+        reg = get_registry()
+        self._m_swaps = reg.counter(
+            "smt_swaps_total", "pipeline hot swaps by outcome",
+            ("outcome",))
+        self._m_swap_s = reg.histogram(
+            "smt_swap_seconds",
+            "load + pre-warm + flip wall time per hot swap")
+
+    def current(self) -> Tuple[Any, int]:
+        """The (pipeline, generation) a batch should run under."""
+        with self._lock:
+            return self._pipeline, self._generation
+
+    @property
+    def generation(self) -> int:
+        with self._lock:
+            return self._generation
+
+    def state(self) -> str:
+        with self._lock:
+            if self._draining:
+                return DRAINING
+            if self._swap_thread is not None and self._swap_thread.is_alive():
+                return WARMING
+            return SERVING
+
+    def begin_drain(self) -> None:
+        with self._lock:
+            self._draining = True
+
+    def resume(self) -> None:
+        with self._lock:
+            self._draining = False
+
+    def install(self, pipeline, generation: int) -> None:
+        """Flip the slot (the atomic half of a swap). Safe to call directly
+        for in-process swaps; cross-process swaps arrive via
+        :meth:`swap_async`."""
+        with self._lock:
+            self._pipeline = pipeline
+            self._generation = int(generation)
+        cb = self.on_swap
+        if cb is not None:
+            try:
+                cb(pipeline)
+            except Exception:
+                _logger.exception("on_swap callback failed (generation %s)",
+                                  generation)
+
+    def swap_async(self, loader: Callable[[], Any], generation: int,
+                   prewarm: Optional[Callable[[Any], None]] = None) -> bool:
+        """Load + pre-warm + flip on a background thread; False when a swap
+        is already in flight (the control endpoint answers 409). ``loader``
+        produces the new pipeline (e.g. ``load_stage(path)``); ``prewarm``
+        runs it once off the request path so the flip never pays a cold
+        compile mid-traffic."""
+        with self._lock:
+            if self._swap_thread is not None and self._swap_thread.is_alive():
+                return False
+            self._swap_error = None
+            t = self._swap_thread = threading.Thread(
+                target=self._swap_run, args=(loader, generation, prewarm),
+                name=f"pipeline-swap-g{generation}", daemon=True)
+        t.start()
+        return True
+
+    def _swap_run(self, loader, generation, prewarm) -> None:
+        t0 = _perf_counter()
+        try:
+            pipeline = loader()
+            if prewarm is not None:
+                try:
+                    prewarm(pipeline)
+                except Exception:
+                    # a failed pre-warm costs the first batch a compile; it
+                    # must never abort the swap itself
+                    _logger.exception("pipeline pre-warm failed "
+                                      "(generation %s)", generation)
+            self.install(pipeline, generation)
+        except Exception as e:
+            with self._lock:
+                self._swap_error = f"{type(e).__name__}: {e}"
+            self._m_swaps.labels("failed").inc()
+            log_event("swap_failed", className="lifecycle", uid="worker",
+                      generation=generation, error=self._swap_error)
+            _logger.exception("pipeline swap to generation %s failed",
+                              generation)
+            return
+        dt = _perf_counter() - t0
+        self._m_swaps.labels("ok").inc()
+        self._m_swap_s.observe(dt)
+        log_event("swap", className="lifecycle", uid="worker",
+                  generation=generation, duration_s=dt)
+
+    def swap_error(self) -> Optional[str]:
+        return self._swap_error
+
+    def healthz(self) -> Dict[str, Any]:
+        """The lifecycle half of the ``/healthz`` body (the server adds
+        ``inflight``/``queue_wait_s``)."""
+        d = {"state": self.state(), "generation": self.generation}
+        err = self._swap_error
+        if err is not None:
+            d["swap_error"] = err
+        return d
+
+
+# ---------------------------------------------------------------------------
+# load-aware routing: weighted pick-2 over live per-worker signals
+# ---------------------------------------------------------------------------
+
+class LoadAwareBalancer:
+    """Weighted pick-2 candidate ordering for the routing front door.
+
+    Score = (in-flight + 1) × recent attempt p99: the in-flight count is
+    the instantaneous queue signal, the p99 the structural one (a worker
+    that answers slowly deserves less traffic even when idle). Two random
+    candidates are drawn and the lower score wins — the classic
+    power-of-two-choices result keeps the fleet balanced without the herd
+    behavior of always-pick-best. With any candidate's latency window
+    still cold (< ``min_samples`` observations) the balancer degrades to
+    plain round-robin: routing on ignorance would starve the cold worker
+    of exactly the samples that would warm its window.
+    """
+
+    def __init__(self, min_samples: int = 8, window: int = 128,
+                 seed: Optional[int] = None):
+        self.min_samples = min_samples
+        self.window = window
+        self._rng = random.Random(seed)
+        self._lock = threading.Lock()
+        self._lat: Dict[str, deque] = {}
+        self._inflight: Dict[str, int] = {}
+
+    def note_start(self, target: str) -> None:
+        with self._lock:
+            self._inflight[target] = self._inflight.get(target, 0) + 1
+
+    def note_end(self, target: str, latency_s: float,
+                 success: bool = True) -> None:
+        """``success=False`` (error reply, timeout, contact failure)
+        releases the in-flight slot WITHOUT feeding the latency window: a
+        worker failing instantly must not look like the fastest worker in
+        the fleet and attract the traffic it is failing — errors are the
+        breaker's and the health machine's to punish, not a routing
+        reward."""
+        with self._lock:
+            n = self._inflight.get(target, 0)
+            self._inflight[target] = max(0, n - 1)
+            if not success:
+                return
+            q = self._lat.get(target)
+            if q is None:
+                q = self._lat[target] = deque(maxlen=self.window)
+            q.append(latency_s)
+
+    def forget(self, target: str) -> None:
+        """Drop a departed worker's history (re-admission starts cold)."""
+        with self._lock:
+            self._lat.pop(target, None)
+            self._inflight.pop(target, None)
+
+    def _score(self, target: str) -> Optional[float]:
+        """(inflight + 1) × p99 over the recent window; None while cold."""
+        q = self._lat.get(target)
+        if q is None or len(q) < self.min_samples:
+            return None
+        lat = sorted(q)
+        p99 = lat[min(len(lat) - 1, int(0.99 * len(lat)))]
+        return (self._inflight.get(target, 0) + 1) * max(p99, 1e-9)
+
+    def order(self, targets: List[str], rr_start: int) -> List[str]:
+        """The failover walk order: pick-2 winner first, remaining
+        candidates by ascending score; round-robin rotation while cold."""
+        n = len(targets)
+        if n <= 1:
+            return list(targets)
+        with self._lock:
+            scores = {t: self._score(t) for t in targets}
+            if any(s is None for s in scores.values()):
+                return [targets[(rr_start + k) % n] for k in range(n)]
+            i, j = self._rng.sample(range(n), 2)
+        a, b = targets[i], targets[j]
+        first = a if scores[a] <= scores[b] else b
+        rest = sorted((t for t in targets if t != first),
+                      key=lambda t: scores[t])
+        return [first] + rest
+
+
+# ---------------------------------------------------------------------------
+# worker control-plane HTTP helpers (shared by fleet roll + autoscaler)
+# ---------------------------------------------------------------------------
+
+def healthz(address: str, timeout: float = 2.0) -> Optional[Dict[str, Any]]:
+    """``GET <address>/healthz`` parsed; None when unreachable/garbage —
+    a dead worker reads as "no health", never as an exception."""
+    try:
+        with urllib.request.urlopen(address + "/healthz",
+                                    timeout=timeout) as r:
+            return json.loads(r.read().decode())
+    except Exception:
+        return None
+
+
+def post_control(address: str, op: str, payload: Optional[dict] = None,
+                 timeout: float = 5.0) -> Tuple[int, bytes]:
+    """``POST <address>/control/<op>``; returns (status, body). Transport
+    failures report status 0 (the roll treats the worker as lost and
+    continues on the survivors)."""
+    body = json.dumps(payload or {}).encode()
+    req = urllib.request.Request(
+        f"{address}/control/{op}", data=body, method="POST",
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, r.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+    except Exception:
+        return 0, b""
+
+
+def wait_until(pred: Callable[[], bool], timeout_s: float,
+               poll_s: float = 0.05) -> bool:
+    """Poll ``pred`` until True or ``timeout_s`` elapses."""
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(poll_s)
+    return bool(pred())
+
+
+# ---------------------------------------------------------------------------
+# SLO-driven autoscaler
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class FleetObservation:
+    """One control-loop sample: the windowed fleet p99 (None while the
+    window is empty), the worst worker queue-wait estimate, and the live
+    worker count."""
+
+    p99_s: Optional[float]
+    queue_wait_s: float
+    n_workers: int
+
+
+class Autoscaler:
+    """The SLO control loop over an abstract fleet adapter.
+
+    The adapter supplies ``observe() -> FleetObservation``, ``scale_up()
+    -> bool`` and ``scale_down() -> bool`` (both return whether the fleet
+    actually changed; scale_down MUST drain, never kill). The loop itself
+    is deliberately free of HTTP and subprocess concerns so the
+    fault-injection tests can drive :meth:`tick` with scripted noisy
+    observations and a fake clock and prove flap-proofness
+    deterministically.
+
+    Decision rule per tick:
+
+    - **breach** = windowed p99 > SLO, or any worker queue-wait > its SLO;
+      ``breach_ticks`` CONSECUTIVE breaches + an elapsed up-cooldown +
+      headroom under ``max_workers`` ⇒ scale up.
+    - **idle** = p99 under ``idle_p99_fraction``×SLO (or no traffic) and
+      queue-wait ~0; ``idle_ticks`` consecutive idles + an elapsed
+      down-cooldown + floor above ``min_workers`` ⇒ scale down (drain).
+    - any transition resets BOTH streak counters and stamps the shared
+      cooldown clock — a noisy signal cannot produce more than one
+      transition per cooldown window by construction.
+
+    Every decision is logged to the telemetry ring with the triggering
+    values and counted in ``smt_autoscale_decisions_total{direction}``.
+    """
+
+    def __init__(self, adapter, cfg: Optional[LifecycleConfig] = None,
+                 clock: Callable[[], float] = time.monotonic):
+        self.adapter = adapter
+        self.cfg = cfg or LifecycleConfig.from_env()
+        self.clock = clock
+        self._breach_streak = 0
+        self._idle_streak = 0
+        self._last_transition: Optional[float] = None
+        self.decisions: List[Dict[str, Any]] = []
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._m_decisions = get_registry().counter(
+            "smt_autoscale_decisions_total",
+            "autoscaler scale transitions by direction", ("direction",))
+
+    # -- decision core (directly drivable by tests) ------------------------
+    def tick(self, now: Optional[float] = None) -> Optional[str]:
+        """One control-loop evaluation; returns ``"up"``/``"down"`` when a
+        transition happened, else None."""
+        cfg = self.cfg
+        if now is None:
+            now = self.clock()
+        try:
+            obs = self.adapter.observe()
+        except Exception:
+            _logger.exception("autoscaler observation failed; skipping tick")
+            return None
+        slo_s = cfg.slo_p99_ms / 1e3
+        breach = ((obs.p99_s is not None and obs.p99_s > slo_s)
+                  or obs.queue_wait_s > cfg.queue_wait_slo_s)
+        idle = ((obs.p99_s is None or obs.p99_s < slo_s
+                 * cfg.idle_p99_fraction)
+                and obs.queue_wait_s < 0.1 * cfg.queue_wait_slo_s)
+        self._breach_streak = self._breach_streak + 1 if breach else 0
+        self._idle_streak = self._idle_streak + 1 if idle else 0
+
+        def cooled(cooldown_s: float) -> bool:
+            return (self._last_transition is None
+                    or now - self._last_transition >= cooldown_s)
+
+        direction = None
+        if (self._breach_streak >= cfg.breach_ticks
+                and obs.n_workers < cfg.max_workers
+                and cooled(cfg.cooldown_up_s)):
+            direction = "up" if self._safe_scale(self.adapter.scale_up) \
+                else None
+        elif (self._idle_streak >= cfg.idle_ticks
+                and obs.n_workers > cfg.min_workers
+                and cooled(cfg.cooldown_down_s)):
+            direction = "down" if self._safe_scale(self.adapter.scale_down) \
+                else None
+        if direction is not None:
+            self._last_transition = now
+            self._breach_streak = 0
+            self._idle_streak = 0
+            decision = {
+                "direction": direction,
+                "p99_ms": (round(obs.p99_s * 1e3, 3)
+                           if obs.p99_s is not None else None),
+                "queue_wait_s": round(obs.queue_wait_s, 4),
+                "n_workers": obs.n_workers,
+                "slo_p99_ms": cfg.slo_p99_ms,
+            }
+            self.decisions.append(decision)
+            self._m_decisions.labels(direction).inc()
+            log_event("autoscale", className="lifecycle", uid="fleet",
+                      **decision)
+            _logger.info("autoscale %s: p99=%sms queue_wait=%.3fs "
+                         "workers=%d", direction, decision["p99_ms"],
+                         obs.queue_wait_s, obs.n_workers)
+        return direction
+
+    @staticmethod
+    def _safe_scale(fn) -> bool:
+        try:
+            return bool(fn())
+        except Exception:
+            _logger.exception("autoscaler scale action failed")
+            return False
+
+    # -- background loop ---------------------------------------------------
+    def start(self) -> "Autoscaler":
+        if self._thread is None or not self._thread.is_alive():
+            self._stop.clear()
+            self._thread = threading.Thread(target=self._run,
+                                            name="autoscaler", daemon=True)
+            self._thread.start()
+        return self
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.cfg.eval_interval_s):
+            self.tick()
+
+    def stop(self, join_timeout: float = 2.0) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(join_timeout)
+
+
+class ProcessFleetAdapter:
+    """Binds :class:`Autoscaler` to a ``ProcessServingFleet``.
+
+    The p99 is WINDOWED: each observation diffs the merged
+    ``smt_serving_latency_seconds`` bucket counts (filtered to the
+    fleet's workers) against the previous tick's and computes the
+    quantile of the delta — the trend signal the SLO compares against,
+    not the lifetime distribution (which would never recover from one
+    bad minute). Queue-wait is the worst worker's ``/healthz`` estimate.
+    """
+
+    def __init__(self, fleet, cfg: Optional[LifecycleConfig] = None):
+        self.fleet = fleet
+        self.cfg = cfg or LifecycleConfig.from_env()
+        self._prev_counts: Optional[List[int]] = None
+
+    def _bucket_counts(self) -> Tuple[Optional[list], List[int]]:
+        snap = self.fleet.metrics_snapshot()
+        fam = (snap.get("families") or {}).get("smt_serving_latency_seconds")
+        if fam is None:
+            return None, []
+        workers = {a[len("http://"):] for a in self.fleet.live_addresses()}
+        labelnames = list(fam.get("labelnames") or [])
+        counts = [0] * (len(fam.get("buckets") or []) + 1)
+        for s in fam.get("series", []):
+            lv = dict(zip(labelnames, s["labels"]))
+            if lv.get("server") not in workers:
+                continue
+            for i, c in enumerate(s["counts"]):
+                if i < len(counts):
+                    counts[i] += c
+        return fam.get("buckets") or [], counts
+
+    def observe(self) -> FleetObservation:
+        buckets, counts = self._bucket_counts()
+        p99 = None
+        if buckets is not None:
+            prev = self._prev_counts
+            self._prev_counts = counts
+            if prev is not None and len(prev) == len(counts):
+                delta = [max(0, c - p) for c, p in zip(counts, prev)]
+                p99 = bucket_quantile(buckets, delta, 0.99)
+        queue_wait = 0.0
+        addrs = self.fleet.live_addresses()
+        # concurrent polls: one wedged worker costs its own healthz
+        # timeout, not timeout × fleet size serialized into every tick
+        from ..core.clock import buffered_map
+
+        for hz in buffered_map(
+                lambda a: healthz(a, timeout=self.cfg.healthz_timeout_s),
+                addrs, concurrency=8):
+            if hz is not None:
+                queue_wait = max(queue_wait,
+                                 float(hz.get("queue_wait_s") or 0.0))
+        return FleetObservation(p99_s=p99, queue_wait_s=queue_wait,
+                                n_workers=len(addrs))
+
+    def scale_up(self) -> bool:
+        return self.fleet.add_worker() is not None
+
+    def scale_down(self) -> bool:
+        return self.fleet.remove_worker() is not None

@@ -278,11 +278,16 @@ class FleetHealth:
 
 
 class HealthProber:
-    """Background re-admission loop: probes due evicted workers with a
-    cheap ``GET /metrics`` and hands successes to ``on_readmit`` (the
-    router re-registers, resets the breaker, counts). One daemon thread
-    per router; probes run serially — a wedged probe costs its own
-    ``probe_timeout_s``, never a request's."""
+    """Background re-admission loop: probes due evicted workers with the
+    dedicated cheap ``GET /healthz`` and hands successes to ``on_readmit``
+    (the router re-registers, resets the breaker, counts). One daemon
+    thread per router; probes run serially — a wedged probe costs its own
+    ``probe_timeout_s``, never a request's.
+
+    A worker that ANSWERS but reports ``state: draining`` is refused:
+    re-admitting it would race the fleet's rolling swap/scale-down drain
+    and route traffic onto a worker the lifecycle layer just took out of
+    rotation. The probe backoff continues as if it had failed."""
 
     def __init__(self, health: FleetHealth, cfg: ResilienceConfig,
                  on_readmit: Callable[[str], None], tick_s: float = 0.1):
@@ -312,10 +317,21 @@ class HealthProber:
                 faultinject.raise_transport_fault(
                     rule, target, timeout=self.cfg.probe_timeout_s)
             with urllib.request.urlopen(
-                    target + "/metrics",
+                    target + "/healthz",
                     timeout=self.cfg.probe_timeout_s) as r:
-                r.read()
+                body = r.read()
         except Exception:
+            self.health.probe_failed(target)
+            return
+        try:
+            import json as _json
+
+            hz = _json.loads(body.decode())
+        except Exception:
+            hz = None  # a 200 that isn't JSON still proves liveness
+        if isinstance(hz, dict) and hz.get("state") == "draining":
+            # alive but mid-drain (rolling swap / scale-down): re-admission
+            # would race the lifecycle layer — keep probing on backoff
             self.health.probe_failed(target)
             return
         self.health.readmit(target)

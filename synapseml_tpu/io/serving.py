@@ -41,8 +41,8 @@ from .resilience import parse_deadline, remaining_s
 
 __all__ = ["ServingServer", "MicroBatchServingEngine", "serve",
            "serve_metrics_exposition", "serve_traces_exposition",
-           "serve_timeline_exposition", "join_or_leak", "request_to_string",
-           "string_to_response"]
+           "serve_timeline_exposition", "join_or_leak", "drain_engine",
+           "prewarm_pipeline", "request_to_string", "string_to_response"]
 
 _logger = get_logger("io.serving")
 
@@ -95,6 +95,23 @@ class ServingServer:
         # by the single engine thread; read lock-free in handler threads
         # (a stale float makes the estimate slightly stale, never wrong).
         self._svc_ewma_s: Optional[float] = None
+        # fleet-lifecycle wiring (io/lifecycle.py): the engine attaches its
+        # generation-tagged pipeline slot here so /healthz can report
+        # {state, generation, inflight} and /control/{drain,resume,swap}
+        # can drive rolling swaps. ``swap_loader(stage_path)`` produces the
+        # new pipeline (default: core.serialization.load_stage);
+        # ``swap_prewarm(pipeline)`` runs it once off the request path.
+        self.lifecycle = None
+        self.swap_loader = None
+        self.swap_prewarm = None
+        # the most recent real request: the pre-warm replay sample a swap
+        # uses to compile the incoming pipeline before the flip
+        self.last_request: Optional[HTTPRequestData] = None
+        # drain-then-stop: once set, new work is answered 503 + Retry-After
+        # (counted in smt_serving_shed_total{reason=shutdown}) while
+        # in-flight requests finish — close() never yanks the listener out
+        # from under held-open exchanges
+        self._shutting_down = False
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -125,8 +142,36 @@ class ServingServer:
                     # Perfetto); same server-answers rule as /traces
                     serve_timeline_exposition(self)
                     return
+                if method == "GET" and op_path == "/healthz":
+                    # the dedicated cheap liveness/lifecycle endpoint: the
+                    # router's re-admission prober and the autoscaler read
+                    # it, so it must answer even mid-drain or mid-swap and
+                    # never occupy a batch slot
+                    outer._serve_healthz(self)
+                    return
                 length = int(self.headers.get("Content-Length") or 0)
                 body = self.rfile.read(length) if length else None
+                if method == "POST" and op_path.startswith("/control/"):
+                    # lifecycle control plane (drain/resume/swap): answered
+                    # in the handler thread, valid even while draining —
+                    # resume must work on a drained worker
+                    outer._serve_control(self, op_path[len("/control/"):],
+                                         body)
+                    return
+                if outer._shutting_down:
+                    # drain-then-stop: the listener is still up so
+                    # in-flight exchanges can finish, but NEW work gets an
+                    # honest 503 + Retry-After instead of riding into a
+                    # closing server
+                    outer._shed("shutdown", count_received=True)
+                    try:
+                        self.send_response(503)
+                        self.send_header("Retry-After", "1")
+                        self.send_header("Content-Length", "0")
+                        self.end_headers()
+                    except OSError:
+                        pass
+                    return
                 # deadline-aware load shedding AT THE DOOR: work that
                 # cannot possibly answer in time must never occupy a batch
                 # slot. Requests without the deadline header (legacy
@@ -185,6 +230,9 @@ class ServingServer:
                 req = HTTPRequestData(
                     url=self.path, method=method,
                     headers=dict(self.headers.items()), entity=body)
+                # the swap pre-warm replay sample (a torn read is impossible
+                # — this is a single reference assignment)
+                outer.last_request = req
                 rid = uuid.uuid4().hex
                 slot = _Pending(req, deadline=deadline)
                 if tracing.is_enabled():
@@ -357,6 +405,90 @@ class ServingServer:
             return 0.0
         return len(self._queue) * svc
 
+    def attach_lifecycle(self, lifecycle, swap_loader=None,
+                         swap_prewarm=None) -> None:
+        """Wire the engine's generation-tagged pipeline slot
+        (``io/lifecycle.py``) into ``/healthz`` + ``/control/*``."""
+        self.lifecycle = lifecycle
+        if swap_loader is not None:
+            self.swap_loader = swap_loader
+        if swap_prewarm is not None:
+            self.swap_prewarm = swap_prewarm
+
+    def begin_shutdown(self) -> None:
+        """Start refusing new work (503 + Retry-After, counted as
+        ``reason=shutdown`` sheds) while in-flight requests finish; the
+        engines call this first so their dispatcher can drain the queue
+        before the listener goes away."""
+        self._shutting_down = True
+
+    def inflight(self) -> int:
+        """Held-open exchanges right now (the /healthz ``inflight``)."""
+        with self._lock:
+            return len(self._pending)
+
+    def _serve_healthz(self, handler) -> None:
+        lc = self.lifecycle
+        payload = lc.healthz() if lc is not None else {
+            "state": "serving", "generation": 0}
+        if self._shutting_down:
+            payload["state"] = "draining"
+        payload["inflight"] = self.inflight()
+        payload["queue_wait_s"] = round(self.estimated_queue_wait_s(), 6)
+        body = json.dumps(payload).encode()
+        try:
+            handler.send_response(200)
+            handler.send_header("Content-Type", "application/json")
+            handler.send_header("Content-Length", str(len(body)))
+            handler.end_headers()
+            handler.wfile.write(body)
+        except OSError:
+            pass
+
+    def _serve_control(self, handler, op: str, body) -> None:
+        """``POST /control/{drain,resume,swap}`` — the worker half of the
+        fleet's rolling swap. Answered entirely in the handler thread; the
+        expensive swap work runs on its own thread (lifecycle.swap_async),
+        never here and never on the request path."""
+        lc = self.lifecycle
+        status, reply = 200, {"ok": True}
+        if lc is None:
+            status, reply = 503, {"error": "no lifecycle attached"}
+        elif op == "drain":
+            lc.begin_drain()
+            reply = lc.healthz()
+        elif op == "resume":
+            lc.resume()
+            reply = lc.healthz()
+        elif op == "swap":
+            try:
+                payload = json.loads((body or b"{}").decode())
+                stage_path = payload["stage_path"]
+                generation = int(payload["generation"])
+            except Exception as e:
+                status, reply = 400, {"error": f"bad swap body: {e}"}
+            else:
+                loader = self.swap_loader or _default_swap_loader
+                accepted = lc.swap_async(
+                    lambda: loader(stage_path), generation,
+                    prewarm=self.swap_prewarm)
+                if accepted:
+                    status, reply = 202, {"generation": generation}
+                else:
+                    status, reply = 409, {"error": "a swap is already "
+                                                   "in flight"}
+        else:
+            status, reply = 404, {"error": f"unknown control op {op!r}"}
+        data = json.dumps(reply).encode()
+        try:
+            handler.send_response(status)
+            handler.send_header("Content-Type", "application/json")
+            handler.send_header("Content-Length", str(len(data)))
+            handler.end_headers()
+            handler.wfile.write(data)
+        except OSError:
+            pass
+
     def get_requests(self, max_n: Optional[int] = None
                      ) -> List[Tuple[str, HTTPRequestData]]:
         """Drain up to ``max_n`` queued request ids (the getBatch analogue).
@@ -434,14 +566,25 @@ class ServingServer:
         lat = list(self._latencies)
         return float(np.quantile(lat, q)) if lat else None
 
-    def close(self) -> None:
-        # release every held-open exchange with 503 so handler threads finish
-        # promptly instead of parking out their reply timeout
+    def close(self, drain_s: float = 0.5) -> None:
+        # drain-then-stop: refuse new work (503 + Retry-After via the
+        # handler's shutdown check) while in-flight requests finish,
+        # bounded by ``drain_s`` — the engines drain their queue before
+        # calling close(), so this wait is normally zero
+        self._shutting_down = True
+        from .lifecycle import wait_until
+
+        wait_until(lambda: not self.inflight(), max(0.0, drain_s),
+                   poll_s=0.02)
+        # release every STILL-held exchange with 503 so handler threads
+        # finish promptly instead of parking out their reply timeout;
+        # these were drained-at-shutdown — count them
         with self._lock:
             pending = list(self._pending.items())
             self._pending.clear()
             self._queue.clear()
         for _rid, slot in pending:
+            self._m_shed.labels(self.server_label, "shutdown").inc()
             slot.response = HTTPResponseData(503, "server shutting down")
             slot.event.set()
             if slot.trace is not None:
@@ -455,8 +598,16 @@ class ServingServer:
         for series in (self._m_requests, self._m_responses, self._m_latency,
                        self._m_admission_rejects):
             series.remove()
-        for reason in ("expired", "overload"):
+        for reason in ("expired", "overload", "shutdown"):
             self._m_shed.remove(self.server_label, reason)
+
+
+def _default_swap_loader(stage_path: str):
+    """The cross-process swap loader: the fleet saved the new pipeline
+    with ``core.serialization.save_stage``; the worker loads it back."""
+    from ..core.serialization import load_stage
+
+    return load_stage(stage_path)
 
 
 def join_or_leak(thread: threading.Thread, timeout: float,
@@ -666,7 +817,10 @@ class MicroBatchServingEngine:
 
     def __init__(self, server: ServingServer, pipeline: Transformer,
                  reply_col: str = "reply", interval: float = 0.01,
-                 max_batch: int = 1024, admission_schema="auto"):
+                 max_batch: int = 1024, admission_schema="auto",
+                 generation: int = 0):
+        from .lifecycle import WorkerLifecycle
+
         self.server = server
         self.pipeline = pipeline
         self.reply_col = reply_col
@@ -674,8 +828,16 @@ class MicroBatchServingEngine:
         self.max_batch = max_batch
         # install the pipeline's declared input schema for admission-time
         # 400s (a schema diff at the door instead of a worker 500)
+        self._admission_knob = admission_schema
         server.admission_schema = resolve_admission_schema(pipeline,
                                                            admission_schema)
+        # the generation-tagged pipeline slot: read once per batch, so a
+        # hot swap flips atomically BETWEEN batches; /healthz + /control
+        # on the server drive it
+        self.lifecycle = WorkerLifecycle(pipeline, generation,
+                                         on_swap=self._on_swap)
+        server.attach_lifecycle(self.lifecycle,
+                                swap_prewarm=self._prewarm)
         self._stop = threading.Event()
         self._error: Optional[BaseException] = None
         self._thread = threading.Thread(target=self._run, name="serving-engine",
@@ -699,6 +861,16 @@ class MicroBatchServingEngine:
     def _collect_metrics(self) -> None:
         self._m_batches.sync_total(self.batches_processed)
 
+    def _on_swap(self, pipeline) -> None:
+        """Slot-flip hook: the engine's view of the pipeline (and the
+        admission schema derived from it) follows the new generation."""
+        self.pipeline = pipeline
+        self.server.admission_schema = resolve_admission_schema(
+            pipeline, self._admission_knob)
+
+    def _prewarm(self, pipeline) -> None:
+        prewarm_pipeline(self.server, pipeline)
+
     def start(self) -> "MicroBatchServingEngine":
         self._thread.start()
         return self
@@ -714,10 +886,12 @@ class MicroBatchServingEngine:
             reqs = np.empty(len(batch), dtype=object)
             reqs[:] = [r for _, r in batch]
             table = Table({"id": np.array(ids, dtype=object), "request": reqs})
+            # one slot read per batch: the atomic hot-swap flip point
+            pipeline, _generation = self.lifecycle.current()
             t0 = time.perf_counter()
             try:
                 with traced_batch(self.server, ids, "microbatch"):
-                    out = self.pipeline.transform(table)
+                    out = pipeline.transform(table)
                     replies = out[self.reply_col]
                     out_ids = out["id"]
                     # observed INSIDE the batch trace so the bucket gets
@@ -749,6 +923,11 @@ class MicroBatchServingEngine:
             self.batches_processed += 1
 
     def stop(self) -> None:
+        # drain-then-stop: refuse new work first, let the dispatcher
+        # answer what is already in flight (bounded), THEN stop the loop
+        # and the listener — a shutdown never drops accepted requests
+        self.server.begin_shutdown()
+        drain_engine(self.server, self._stop)
         self._stop.set()
         self._work.set()
         join_or_leak(self._thread, 5.0,
@@ -760,6 +939,39 @@ class MicroBatchServingEngine:
             series.remove()
         if self._error is not None:
             _logger.warning("serving engine saw pipeline errors; last: %s", self._error)
+
+
+def prewarm_pipeline(server: ServingServer, pipeline) -> bool:
+    """Run ``pipeline`` once on a replay of the server's most recent real
+    request — the off-request-path compile a hot swap pays BEFORE the
+    flip, so the first post-swap batch is warm. False when no request has
+    been seen yet (nothing to replay; the persisted AOT cache still
+    covers previously-seen jit signatures)."""
+    req = server.last_request
+    if req is None:
+        return False
+    reqs = np.empty(1, dtype=object)
+    reqs[0] = req
+    pipeline.transform(Table({"id": np.array(["_warmup"], dtype=object),
+                              "request": reqs}))
+    return True
+
+
+def drain_engine(server: ServingServer, stop_event: threading.Event,
+                 timeout_s: float = 2.0) -> bool:
+    """Wait (bounded) for the server's held-open exchanges to be answered
+    while the engine's dispatcher is still running — the engine half of
+    drain-then-stop. The server must already be refusing new work
+    (``begin_shutdown``), so the in-flight set can only shrink. True when
+    fully drained."""
+    deadline = time.monotonic() + min(timeout_s, server.reply_timeout)
+    while time.monotonic() < deadline and not stop_event.is_set():
+        with server._lock:
+            busy = bool(server._pending) or bool(server._queue)
+        if not busy:
+            return True
+        time.sleep(0.02)
+    return not server.inflight()
 
 
 def respond_batch(server, batch_ids, out_ids, replies) -> None:

@@ -45,12 +45,16 @@ from ..observability import (get_registry, histogram_quantile,
                              merge_snapshots, merge_traces, tracing)
 from . import faultinject
 from .http_schema import HTTPResponseData
+from .lifecycle import (LifecycleConfig, LoadAwareBalancer, WorkerLifecycle,
+                        healthz as lifecycle_healthz, post_control,
+                        wait_until)
 from .resilience import (BreakerBoard, FleetHealth, HEALTHY, HealthProber,
                          HedgePolicy, ResilienceConfig, RetryBudget,
                          WORKER_STATES, inject_deadline, parse_deadline,
                          remaining_s)
-from .serving import (MicroBatchServingEngine, ServingServer, engine_metrics,
-                      join_or_leak, resolve_admission_schema, respond_batch,
+from .serving import (MicroBatchServingEngine, ServingServer, drain_engine,
+                      engine_metrics, join_or_leak, prewarm_pipeline,
+                      resolve_admission_schema, respond_batch,
                       serve_metrics_exposition, serve_timeline_exposition,
                       serve_traces_exposition, traced_batch)
 
@@ -66,7 +70,7 @@ class ContinuousServingEngine:
 
     def __init__(self, server: ServingServer, pipeline: Transformer,
                  reply_col: str = "reply", max_batch: int = 1024,
-                 admission_schema="auto"):
+                 admission_schema="auto", generation: int = 0):
         self.server = server
         self.pipeline = pipeline
         self.reply_col = reply_col
@@ -74,8 +78,15 @@ class ContinuousServingEngine:
         # admission-time request validation against the pipeline's declared
         # input schema (core.schema): a 400 with the schema diff at the
         # door, not a worker 500 mid-batch
+        self._admission_knob = admission_schema
         server.admission_schema = resolve_admission_schema(pipeline,
                                                            admission_schema)
+        # generation-tagged pipeline slot (io/lifecycle.py): read once per
+        # batch, so a hot swap flips atomically between batches
+        self.lifecycle = WorkerLifecycle(pipeline, generation,
+                                         on_swap=self._on_swap)
+        server.attach_lifecycle(self.lifecycle,
+                                swap_prewarm=self._prewarm)
         self._work = threading.Event()
         self._stop = threading.Event()
         self._error: Optional[BaseException] = None
@@ -92,6 +103,14 @@ class ContinuousServingEngine:
 
     def _collect_metrics(self) -> None:
         self._m_batches.sync_total(self.batches_processed)
+
+    def _on_swap(self, pipeline) -> None:
+        self.pipeline = pipeline
+        self.server.admission_schema = resolve_admission_schema(
+            pipeline, self._admission_knob)
+
+    def _prewarm(self, pipeline) -> None:
+        prewarm_pipeline(self.server, pipeline)
 
     def start(self) -> "ContinuousServingEngine":
         self._thread.start()
@@ -114,10 +133,12 @@ class ContinuousServingEngine:
         reqs = np.empty(len(batch), dtype=object)
         reqs[:] = [r for _, r in batch]
         table = Table({"id": np.array(ids, dtype=object), "request": reqs})
+        # one slot read per batch: the atomic hot-swap flip point
+        pipeline, _generation = self.lifecycle.current()
         t0 = time.perf_counter()
         try:
             with traced_batch(self.server, ids, "continuous"):
-                out = self.pipeline.transform(table)
+                out = pipeline.transform(table)
                 replies, out_ids = out[self.reply_col], out["id"]
                 # inside the batch trace: the bucket gets the leader
                 # request's exemplar
@@ -151,6 +172,10 @@ class ContinuousServingEngine:
         return self.server.latency_quantile(0.5)
 
     def stop(self) -> None:
+        # drain-then-stop: refuse new work, let the dispatcher answer the
+        # in-flight set (bounded), then stop the loop and the listener
+        self.server.begin_shutdown()
+        drain_engine(self.server, self._stop)
         self._stop.set()
         self._work.set()
         # a dispatcher wedged inside the pipeline would previously leak
@@ -242,6 +267,15 @@ class RoutingServer:
         self._lock = threading.Lock()
         self._rr = count()
         self._state_targets: set = set()
+        # drain-then-stop bookkeeping: handler threads inside _route
+        self._closing = False
+        self._active_forwards = 0
+        # load-aware routing over live per-worker signals (pick-2 by
+        # attempt p99 × in-flight; RR while cold)
+        lcfg = LifecycleConfig.from_env()
+        self._balancer = LoadAwareBalancer(
+            min_samples=lcfg.pick2_min_samples, window=lcfg.latency_window,
+            seed=(resilience.seed if resilience is not None else None))
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -265,6 +299,22 @@ class RoutingServer:
                     # spans carry their recording process's pid, so the
                     # router and every worker render as separate tracks
                     serve_timeline_exposition(self, outer.fleet_traces())
+                    return
+                if outer._closing:
+                    # drain-then-stop: the listener stays up while
+                    # in-flight forwards finish, but NEW work is refused
+                    # with honest backpressure instead of a torn socket
+                    outer._m_shed.labels(outer.server_label,
+                                         "shutdown").inc()
+                    with outer._lock:
+                        outer.requests_routed += 1
+                    try:
+                        self.send_response(503)
+                        self.send_header("Retry-After", "1")
+                        self.send_header("Content-Length", "0")
+                        self.end_headers()
+                    except OSError:
+                        pass
                     return
                 targets = outer.registry.lookup(outer.service)
                 if not targets:
@@ -318,12 +368,19 @@ class RoutingServer:
                 fwd_headers = {k: v for k, v in self.headers.items()
                                if k.lower() not in drop}
                 inject_deadline(fwd_headers, deadline)
-                start = next(outer._rr)
-                order = [targets[(start + k) % len(targets)]
-                         for k in range(len(targets))]
-                reply, fail = outer._route(order, method, self.path, body,
-                                           fwd_headers, deadline, idempotent,
-                                           route_span)
+                # load-aware candidate order (io/lifecycle.py): weighted
+                # pick-2 by observed per-worker attempt p99 × in-flight,
+                # degrading to round-robin while the windows are cold
+                order = outer._balancer.order(targets, next(outer._rr))
+                with outer._lock:
+                    outer._active_forwards += 1
+                try:
+                    reply, fail = outer._route(order, method, self.path,
+                                               body, fwd_headers, deadline,
+                                               idempotent, route_span)
+                finally:
+                    with outer._lock:
+                        outer._active_forwards -= 1
                 if route_span is not None:
                     if reply is None:
                         status = {"timeout": 504, "deadline": 504,
@@ -415,6 +472,12 @@ class RoutingServer:
             "smt_routing_attempt_latency_seconds",
             "per-forward-attempt latency",
             ("server",)).labels(label)
+        # drained-at-shutdown requests share the worker-side shed family
+        # (one place to alert on shed work, whatever the reason)
+        self._m_shed = reg.counter(
+            "smt_serving_shed_total",
+            "requests shed by deadline-aware admission",
+            ("server", "reason"))
         self._m_breaker_trans = reg.counter(
             "smt_routing_breaker_transitions_total",
             "circuit-breaker state transitions",
@@ -456,6 +519,9 @@ class RoutingServer:
         — put it back in the routing table with a clean breaker."""
         self.registry.register(self.service, target)
         self._breakers.reset(target)
+        # a restarted worker's latency history is stale: start it cold
+        # (round-robin) until its window re-warms
+        self._balancer.forget(target)
         with self._lock:
             self.workers_readmitted += 1
         _logger.info("re-admitted worker %s after a successful probe", target)
@@ -556,6 +622,7 @@ class RoutingServer:
         ok = False
         reply = None
         error: Optional[BaseException] = None
+        self._balancer.note_start(target)
         t0 = time.perf_counter()
         try:
             rule = faultinject.act("router.forward",
@@ -585,6 +652,11 @@ class RoutingServer:
             # connection resets and mid-body disconnects land here
             kind, error = "dead", e
         latency = time.perf_counter() - t0
+        # only a SUCCESSFUL reply feeds the routing score: an instant 4xx
+        # must not make a broken worker the pick-2 favourite
+        self._balancer.note_end(target, latency,
+                                success=(kind == "reply"
+                                         and reply[0] < 400))
         self._m_attempt_lat.observe(latency)
         self._breakers.on_result(target, ok, latency)
         if kind == "reply":
@@ -760,7 +832,19 @@ class RoutingServer:
         return merge_traces([tracing.get_tracer().snapshot()]
                             + self._scrape_workers("/traces"))
 
-    def close(self) -> None:
+    def close(self, drain_s: float = 5.0) -> None:
+        # drain-then-stop: refuse NEW work (503 + Retry-After, counted in
+        # smt_serving_shed_total{reason=shutdown}) while handler threads
+        # already inside _route finish their forwards, bounded by
+        # ``drain_s`` ∧ the router timeout — in-flight requests are never
+        # cut off by the listener disappearing under them
+        self._closing = True
+
+        def _idle() -> bool:
+            with self._lock:
+                return self._active_forwards == 0
+
+        wait_until(_idle, max(0.0, min(drain_s, self.timeout)), poll_s=0.02)
         self._prober.request_stop()
         join_or_leak(self._prober.thread, 2.0,
                      f"routing-prober:{self.server_label}")
@@ -782,6 +866,7 @@ class RoutingServer:
             series.remove()
         for state in ("closed", "open", "half_open"):
             self._m_breaker_trans.remove(self.server_label, state)
+        self._m_shed.remove(self.server_label, "shutdown")
         with self._lock:
             targets = set(self._state_targets)
         for t in targets:
@@ -799,6 +884,10 @@ class DistributedServingEngine:
                  admission_schema="auto",
                  resilience: Optional[ResilienceConfig] = None):
         self.registry = ServiceRegistry()
+        self.service = service
+        self.generation = 0
+        # serializes concurrent swap() calls (and guards `generation`)
+        self._swap_lock = threading.Lock()
         self.workers = []
         for _ in range(n_workers):
             server = ServingServer(host, 0, reply_timeout=reply_timeout)
@@ -823,6 +912,40 @@ class DistributedServingEngine:
 
     def routing_table(self) -> Dict[str, List[str]]:
         return self.registry.routing_table()
+
+    def swap(self, pipeline: Transformer,
+             cfg: Optional[LifecycleConfig] = None) -> int:
+        """Zero-downtime rolling hot swap across the in-process fleet:
+        one worker at a time is drained (unregistered from the routing
+        table, in-flight requests allowed to finish), its slot flipped to
+        the new pipeline (pre-warmed off the request path), then
+        re-admitted — at every instant the remaining workers keep
+        serving, so no request is ever dropped. Returns the new
+        generation."""
+        cfg = cfg or LifecycleConfig.from_env()
+        with self._swap_lock:
+            gen = self.generation + 1
+            for eng in self.workers:
+                addr = eng.server.address
+                eng.lifecycle.begin_drain()
+                self.registry.unregister(self.service, addr)
+                try:
+                    wait_until(lambda: eng.server.inflight() == 0,
+                               cfg.drain_timeout_s, cfg.poll_interval_s)
+                    if not eng.lifecycle.swap_async(lambda: pipeline, gen,
+                                                    prewarm=eng._prewarm):
+                        raise RuntimeError("a swap is already in flight")
+                    if not wait_until(
+                            lambda: eng.lifecycle.generation == gen,
+                            cfg.swap_timeout_s, cfg.poll_interval_s):
+                        raise RuntimeError(
+                            f"swap did not complete: "
+                            f"{eng.lifecycle.swap_error()}")
+                finally:
+                    eng.lifecycle.resume()
+                    self.registry.register(self.service, addr)
+            self.generation = gen
+        return gen
 
     def latency_p50(self) -> Optional[float]:
         """FLEET p50 from the workers' latency histograms merged bucket-wise.
@@ -868,7 +991,9 @@ class ProcessServingFleet:
                  import_modules: Optional[List[str]] = None,
                  trace_knobs: Optional[Dict[str, float]] = None,
                  resilience: Optional[ResilienceConfig] = None,
-                 fault_plan=None):
+                 fault_plan=None,
+                 aot_cache_dir: Optional[str] = None,
+                 lifecycle: Optional[LifecycleConfig] = None):
         import json as _json
         import os
         import shutil
@@ -878,11 +1003,20 @@ class ProcessServingFleet:
         from ..core.serialization import save_stage
 
         self._tmp = tempfile.mkdtemp(prefix="serving_fleet_")
-        stage_path = os.path.join(self._tmp, "pipeline")
-        save_stage(pipeline, stage_path)
+        self.generation = 0
+        self._stage_path = os.path.join(self._tmp, "pipeline_g0")
+        save_stage(pipeline, self._stage_path)
         self.registry = ServiceRegistry()
         self.service = service
         self.startup_timeout = startup_timeout
+        self.lifecycle_cfg = lifecycle or LifecycleConfig.from_env()
+        self._autoscaler = None
+        # the autoscaler mutates the fleet from its own thread: _ops_lock
+        # serializes the slow mutators (swap/add/remove/restart) against
+        # each other; _lists_lock keeps the procs/addresses PAIR coherent
+        # for readers (it is never held across I/O)
+        self._ops_lock = threading.RLock()
+        self._lists_lock = threading.Lock()
         self.procs = []
         self.addresses = []
         repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
@@ -896,12 +1030,23 @@ class ProcessServingFleet:
             env[faultinject.ENV_VAR] = (
                 fault_plan if isinstance(fault_plan, str)
                 else _json.dumps(fault_plan))
+        # persisted-AOT warm start: every worker shares one on-disk
+        # executable cache ("auto" = under the fleet tempdir), and fresh
+        # workers (scale-up / restart) pre-warm from it BEFORE announcing
+        # their address — previously-seen jit signatures serve their first
+        # request without a cold XLA compile
+        self.aot_cache_dir = None
+        if aot_cache_dir is not None:
+            self.aot_cache_dir = (os.path.join(self._tmp, "aot")
+                                  if aot_cache_dir == "auto"
+                                  else aot_cache_dir)
+            os.makedirs(self.aot_cache_dir, exist_ok=True)
+            env["SMT_AOT_CACHE_DIR"] = self.aot_cache_dir
         self._env = env
-        cmd = [sys.executable, "-m", "synapseml_tpu.io.serving_worker",
-               stage_path, "--host", host, "--mode", mode,
-               "--reply-timeout", str(reply_timeout)]
+        flags = ["--host", host, "--mode", mode,
+                 "--reply-timeout", str(reply_timeout)]
         for mod in (import_modules or []):
-            cmd += ["--import-module", mod]
+            flags += ["--import-module", mod]
         # tail-sampling knobs for the worker processes' flight recorders
         # (keys: sample_rate, slow_ms, capacity); unset keys keep the
         # worker's env/default configuration
@@ -910,8 +1055,10 @@ class ProcessServingFleet:
                                 ("capacity", "--trace-capacity",
                                  lambda v: str(int(v)))):
             if trace_knobs and trace_knobs.get(key) is not None:
-                cmd += [flag, conv(trace_knobs[key])]
-        self._cmd = cmd
+                flags += [flag, conv(trace_knobs[key])]
+        if self.aot_cache_dir is not None:
+            flags += ["--prewarm-aot"]
+        self._cmd_flags = flags
         import time as _time
 
         try:
@@ -938,16 +1085,26 @@ class ProcessServingFleet:
             shutil.rmtree(self._tmp, ignore_errors=True)
             raise
 
+    def _worker_cmd(self, port: int = 0) -> List[str]:
+        """The worker argv for the CURRENT generation: a swap updates
+        ``_stage_path``/``generation``, so restarts and scale-ups always
+        serve the fleet's live pipeline, never the boot-time one."""
+        import sys
+
+        cmd = [sys.executable, "-m", "synapseml_tpu.io.serving_worker",
+               self._stage_path] + list(self._cmd_flags)
+        cmd += ["--generation", str(self.generation)]
+        if port:
+            cmd += ["--port", str(port)]
+        return cmd
+
     def _launch_worker(self, port: int = 0):
         """Popen one worker process (no handshake yet). ``port`` pins the
         listen port — how ``restart_worker`` resurrects a kill victim at
         its old address so the router's prober can re-admit it."""
         import subprocess
 
-        cmd = list(self._cmd)
-        if port:
-            cmd += ["--port", str(port)]
-        return subprocess.Popen(cmd, stdout=subprocess.PIPE,
+        return subprocess.Popen(self._worker_cmd(port), stdout=subprocess.PIPE,
                                 stderr=subprocess.DEVNULL, text=True,
                                 env=self._env)
 
@@ -1045,25 +1202,180 @@ class ProcessServingFleet:
         trip ``tests/test_serving_process_fleet.py`` proves."""
         import time
 
-        addr = self.addresses[i]
-        port = int(addr.rsplit(":", 1)[1])
-        if self.procs[i].poll() is None:
-            self.procs[i].kill()
-            self.procs[i].wait()
-        p = self._launch_worker(port=port)
-        try:
-            new_addr = self._handshake(
-                p, time.monotonic() + self.startup_timeout)
-        except BaseException:
-            p.kill()
-            raise
-        assert new_addr == addr, (new_addr, addr)
-        self.procs[i] = p
+        with self._ops_lock:
+            addr = self.addresses[i]
+            port = int(addr.rsplit(":", 1)[1])
+            if self.procs[i].poll() is None:
+                self.procs[i].kill()
+                self.procs[i].wait()
+            p = self._launch_worker(port=port)
+            try:
+                new_addr = self._handshake(
+                    p, time.monotonic() + self.startup_timeout)
+            except BaseException:
+                p.kill()
+                raise
+            assert new_addr == addr, (new_addr, addr)
+            with self._lists_lock:
+                self.procs[i] = p
         return addr
+
+    def live_addresses(self) -> List[str]:
+        """Addresses whose worker process is still alive."""
+        with self._lists_lock:
+            pairs = list(zip(self.addresses, self.procs))
+        return [a for a, p in pairs if p.poll() is None]
+
+    # -- zero-downtime lifecycle -------------------------------------------
+    def swap(self, pipeline: Transformer,
+             cfg: Optional[LifecycleConfig] = None) -> int:
+        """Zero-downtime rolling hot swap across the worker PROCESSES.
+
+        The new pipeline is saved once (``core.serialization.save_stage``)
+        and each worker, one at a time, is: told to drain (its ``/healthz``
+        reports ``draining``, so the router's prober cannot re-admit it
+        mid-roll), unregistered from the routing table, waited to
+        ``inflight == 0``, told to ``/control/swap`` (the worker loads +
+        pre-warms OFF the request path and flips between batches), then
+        resumed and re-registered. The rest of the fleet serves throughout
+        — no request is ever dropped. A worker that DIES mid-roll is
+        skipped (it stays out of the routing table) and the roll completes
+        on the survivors. Returns the new generation."""
+        import os
+
+        cfg = cfg or self.lifecycle_cfg
+        from ..core.serialization import save_stage
+
+        with self._ops_lock:  # serialized against autoscaler add/remove
+            gen = self.generation + 1
+            stage_path = os.path.join(self._tmp, f"pipeline_g{gen}")
+            save_stage(pipeline, stage_path)
+            for addr in self.live_addresses():
+                if not self._swap_one(addr, stage_path, gen, cfg):
+                    _logger.warning(
+                        "rolling swap did not land on worker %s "
+                        "(re-admitted if still alive); continuing on "
+                        "the rest", addr)
+            # restarts/scale-ups from here on serve the new generation
+            self._stage_path = stage_path
+            self.generation = gen
+        return gen
+
+    def _swap_one(self, addr: str, stage_path: str, gen: int,
+                  cfg: LifecycleConfig) -> bool:
+        """Drain -> swap -> re-admit ONE worker; False when the swap did
+        not land. EVERY exit path re-admits a worker that still answers —
+        a transient swap failure (409 from a straggling prior swap, a slow
+        load) must not strand a LIVE worker in ``draining`` forever (the
+        prober refuses draining workers, so nothing else would ever bring
+        it back). Only a worker that stopped answering stays out."""
+        status, _ = post_control(addr, "drain",
+                                 timeout=cfg.healthz_timeout_s)
+        if status != 200:
+            self.registry.unregister(self.service, addr)
+            return False
+        self.registry.unregister(self.service, addr)
+        swapped = False
+        try:
+            wait_until(
+                lambda: (lifecycle_healthz(addr, cfg.healthz_timeout_s)
+                         or {}).get("inflight") == 0,
+                cfg.drain_timeout_s, cfg.poll_interval_s)
+            status, _ = post_control(
+                addr, "swap",
+                {"stage_path": stage_path, "generation": gen},
+                timeout=cfg.healthz_timeout_s)
+            if status == 202:
+                swapped = wait_until(
+                    lambda: (lifecycle_healthz(addr, cfg.healthz_timeout_s)
+                             or {}).get("generation") == gen,
+                    cfg.swap_timeout_s, cfg.poll_interval_s)
+        except Exception:
+            swapped = False
+        # re-admission is unconditional-if-alive: even when the flip did
+        # not (yet) land, a worker serving the OLD generation is strictly
+        # better than a stranded one (and an accepted-but-slow swap still
+        # flips between batches whenever it finishes)
+        status, _ = post_control(addr, "resume",
+                                 timeout=cfg.healthz_timeout_s)
+        if status != 200:
+            return False  # stopped answering: stays unregistered
+        self.registry.register(self.service, addr)
+        return swapped
+
+    def add_worker(self) -> Optional[str]:
+        """Scale UP: spawn one more worker serving the CURRENT generation.
+        With a shared AOT cache dir the worker pre-warms every persisted
+        signature BEFORE announcing its address (= before registration),
+        so its first routed request is warm-start bounded. Returns the new
+        address (None on startup failure)."""
+        import time as _time
+
+        with self._ops_lock:
+            try:
+                p = self._launch_worker()
+                addr = self._handshake(
+                    p, _time.monotonic() + self.startup_timeout)
+            except BaseException:
+                _logger.exception("scale-up worker failed to start")
+                return None
+            with self._lists_lock:
+                self.procs.append(p)
+                self.addresses.append(addr)
+            self.registry.register(self.service, addr)
+        return addr
+
+    def remove_worker(self, i: Optional[int] = None,
+                      cfg: Optional[LifecycleConfig] = None
+                      ) -> Optional[str]:
+        """Scale DOWN via drain, never kill: the victim is marked draining
+        (prober-proof), unregistered, waited to ``inflight == 0``, and
+        only then terminated. Returns its address (None when the fleet is
+        already at one live worker — a scale-down must not empty it)."""
+        cfg = cfg or self.lifecycle_cfg
+        with self._ops_lock:
+            with self._lists_lock:
+                live = [k for k, p in enumerate(self.procs)
+                        if p.poll() is None]
+                if len(live) <= 1:
+                    return None
+                if i is None:
+                    i = live[-1]
+                addr = self.addresses[i]
+                p = self.procs[i]
+            post_control(addr, "drain", timeout=cfg.healthz_timeout_s)
+            self.registry.unregister(self.service, addr)
+            wait_until(
+                lambda: (lifecycle_healthz(addr, cfg.healthz_timeout_s)
+                         or {"inflight": 0}).get("inflight") == 0,
+                cfg.drain_timeout_s, cfg.poll_interval_s)
+            if p.poll() is None:
+                p.terminate()
+                try:
+                    p.wait(timeout=10)
+                except Exception:
+                    p.kill()
+            with self._lists_lock:
+                self.procs.pop(i)
+                self.addresses.pop(i)
+        return addr
+
+    def start_autoscaler(self, cfg: Optional[LifecycleConfig] = None):
+        """Attach + start the SLO control loop (``io/lifecycle.py``) over
+        this fleet; returns the :class:`Autoscaler` (stopped by
+        ``fleet.stop()``)."""
+        from .lifecycle import Autoscaler, ProcessFleetAdapter
+
+        cfg = cfg or self.lifecycle_cfg
+        self._autoscaler = Autoscaler(
+            ProcessFleetAdapter(self, cfg), cfg).start()
+        return self._autoscaler
 
     def stop(self) -> None:
         import shutil
 
+        if self._autoscaler is not None:
+            self._autoscaler.stop()
         self.router.close()
         for p in self.procs:
             if p.poll() is None:

@@ -47,6 +47,16 @@ def main(argv=None) -> int:
     # argparse time); the Tracer constructor truncates to int
     ap.add_argument("--trace-capacity", type=float, default=None,
                     help="total traces kept in the ring")
+    ap.add_argument("--generation", type=int, default=0,
+                    help="initial pipeline generation (a fleet spawning a "
+                         "worker after N rolling swaps passes N so "
+                         "/healthz reports the truth)")
+    ap.add_argument("--prewarm-aot", action="store_true",
+                    help="deserialize every persisted AOT executable "
+                         "(SMT_AOT_CACHE_DIR) for the loaded pipeline's "
+                         "jit entry points BEFORE announcing the address "
+                         "— previously-seen signatures then serve their "
+                         "first request without a cold XLA compile")
     args = ap.parse_args(argv)
 
     import importlib
@@ -73,16 +83,40 @@ def main(argv=None) -> int:
                                  if args.trace_slow_ms is not None
                                  else None)))
 
+    import time as _time
+
+    t_load0 = _time.perf_counter()
     pipeline = load_stage(args.stage_path)
+    prewarmed = {}
+    if args.prewarm_aot:
+        # warm start BEFORE the address announcement (= before the fleet
+        # registers this worker): every persisted executable the fleet has
+        # ever compiled for these entry points deserializes now, off the
+        # serving path entirely
+        from ..observability.profiling import prewarm_aot_cache
+
+        prewarmed = prewarm_aot_cache()
+    ready_s = _time.perf_counter() - t_load0
     server = ServingServer(args.host, args.port,
                            reply_timeout=args.reply_timeout)
     if args.mode == "continuous":
-        engine = ContinuousServingEngine(server, pipeline,
-                                         reply_col=args.reply_col).start()
+        engine = ContinuousServingEngine(
+            server, pipeline, reply_col=args.reply_col,
+            generation=args.generation).start()
     else:
-        engine = MicroBatchServingEngine(server, pipeline,
-                                         reply_col=args.reply_col).start()
+        engine = MicroBatchServingEngine(
+            server, pipeline, reply_col=args.reply_col,
+            generation=args.generation).start()
+    import json as _json
+
     print(f"ADDRESS {server.address}", flush=True)
+    # AFTER the address announcement: the parent's handshake select()s on
+    # an unbuffered view of stdout, so ADDRESS must be the first line;
+    # benches read this one to attribute load-vs-prewarm time without a
+    # second channel
+    print("PREWARM " + _json.dumps(
+        {"loaded": sum(prewarmed.values()), "fns": prewarmed,
+         "ready_s": round(ready_s, 4)}), flush=True)
     try:
         threading.Event().wait()  # serve until killed
     except KeyboardInterrupt:

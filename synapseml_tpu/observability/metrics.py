@@ -352,8 +352,16 @@ class MetricsRegistry:
                 continue
             try:
                 fn()
-            except Exception:  # a broken collector must not kill scrapes
-                pass
+            except Exception:
+                # a broken collector must not kill scrapes, but eating it
+                # silently hides a dead gauge forever (lint SMT012) — say
+                # which one broke, at debug so a flapping collector cannot
+                # flood the log on every scrape
+                import logging
+
+                logging.getLogger("synapseml_tpu").debug(
+                    "metrics collector %r failed during snapshot",
+                    getattr(fn, "__qualname__", fn), exc_info=True)
         if dead:
             with self._lock:
                 self._collectors = [r for r in self._collectors

@@ -10,8 +10,9 @@ import os
 
 import numpy as np
 
-from synapseml_tpu.core import Table, Transformer
+from synapseml_tpu.core import Param, Table, Transformer
 from synapseml_tpu.io.http_schema import HTTPResponseData
+from synapseml_tpu.observability.profiling import profiled_jit
 
 
 class PidEchoReply(Transformer):
@@ -25,4 +26,58 @@ class PidEchoReply(Transformer):
         body = str(os.getpid()).encode()
         replies[:] = [HTTPResponseData(200, "OK", entity=body)
                       for _ in range(n)]
+        return table.with_column("reply", replies)
+
+
+class TagEchoReply(Transformer):
+    """Replies ``{tag}:{pid}:{body}`` — the hot-swap tests flip ``tag``
+    across generations, so a reply PROVES which pipeline generation (and
+    which worker process) served it."""
+
+    tag = Param("generation tag echoed in every reply", str, default="g0")
+
+    def _transform(self, table: Table) -> Table:
+        n = table.num_rows
+        pid = os.getpid()
+        reqs = table["request"]
+        replies = np.empty(n, dtype=object)
+        for i, r in enumerate(reqs):
+            body = (r.entity or b"").decode()
+            replies[i] = HTTPResponseData(
+                200, "OK", entity=f"{self.tag}:{pid}:{body}".encode())
+        return table.with_column("reply", replies)
+
+
+def _burn_impl(x):
+    import jax.numpy as jnp
+
+    for _ in range(30):
+        x = jnp.tanh(x @ x.T) @ x
+    return x
+
+
+# module-level so every process that imports this module shares one entry
+# point (the persisted AOT cache is keyed by this name)
+burn = profiled_jit(_burn_impl, name="test.lifecycle_burn")
+
+
+class JitBurnReply(Transformer):
+    """Runs a deliberately compile-heavy profiled jit once per batch, then
+    echoes ``{pid}:{body}`` — the warm-start tests' workload: a cold
+    worker pays a multi-hundred-ms XLA compile on its first batch, a
+    warm-started one (persisted AOT cache) does not."""
+
+    reply_col = "reply"
+
+    def _transform(self, table: Table) -> Table:
+        x = np.ones((48, 48), np.float32)
+        burn(x)
+        n = table.num_rows
+        pid = os.getpid()
+        reqs = table["request"]
+        replies = np.empty(n, dtype=object)
+        for i, r in enumerate(reqs):
+            body = (r.entity or b"").decode()
+            replies[i] = HTTPResponseData(
+                200, "OK", entity=f"{pid}:{body}".encode())
         return table.with_column("reply", replies)

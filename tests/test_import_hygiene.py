@@ -36,6 +36,7 @@ _GATED_MODULES = [
     "synapseml_tpu.observability.spans",
     "synapseml_tpu.observability.tracing",
     "synapseml_tpu.io.faultinject",
+    "synapseml_tpu.io.lifecycle",
     "synapseml_tpu.io.resilience",
     "synapseml_tpu.io.serving",
     "synapseml_tpu.io.serving_v2",

@@ -1,0 +1,574 @@
+"""Fleet lifecycle: hot swap, SLO autoscaling, load-aware routing, drain.
+
+The zero-downtime contract is proved the only way that means anything: a
+per-body exactly-once ledger under sustained load while the lifecycle
+transition (rolling swap, scale-down drain, shutdown) happens mid-stream —
+every body answered exactly once, zero 5xx attributable to the transition.
+The autoscaler's flap-proofness is proved deterministically: seeded noisy
+observations driven through the control loop with a fake clock can never
+produce more than one scale transition per cooldown window.
+"""
+
+import json
+import os
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from synapseml_tpu.io import faultinject
+from synapseml_tpu.io.lifecycle import (Autoscaler, FleetObservation,
+                                        LifecycleConfig, LoadAwareBalancer,
+                                        WorkerLifecycle)
+from synapseml_tpu.io.resilience import (EVICTED, FleetHealth, HealthProber,
+                                         ResilienceConfig)
+from synapseml_tpu.io.serving_v2 import (DistributedServingEngine,
+                                         ProcessServingFleet,
+                                         serve_continuous)
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+from tests.serving_fault_stage import PidEchoReply, TagEchoReply  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _fresh_observability():
+    """Fresh registry + tracer per test: the in-process engines here run
+    real pipelines in THIS process, and their stage-span series (with
+    exemplars pointing at this session's tracer) must not leak into the
+    process-default registry that later suites' fleet merges scrape."""
+    from synapseml_tpu.observability import tracing
+    from synapseml_tpu.observability.metrics import (MetricsRegistry,
+                                                     set_registry)
+
+    prev = set_registry(MetricsRegistry())
+    prev_tracer = tracing.get_tracer()
+    tracing.set_tracer(tracing.Tracer())
+    try:
+        yield
+    finally:
+        set_registry(prev)
+        tracing.set_tracer(prev_tracer)
+
+
+def _get(url, timeout=10.0):
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return r.status, r.read().decode()
+
+
+def _post(url, body, timeout=10.0):
+    req = urllib.request.Request(url, data=body.encode(), method="POST")
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, r.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+# ---------------------------------------------------------------------------
+# the generation-tagged slot
+# ---------------------------------------------------------------------------
+
+def test_worker_lifecycle_slot_and_states():
+    lc = WorkerLifecycle("pipe-a", generation=0)
+    assert lc.current() == ("pipe-a", 0)
+    assert lc.state() == "serving"
+    lc.begin_drain()
+    assert lc.state() == "draining"
+    hz = lc.healthz()
+    assert hz["state"] == "draining" and hz["generation"] == 0
+    lc.resume()
+    lc.install("pipe-b", 1)
+    assert lc.current() == ("pipe-b", 1)
+    assert lc.state() == "serving"
+
+
+def test_worker_lifecycle_swap_async_prewarms_then_flips():
+    seen = []
+    lc = WorkerLifecycle("old", generation=3)
+    ok = lc.swap_async(lambda: "new", 4, prewarm=seen.append)
+    assert ok
+    deadline = time.monotonic() + 5.0
+    while lc.generation != 4 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert lc.current() == ("new", 4)
+    assert seen == ["new"]  # pre-warm ran on the incoming pipeline
+    assert lc.swap_error() is None
+
+
+def test_worker_lifecycle_swap_failure_keeps_old_generation():
+    lc = WorkerLifecycle("old", generation=1)
+
+    def boom():
+        raise RuntimeError("no such stage")
+
+    assert lc.swap_async(boom, 2)
+    deadline = time.monotonic() + 5.0
+    while lc.swap_error() is None and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert "no such stage" in lc.swap_error()
+    assert lc.current() == ("old", 1)  # the flip never happened
+    assert "swap_error" in lc.healthz()
+
+
+# ---------------------------------------------------------------------------
+# load-aware routing (pick-2)
+# ---------------------------------------------------------------------------
+
+def test_balancer_cold_windows_degrade_to_round_robin():
+    b = LoadAwareBalancer(min_samples=4, seed=0)
+    targets = ["a", "b", "c"]
+    assert b.order(targets, 0) == ["a", "b", "c"]
+    assert b.order(targets, 1) == ["b", "c", "a"]
+    assert b.order(targets, 2) == ["c", "a", "b"]
+
+
+def test_balancer_pick2_prefers_fast_low_load_worker():
+    b = LoadAwareBalancer(min_samples=4, seed=0)
+    for _ in range(20):
+        b.note_start("fast")
+        b.note_end("fast", 0.01)
+        b.note_start("slow")
+        b.note_end("slow", 0.5)
+    firsts = [b.order(["fast", "slow"], i)[0] for i in range(100)]
+    # pick-2 over two workers compares them every draw: the fast one
+    # must always win, and the failover walk still lists both
+    assert set(firsts) == {"fast"}
+    assert b.order(["fast", "slow"], 0) == ["fast", "slow"]
+    # in-flight pressure flips the preference: pile 100 requests on fast
+    for _ in range(100):
+        b.note_start("fast")
+    assert b.order(["fast", "slow"], 0)[0] == "slow"
+
+
+def test_balancer_forget_restores_cold_round_robin():
+    b = LoadAwareBalancer(min_samples=2, seed=1)
+    for t in ("a", "b"):
+        for _ in range(4):
+            b.note_start(t)
+            b.note_end(t, 0.01)
+    assert b._score("a") is not None
+    b.forget("a")
+    assert b.order(["a", "b"], 0) == ["a", "b"]  # cold again -> RR
+
+
+def test_router_load_aware_routing_shifts_traffic_to_fast_worker():
+    """Integration: one in-process worker is slowed via the server.handle
+    fault seam; after the latency windows warm, pick-2 routes the bulk of
+    the traffic to the fast worker (round-robin would split 50/50)."""
+    eng = DistributedServingEngine(
+        PidEchoReply(), n_workers=2,
+        resilience=ResilienceConfig(hedge_enabled=False, seed=0))
+    slow = eng.workers[1].server
+    fast = eng.workers[0].server
+    faultinject.install_plan({"rules": [{
+        "site": "server.handle", "kind": "latency", "delay_ms": 60,
+        "match": slow.server_label, "every": 1}]})
+    try:
+        for _ in range(60):
+            status, _ = _get(eng.address + "/")
+            assert status == 200
+        # both served some (cold RR + failover walk), but the fast worker
+        # took the clear majority once the windows warmed
+        assert fast.requests_received > 2 * slow.requests_received, (
+            fast.requests_received, slow.requests_received)
+    finally:
+        faultinject.clear_plan()
+        eng.stop()
+
+
+# ---------------------------------------------------------------------------
+# /healthz + prober drain refusal (satellite)
+# ---------------------------------------------------------------------------
+
+def test_healthz_reports_state_generation_inflight():
+    eng = serve_continuous(PidEchoReply())
+    try:
+        status, body = _get(eng.server.address + "/healthz")
+        hz = json.loads(body)
+        assert status == 200
+        assert hz["state"] == "serving"
+        assert hz["generation"] == 0
+        assert hz["inflight"] == 0
+        assert "queue_wait_s" in hz
+        eng.lifecycle.begin_drain()
+        assert json.loads(_get(eng.server.address + "/healthz")[1])[
+            "state"] == "draining"
+        eng.lifecycle.resume()
+    finally:
+        eng.stop()
+
+
+def test_prober_refuses_to_readmit_draining_worker():
+    """The drain/probe race the satellite names: an evicted-then-restarted
+    worker that is mid-drain answers its probe with ``draining`` — the
+    prober must NOT re-admit it (and must once it resumes)."""
+    eng = serve_continuous(PidEchoReply())
+    addr = eng.server.address
+    readmitted = []
+    cfg = ResilienceConfig(probe_base_s=0.01, seed=0)
+    health = FleetHealth(cfg)
+    prober = HealthProber(health, cfg, readmitted.append)
+    try:
+        for _ in range(cfg.evict_after):
+            health.record_failure(addr)
+        assert health.state(addr) == EVICTED
+        eng.lifecycle.begin_drain()
+        health.due_probes(now=time.monotonic() + 60.0)  # force due -> probing
+        prober._probe(addr)
+        assert readmitted == []         # refused: the worker is draining
+        assert health.state(addr) == EVICTED  # back on backoff
+        eng.lifecycle.resume()
+        health.due_probes(now=time.monotonic() + 120.0)
+        prober._probe(addr)
+        assert readmitted == [addr]     # resumed -> re-admitted
+    finally:
+        eng.stop()
+
+
+# ---------------------------------------------------------------------------
+# drain-then-stop (satellite)
+# ---------------------------------------------------------------------------
+
+def test_server_shutdown_rejects_new_work_with_503_retry_after():
+    from synapseml_tpu.observability import get_registry
+
+    eng = serve_continuous(PidEchoReply())
+    label = eng.server.server_label
+    try:
+        assert _post(eng.server.address, "x")[0] == 200
+        eng.server.begin_shutdown()
+        code, _ = _post(eng.server.address, "y")
+        assert code == 503
+        # Retry-After rides the 503 (honest backpressure, not a dead socket)
+        req = urllib.request.Request(eng.server.address, data=b"z",
+                                     method="POST")
+        try:
+            urllib.request.urlopen(req, timeout=10)
+            raise AssertionError("expected 503")
+        except urllib.error.HTTPError as e:
+            assert e.code == 503
+            assert e.headers.get("Retry-After") == "1"
+        snap = get_registry().snapshot()
+        shed = snap["families"]["smt_serving_shed_total"]["series"]
+        mine = {tuple(s["labels"]): s["value"] for s in shed}
+        assert mine.get((label, "shutdown"), 0) >= 2
+    finally:
+        eng.stop()
+
+
+def test_stop_lets_in_flight_request_finish():
+    """Drain-then-stop: a request already inside the pipeline when stop()
+    is called gets its 200, not a torn socket."""
+    import numpy as np
+
+    from synapseml_tpu.core import Table, Transformer
+    from synapseml_tpu.io.http_schema import HTTPResponseData
+
+    class Slow(Transformer):
+        def _transform(self, table):
+            time.sleep(0.4)
+            n = table.num_rows
+            out = np.empty(n, dtype=object)
+            out[:] = [HTTPResponseData(200, "OK", entity=b"done")] * n
+            return table.with_column("reply", out)
+
+    eng = serve_continuous(Slow())
+    results = []
+
+    def one():
+        results.append(_post(eng.server.address, "x", timeout=15.0))
+
+    t = threading.Thread(target=one)
+    t.start()
+    time.sleep(0.15)  # the request is inside the pipeline now
+    eng.stop()        # drains: must NOT cut the in-flight exchange
+    t.join(timeout=10)
+    assert results and results[0][0] == 200, results
+
+
+def test_router_close_drains_in_flight_and_rejects_new():
+    import numpy as np
+
+    from synapseml_tpu.core import Table, Transformer
+    from synapseml_tpu.io.http_schema import HTTPResponseData
+
+    class Slow(Transformer):
+        def _transform(self, table):
+            time.sleep(0.4)
+            n = table.num_rows
+            out = np.empty(n, dtype=object)
+            out[:] = [HTTPResponseData(200, "OK", entity=b"done")] * n
+            return table.with_column("reply", out)
+
+    eng = DistributedServingEngine(Slow(), n_workers=1)
+    results, late = [], []
+
+    def one():
+        results.append(_post(eng.address, "x", timeout=15.0))
+
+    t = threading.Thread(target=one)
+    t.start()
+    time.sleep(0.15)
+    closer = threading.Thread(target=eng.router.close)
+    closer.start()
+    time.sleep(0.05)  # close() is now draining (closing flag set)
+    late.append(_post(eng.address, "late", timeout=10.0))
+    t.join(timeout=10)
+    closer.join(timeout=10)
+    assert results and results[0][0] == 200, results  # in-flight finished
+    assert late and late[0][0] == 503, late           # new work refused
+    for w in eng.workers:
+        w.stop()
+
+
+# ---------------------------------------------------------------------------
+# in-process rolling hot swap under load: the exactly-once ledger
+# ---------------------------------------------------------------------------
+
+def test_rolling_swap_under_load_exactly_once_in_process():
+    eng = DistributedServingEngine(
+        TagEchoReply(tag="g1"), n_workers=3,
+        resilience=ResilienceConfig(hedge_enabled=False, seed=0))
+    ledger = {}  # body -> [replies]
+    lock = threading.Lock()
+    stop = threading.Event()
+    fail = []
+
+    def client(k):
+        i = 0
+        while not stop.is_set():
+            body = f"c{k}-{i}"
+            i += 1
+            try:
+                status, reply = _post(eng.address, body, timeout=10.0)
+            except Exception as e:  # transport failure = a dropped request
+                fail.append((body, repr(e)))
+                continue
+            with lock:
+                ledger.setdefault(body, []).append((status, reply))
+            time.sleep(0.002)
+
+    threads = [threading.Thread(target=client, args=(k,)) for k in range(3)]
+    for t in threads:
+        t.start()
+    try:
+        time.sleep(0.3)  # steady state on g1
+        gen = eng.swap(TagEchoReply(tag="g2"),
+                       cfg=LifecycleConfig(drain_timeout_s=5.0,
+                                           swap_timeout_s=10.0))
+        assert gen == 1
+        time.sleep(0.3)  # post-swap traffic on g2
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=10)
+    try:
+        # THE LEDGER: every body exactly once, zero 5xx, zero transport drops
+        assert not fail, fail[:5]
+        assert ledger
+        for body, replies in ledger.items():
+            assert len(replies) == 1, (body, replies)
+            status, reply = replies[0]
+            assert status == 200, (body, replies)
+        # the post-swap generation is serving on EVERY worker
+        for w in eng.workers:
+            assert w.lifecycle.generation == 1
+            hz = json.loads(_get(w.server.address + "/healthz")[1])
+            assert hz["generation"] == 1 and hz["state"] == "serving"
+        # and the new pipeline actually answers (tag flipped)
+        tags = {r[0][1].split(":")[0] for r in ledger.values()}
+        assert tags == {"g1", "g2"}, tags  # both generations served traffic
+        assert _post(eng.address, "probe")[1].startswith("g2:")
+    finally:
+        eng.stop()
+
+
+def test_swap_updates_admission_schema():
+    """The flip re-resolves the admission schema from the NEW pipeline."""
+    from synapseml_tpu.core.schema import TableSchema
+
+    eng = serve_continuous(PidEchoReply())
+    try:
+        assert eng.server.admission_schema is None
+        schema = TableSchema({"text": "object:scalar"})
+
+        class Declared(TagEchoReply):
+            _abstract_stage = True
+
+            def request_schema(self):
+                return schema
+
+        eng.lifecycle.install(Declared(tag="g9"), 1)
+        assert eng.server.admission_schema is schema
+        assert eng.pipeline.tag == "g9"
+    finally:
+        eng.stop()
+
+
+# ---------------------------------------------------------------------------
+# autoscaler: deterministic flap-proofing + drain-based scale-down
+# ---------------------------------------------------------------------------
+
+class ScriptedAdapter:
+    """Adapter driven by a list of (p99_s, queue_wait_s) observations;
+    scale actions mutate n_workers instantly."""
+
+    def __init__(self, obs, n_workers=2):
+        self.obs = obs
+        self.i = 0
+        self.n_workers = n_workers
+        self.events = []
+
+    def observe(self):
+        o = self.obs[min(self.i, len(self.obs) - 1)]
+        self.i += 1
+        return FleetObservation(p99_s=o[0], queue_wait_s=o[1],
+                                n_workers=self.n_workers)
+
+    def scale_up(self):
+        self.n_workers += 1
+        self.events.append(("up", self.i))
+        return True
+
+    def scale_down(self):
+        self.n_workers -= 1
+        self.events.append(("down", self.i))
+        return True
+
+
+def _cfg(**kw):
+    base = dict(slo_p99_ms=200.0, queue_wait_slo_s=0.2, breach_ticks=3,
+                idle_ticks=3, cooldown_up_s=10.0, cooldown_down_s=10.0,
+                min_workers=1, max_workers=4, idle_p99_fraction=0.5)
+    base.update(kw)
+    return LifecycleConfig(**base)
+
+
+def test_autoscaler_scales_up_after_sustained_breach_only():
+    ad = ScriptedAdapter([(0.5, 0.0)] * 10)
+    a = Autoscaler(ad, _cfg())
+    results = [a.tick(now=float(t)) for t in range(5)]
+    # hysteresis: two breaches are not enough; the third scales up
+    assert results == [None, None, "up", None, None]
+    assert ad.events == [("up", 3)]
+
+
+def test_autoscaler_single_breach_blip_never_scales():
+    ad = ScriptedAdapter([(0.5, 0.0) if t % 3 == 0 else (0.05, 0.0)
+                          for t in range(30)])
+    a = Autoscaler(ad, _cfg(idle_ticks=100))
+    for t in range(30):
+        a.tick(now=float(t))
+    assert ad.events == []  # never 3 consecutive breaches
+
+
+def test_autoscaler_scales_down_via_drain_when_idle():
+    ad = ScriptedAdapter([(0.01, 0.0)] * 10, n_workers=3)
+    a = Autoscaler(ad, _cfg())
+    for t in range(10):
+        a.tick(now=float(t))
+    # one down at tick 3, the next only after the 10s cooldown
+    assert ad.events[0] == ("down", 3)
+    assert len(ad.events) == 1 or ad.events[1][1] - ad.events[0][1] >= 10
+
+
+def test_autoscaler_respects_min_and_max_workers():
+    hot = ScriptedAdapter([(9.9, 9.9)] * 50, n_workers=4)
+    a = Autoscaler(hot, _cfg(cooldown_up_s=0.0))
+    for t in range(50):
+        a.tick(now=float(t))
+    assert hot.events == []  # already at max_workers
+    cold = ScriptedAdapter([(None, 0.0)] * 50, n_workers=1)
+    a2 = Autoscaler(cold, _cfg(cooldown_down_s=0.0))
+    for t in range(50):
+        a2.tick(now=float(t))
+    assert cold.events == []  # already at min_workers
+
+
+def test_autoscaler_flap_proof_under_seeded_noise():
+    """The acceptance criterion: seeded noisy latency can NEVER produce
+    more than one scale transition per cooldown window."""
+    import random
+
+    rng = random.Random(1234)
+    obs = [(0.4 if rng.random() < 0.5 else 0.02, 0.0) for _ in range(400)]
+    ad = ScriptedAdapter(obs, n_workers=2)
+    cfg = _cfg(cooldown_up_s=20.0, cooldown_down_s=20.0)
+    a = Autoscaler(ad, cfg)
+    times = []
+    for t in range(400):
+        if a.tick(now=float(t)) is not None:
+            times.append(t)
+    assert times, "seeded noise never triggered a single transition"
+    gaps = [b - x for x, b in zip(times, times[1:])]
+    assert all(g >= 20.0 for g in gaps), (times, gaps)
+    # telemetry: every decision carries the triggering metric values
+    assert len(a.decisions) == len(times)
+    for d in a.decisions:
+        assert {"direction", "p99_ms", "queue_wait_s",
+                "n_workers"} <= set(d)
+
+
+def test_autoscaler_decisions_counted_in_registry():
+    from synapseml_tpu.observability import get_registry
+
+    ad = ScriptedAdapter([(0.5, 0.0)] * 5)
+    before = _decision_count()
+    a = Autoscaler(ad, _cfg())
+    for t in range(5):
+        a.tick(now=float(t))
+    assert _decision_count() - before == 1
+
+
+def _decision_count():
+    from synapseml_tpu.observability import get_registry
+
+    fam = get_registry().snapshot()["families"].get(
+        "smt_autoscale_decisions_total")
+    if fam is None:
+        return 0
+    return sum(s["value"] for s in fam["series"])
+
+
+# ---------------------------------------------------------------------------
+# scale-down drains (process fleet): the no-request-lost ledger
+# ---------------------------------------------------------------------------
+
+def test_process_fleet_scale_down_drains_no_request_lost():
+    fleet = ProcessServingFleet(
+        PidEchoReply(), n_workers=2,
+        import_modules=["tests.serving_fault_stage"], reply_timeout=15.0)
+    ledger = []
+    stop = threading.Event()
+
+    def client():
+        i = 0
+        while not stop.is_set():
+            ledger.append(_post(fleet.address, f"b{i}", timeout=15.0))
+            i += 1
+            time.sleep(0.005)
+
+    t = threading.Thread(target=client)
+    t.start()
+    try:
+        time.sleep(0.3)
+        gone = fleet.remove_worker()
+        assert gone is not None
+        time.sleep(0.3)
+    finally:
+        stop.set()
+        t.join(timeout=15)
+    try:
+        # the ledger: scale-down dropped NOTHING (drain, never kill)
+        assert ledger
+        assert all(status == 200 for status, _ in ledger), \
+            [x for x in ledger if x[0] != 200][:5]
+        assert len(fleet.live_addresses()) == 1
+        assert gone not in fleet.routing_table()["default"]
+    finally:
+        fleet.stop()

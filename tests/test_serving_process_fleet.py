@@ -186,3 +186,161 @@ def test_kill_all_workers_returns_5xx(fleet):
     # dead fleet: 502 while eviction drains, then 503 (none registered)
     assert all(c in (502, 503) for c in codes), codes
     assert codes[-1] == 503
+
+
+def _hammer(fleet, ledger, lock, stop, k):
+    """Sustained-load client: unique bodies, one ledger entry per body."""
+    import urllib.error
+
+    i = 0
+    while not stop.is_set():
+        body = f"c{k}-{i}".encode()
+        i += 1
+        req = urllib.request.Request(fleet.address + "/", data=body,
+                                     method="POST")
+        try:
+            with urllib.request.urlopen(req, timeout=15) as r:
+                entry = (r.status, r.read().decode())
+        except urllib.error.HTTPError as e:
+            entry = (e.code, e.read().decode())
+        except Exception as e:
+            entry = (0, repr(e))
+        with lock:
+            ledger.setdefault(body.decode(), []).append(entry)
+
+
+def test_rolling_swap_across_processes_with_mid_roll_kill():
+    """The tentpole's chaos acceptance: a rolling swap() at sustained
+    offered load, with a worker SIGKILLed mid-roll, still completes on
+    the survivors — the per-body ledger shows exactly-once 200 replies
+    (zero drops, zero dupes, zero 5xx), and the post-swap generation is
+    serving on every survivor."""
+    import json as _json
+    import threading
+    import time
+
+    from synapseml_tpu.io.lifecycle import LifecycleConfig, healthz
+    from synapseml_tpu.io.resilience import ResilienceConfig
+
+    sys.path.insert(0, _REPO)
+    from tests.serving_fault_stage import TagEchoReply
+
+    fleet = ProcessServingFleet(
+        TagEchoReply(tag="g1"), n_workers=3,
+        import_modules=["tests.serving_fault_stage"], reply_timeout=15.0,
+        resilience=ResilienceConfig(probe_base_s=30.0, seed=0))
+    ledger, lock, stop = {}, threading.Lock(), threading.Event()
+    threads = [threading.Thread(target=_hammer,
+                                args=(fleet, ledger, lock, stop, k))
+               for k in range(2)]
+    for t in threads:
+        t.start()
+    try:
+        time.sleep(0.3)  # steady state on g1
+        cfg = LifecycleConfig(drain_timeout_s=5.0, swap_timeout_s=30.0)
+        swap_done = []
+        swapper = threading.Thread(
+            target=lambda: swap_done.append(
+                fleet.swap(TagEchoReply(tag="g2"), cfg=cfg)))
+        swapper.start()
+        time.sleep(0.15)  # the roll is in flight: kill the LAST worker
+        fleet.kill_worker(2)
+        swapper.join(timeout=60)
+        assert swap_done == [1], "rolling swap did not complete"
+        time.sleep(0.3)  # post-swap traffic on the survivors
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=15)
+    try:
+        # THE LEDGER: every body exactly once, all 200 (the kill victim's
+        # in-flight request fails over to a survivor — never 5xx, never a
+        # duplicate reply)
+        assert ledger
+        bad = {b: r for b, r in ledger.items()
+               if len(r) != 1 or r[0][0] != 200}
+        assert not bad, dict(list(bad.items())[:5])
+        # post-swap generation serving on every SURVIVOR
+        for i in (0, 1):
+            hz = healthz(fleet.addresses[i], timeout=5.0)
+            assert hz is not None
+            assert hz["generation"] == 1 and hz["state"] == "serving", hz
+        # the dead worker stayed out of the roll and the routing table
+        assert fleet.addresses[2] not in fleet.routing_table()["default"]
+        # both generations actually served, and g2 serves now
+        tags = {r[0][1].split(":")[0] for r in ledger.values()}
+        assert tags == {"g1", "g2"}, tags
+    finally:
+        fleet.stop()
+
+
+def test_scale_up_under_load_is_warm_start_bounded():
+    """Satellite: a worker added under load with a shared persisted-AOT
+    cache pre-warms before registering — its metrics show a persisted
+    cache HIT and NO cold ``smt_compile_seconds`` sample for the
+    pre-warmed signature, and its first direct request answers in a
+    fraction of the measured cold-compile time."""
+    import json as _json
+    import threading
+    import time
+
+    sys.path.insert(0, _REPO)
+    from tests.serving_fault_stage import JitBurnReply
+
+    fleet = ProcessServingFleet(
+        JitBurnReply(), n_workers=1,
+        import_modules=["tests.serving_fault_stage"], reply_timeout=30.0,
+        startup_timeout=120.0, aot_cache_dir="auto")
+    try:
+        # worker 0 compiles COLD and persists the executable
+        _hit(fleet.address)
+        snap0 = _json.loads(urllib.request.urlopen(
+            fleet.addresses[0] + "/metrics?format=json",
+            timeout=15).read().decode())
+        fam0 = snap0["families"]
+        comp = [s for s in fam0["smt_compile_seconds"]["series"]]
+        assert comp and comp[0]["count"] >= 1  # the cold compile happened
+        cold_compile_s = comp[0]["sum"]
+        assert fam0["smt_aot_cache_misses_total"]["series"][0]["value"] >= 1
+
+        # sustained load while the fleet scales up
+        stop = threading.Event()
+        codes = []
+
+        def load():
+            while not stop.is_set():
+                codes.append(_hit(fleet.address) is not None)
+                time.sleep(0.01)
+
+        t = threading.Thread(target=load)
+        t.start()
+        try:
+            addr = fleet.add_worker()
+        finally:
+            stop.set()
+            t.join(timeout=15)
+        assert addr is not None
+        assert all(codes)  # the scale-up dropped nothing
+
+        # the NEW worker's first direct request: warm-start bounded
+        t0 = time.perf_counter()
+        with urllib.request.urlopen(addr + "/", data=b"warm?",
+                                    timeout=30) as r:
+            assert r.status == 200
+        first_reply_s = time.perf_counter() - t0
+        snap1 = _json.loads(urllib.request.urlopen(
+            addr + "/metrics?format=json", timeout=15).read().decode())
+        fam1 = snap1["families"]
+        # persisted cache hit counter > 0 ...
+        hits = fam1["smt_aot_cache_hits_total"]["series"]
+        assert hits and hits[0]["value"] >= 1, hits
+        # ... and NO cold compile sample for the pre-warmed signature
+        comp1 = fam1.get("smt_compile_seconds")
+        total1 = sum(s["count"] for s in comp1["series"]) if comp1 else 0
+        assert total1 == 0, comp1
+        # first reply beat the cold compile alone (generous 2x margin for
+        # CI noise; the bench lane measures the real speedup)
+        assert first_reply_s < max(cold_compile_s, 0.05) * 2.0, (
+            first_reply_s, cold_compile_s)
+    finally:
+        fleet.stop()
