@@ -487,6 +487,59 @@ def bench_flash_gqa(platform, peak):
     return out
 
 
+def bench_onnx_tp(platform, peak):
+    """Tensor-parallel ONNX serving lane (ROADMAP item 3, the sharding
+    layer's headline payoff): MatMul weights column-sharded over the
+    ``SpecLayout`` 'model' axis (``runtime/layout.py``), jit-inserted
+    collectives, parity-checked against the unsharded graph every run. On
+    a single chip the layout degrades to ``(1, 1)`` and the lane measures
+    the degradation overhead (should be ~none); on a pod slice the same
+    code serves models bigger than one chip's HBM."""
+    import jax
+
+    from synapseml_tpu.onnx import builder
+    from synapseml_tpu.onnx.importer import OnnxFunction
+    from synapseml_tpu.onnx.wire import serialize_model
+    from synapseml_tpu.runtime.layout import SpecLayout
+
+    n_dev = len(jax.devices())
+    model_sz = max(m for m in (1, 2, 4, 8) if m <= n_dev and n_dev % m == 0)
+    layout = SpecLayout.build(model=model_sz)
+    d, hsz = (512, 2048) if platform != "cpu" else (256, 1024)
+    rng = np.random.default_rng(5)
+    w1 = (rng.normal(size=(d, hsz)) / np.sqrt(d)).astype(np.float32)
+    b1 = np.zeros(hsz, np.float32)
+    w2 = (rng.normal(size=(hsz, d)) / np.sqrt(hsz)).astype(np.float32)
+    g = builder.make_graph(
+        [builder.node("MatMul", ["x", "w1"], ["h0"]),
+         builder.node("Add", ["h0", "b1"], ["h1"]),
+         builder.node("Relu", ["h1"], ["h2"]),
+         builder.node("MatMul", ["h2", "w2"], ["y"])],
+        "tp_mlp",
+        [builder.value_info("x", np.float32, [None, d])],
+        [builder.value_info("y", np.float32, [None, d])],
+        initializers={"w1": w1, "b1": b1, "w2": w2})
+    mb = serialize_model(builder.make_model(g))
+    batch = 256 if platform != "cpu" else 64
+    x = rng.normal(size=(batch, d)).astype(np.float32)
+    fn_ref = OnnxFunction(mb, dtype_policy="bfloat16")
+    fn_tp = OnnxFunction(mb, dtype_policy="bfloat16", layout=layout)
+    ref = np.asarray(fn_ref({"x": x})["y"], np.float32)
+    tp = np.asarray(fn_tp({"x": x})["y"], np.float32)
+    rel_err = float(np.abs(tp - ref).max() / max(np.abs(ref).max(), 1e-6))
+
+    def step(eps, xv):
+        return fn_tp._run_positional(xv + eps)[0].astype("float32").sum()
+
+    iters = 20 if platform != "cpu" else 4
+    dt, _, warm_s = _timed_device_loop(step, iters, x)
+    return {"rows_per_sec": round(batch / dt, 1),
+            "n_model_shards": model_sz,
+            "sharded_weights": len(fn_tp._const_specs),
+            "parity_max_rel_err": rel_err,
+            "compile_warm_s": round(warm_s, 2)}
+
+
 def bench_serving(platform):
     """Serving latency p50/p99: continuous (push) vs micro-batch engines over
     a trivial pipeline. Reference north-star: sub-millisecond continuous p50
@@ -1190,6 +1243,7 @@ _PRIMARY = {
     "vit_to_gbdt_pipeline": "images_per_sec_end_to_end",
     "flash_attention_32k": "tflops_nominal",
     "flash_attention_gqa": "tflops_nominal",
+    "onnx_tp_sharding": "rows_per_sec",
     "serving_overload": "p99_collapse_ratio",
     "swap_under_load": "swap_p99_ratio",
     "worker_warm_start": "warm_start_speedup",
@@ -1236,6 +1290,7 @@ def main() -> None:
         ("vit_to_gbdt_pipeline", lambda: bench_vit_gbdt(platform, peak)),
         ("flash_attention_32k", lambda: bench_flash_attention(platform, peak)),
         ("flash_attention_gqa", lambda: bench_flash_gqa(platform, peak)),
+        ("onnx_tp_sharding", lambda: bench_onnx_tp(platform, peak)),
         ("serving_latency", lambda: bench_serving(platform)),
         ("serving_overload", lambda: bench_serving_overload(platform)),
         ("swap_under_load", lambda: bench_swap_under_load(platform)),

@@ -793,6 +793,74 @@ class SilentExceptionSwallow(Rule):
         return findings
 
 
+@register
+class AdHocMeshConstruction(Rule):
+    """SMT013 — ad-hoc mesh construction outside the canonical layout.
+
+    Every distributed path used to hand-roll its own 1-D
+    ``jax.sharding.Mesh``, which is exactly how the repo ended up
+    data-parallel-only (no model axis, no tensor-parallel serving, no
+    feature-parallel histograms). Mesh construction now lives in ONE
+    place — ``runtime/layout.py`` (``SpecLayout``) on top of
+    ``runtime/topology.py`` (``make_mesh``) — so axis names, 2-D shapes
+    and the (1, 1) degradation stay consistent across engines. Direct
+    ``jax.sharding.Mesh(...)`` / ``make_mesh(...)`` calls anywhere else
+    are findings (waiverable via ``LINT_ACKS.md`` for the rare
+    deliberate exception).
+    """
+
+    code = "SMT013"
+    name = "ad-hoc-mesh-construction"
+    rationale = ("private meshes fragment sharding decisions and regress "
+                 "to 1-D data parallelism; build layouts through "
+                 "runtime.layout.SpecLayout")
+
+    _ALLOWED_SUFFIXES = ("runtime/layout.py", "runtime/topology.py")
+
+    def check(self, module: Module) -> Iterable[Finding]:
+        rel = module.rel.replace(os.sep, "/")
+        if any(rel.endswith(sfx) for sfx in self._ALLOWED_SUFFIXES):
+            return []
+        findings: List[Finding] = []
+        mesh_aliases: Set[str] = set()   # names bound to the Mesh class
+        module_aliases: Set[str] = set()  # names bound to the jax.sharding module
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.ImportFrom):
+                if node.module == "jax.sharding":
+                    for a in node.names:
+                        if a.name == "Mesh":
+                            mesh_aliases.add(a.asname or a.name)
+                elif node.module == "jax":
+                    for a in node.names:
+                        if a.name == "sharding":
+                            module_aliases.add(a.asname or a.name)
+            elif isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.name == "jax.sharding" and a.asname:
+                        module_aliases.add(a.asname)
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            dn = dotted_name(node.func)
+            if dn is None:
+                continue
+            if dn in mesh_aliases or dn == "jax.sharding.Mesh" \
+                    or dn.endswith(".sharding.Mesh") \
+                    or any(dn == f"{m}.Mesh" for m in module_aliases):
+                findings.append(self.finding(
+                    module, node,
+                    "ad-hoc jax.sharding.Mesh(...) construction; build the "
+                    "mesh through runtime.layout.SpecLayout (canonical "
+                    "axis names, 2-D shapes, (1,1) degradation)"))
+            elif dn.split(".")[-1] == "make_mesh":
+                findings.append(self.finding(
+                    module, node,
+                    "direct make_mesh(...) outside runtime/layout.py; use "
+                    "runtime.layout.SpecLayout.build (or from_mesh) so "
+                    "every engine shares one layout"))
+        return findings
+
+
 # cache of "does this file use jax" verdicts, keyed by absolute path
 _JAX_USING_CACHE: Dict[str, bool] = {}
 

@@ -528,22 +528,49 @@ def _build_gbdt_voting_entry() -> Dict[str, Any]:
     (the PV-tree vote path: per-shard top-k vote, psum'd candidates) —
     the distributed configuration SMT104/SMT101 most need to see."""
     import jax
-    import numpy as np
-    from jax.sharding import Mesh
-    from jax.sharding import PartitionSpec as P
 
     from ..gbdt import grow
-    from ..runtime.topology import shard_map_compat
+    from ..runtime.layout import SpecLayout
 
     binned, g, h, w, fmask, TreeConfig, B = _gbdt_grow_inputs()
     cfg = TreeConfig(n_bins=B, num_leaves=4, parallelism="voting", top_k=2)
-    mesh = Mesh(np.array(jax.devices("cpu")[:1]), ("data",))
-    data, rep = P("data"), P()
+    layout = SpecLayout.build(devices=jax.devices("cpu")[:1],
+                              model_axis=None)
+    data, rep = layout.batch(), layout.replicated()
 
     def body(b, gg, hh, ww, fm):
-        return grow.grow_tree(b, gg, hh, ww, fm, cfg, axis_name="data")
+        return grow.grow_tree(b, gg, hh, ww, fm, cfg,
+                              axis_name=layout.data_axis)
 
-    fn = shard_map_compat(body, mesh=mesh,
+    fn = layout.shard_map(body,
+                          in_specs=(data, data, data, data, rep),
+                          out_specs=(rep, data), check=False)
+    return {"fn": fn, "args": (binned, g, h, w, fmask),
+            "anchor_obj": grow.grow_tree}
+
+
+def _build_gbdt_feature_parallel_entry() -> Dict[str, Any]:
+    """``gbdt.iter_sharded`` over a 2-D ``(data, model)`` ``SpecLayout``
+    mesh — the feature-parallel histogram path (features over ``model``,
+    stats ``psum``'d per axis). The jaxpr binds BOTH axis names, so
+    SMT104 verifies collectives against a 2-D declaration."""
+    import jax
+
+    from ..gbdt import grow
+    from ..runtime.layout import SpecLayout
+
+    binned, g, h, w, fmask, TreeConfig, B = _gbdt_grow_inputs()
+    cfg = TreeConfig(n_bins=B, num_leaves=4)
+    layout = SpecLayout.build(data=1, model=1,
+                              devices=jax.devices("cpu")[:1])
+    data, rep = layout.batch(), layout.replicated()
+
+    def body(b, gg, hh, ww, fm):
+        return grow.grow_tree(b, gg, hh, ww, fm, cfg,
+                              axis_name=layout.data_axis,
+                              model_axis_name=layout.model_axis)
+
+    fn = layout.shard_map(body,
                           in_specs=(data, data, data, data, rep),
                           out_specs=(rep, data), check=False)
     return {"fn": fn, "args": (binned, g, h, w, fmask),
@@ -564,6 +591,9 @@ def default_device_entries() -> List[DeviceEntry]:
                     policy="float32"),
         DeviceEntry("gbdt.grow[voting,sharded]", _build_gbdt_voting_entry,
                     policy="float32", mesh_axes=("data",)),
+        DeviceEntry("gbdt.grow[feature-parallel,2d]",
+                    _build_gbdt_feature_parallel_entry,
+                    policy="float32", mesh_axes=("data", "model")),
     ]
 
 

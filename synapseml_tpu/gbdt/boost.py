@@ -1147,6 +1147,7 @@ def _renewed_leaf_values(node, yv, raw_col, weight, alpha: float, L: int):
 
 def _build_step(grad_fn=None, fobj=None, *, cfg, C, lr, boosting, d, cat_idx,
                 ff, bf, bfreq, use_goss, top_rate, other_rate, mesh, axis,
+                model_axis=None,
                 pos_bf=1.0, neg_bf=1.0, sparse_meta=None, renew_alpha=None,
                 scan_iters=None, eval_metric=None, n_eval=0):
     """Build the jitted per-iteration training step.
@@ -1227,7 +1228,8 @@ def _build_step(grad_fn=None, fobj=None, *, cfg, C, lr, boosting, d, cat_idx,
 
         def grow_c(gc, hc):
             return grow_tree(binned, gc, hc, bw, fmask, cfg,
-                             axis_name=axis_name, cat_mask=cmask)
+                             axis_name=axis_name, cat_mask=cmask,
+                             model_axis_name=model_axis)
 
         if C == 1:
             tree, node = grow_c(g[:, 0], h[:, 0])
@@ -1313,12 +1315,11 @@ def _build_step(grad_fn=None, fobj=None, *, cfg, C, lr, boosting, d, cat_idx,
         return trees, raw, eraws, metrics, key
 
     if mesh is not None:
-        from jax.sharding import PartitionSpec as Pspec
+        from ..runtime.layout import as_layout
 
-        from ..runtime.topology import shard_map_compat
-
-        data_spec = Pspec(axis)
-        rep = Pspec()
+        layout = as_layout(mesh, data_axis=axis)
+        data_spec = layout.batch()
+        rep = layout.replicated()
         if sparse_meta is not None:
             # SparseBinned pytree: the per-shard entry/cell-table arrays
             # shard on axis 0 (row blocks), the per-feature zero_bin
@@ -1340,7 +1341,7 @@ def _build_step(grad_fn=None, fobj=None, *, cfg, C, lr, boosting, d, cat_idx,
         # executable's cost_analysis FLOPs attribute achieved MFU to the
         # enclosing fit() span
         if scan_iters is not None:
-            return profiled_jit(shard_map_compat(scan_loop, mesh=mesh,
+            return profiled_jit(layout.shard_map(scan_loop,
                                                  in_specs=in_specs,
                                                  out_specs=out_specs,
                                                  check=False),
@@ -1351,8 +1352,8 @@ def _build_step(grad_fn=None, fobj=None, *, cfg, C, lr, boosting, d, cat_idx,
             trees, new_raw = one_iter(binned, yv, wv, raw, key, fkey)
             return trees, new_raw
 
-        return profiled_jit(shard_map_compat(
-            sharded_iter, mesh=mesh,
+        return profiled_jit(layout.shard_map(
+            sharded_iter,
             in_specs=in_specs,
             out_specs=out_specs,
             check=False,
@@ -1366,7 +1367,7 @@ def _build_step(grad_fn=None, fobj=None, *, cfg, C, lr, boosting, d, cat_idx,
 
 @lru_cache(maxsize=64)
 def _cached_step(obj_key, *, cfg, C, lr, boosting, d, cat_idx, ff, bf, bfreq,
-                 use_goss, top_rate, other_rate, mesh, axis,
+                 use_goss, top_rate, other_rate, mesh, axis, model_axis=None,
                  pos_bf=1.0, neg_bf=1.0, sparse_meta=None, renew_alpha=None,
                  scan_iters=None, eval_metric=None, n_eval=0):
     """Compiled-step cache for built-in objectives (custom fobj / lambdarank
@@ -1380,6 +1381,7 @@ def _cached_step(obj_key, *, cfg, C, lr, boosting, d, cat_idx, ff, bf, bfreq,
                        d=d, cat_idx=cat_idx, ff=ff, bf=bf, bfreq=bfreq,
                        use_goss=use_goss, top_rate=top_rate,
                        other_rate=other_rate, mesh=mesh, axis=axis,
+                       model_axis=model_axis,
                        pos_bf=pos_bf, neg_bf=neg_bf, sparse_meta=sparse_meta,
                        renew_alpha=renew_alpha,
                        scan_iters=scan_iters, eval_metric=eval_metric,
@@ -1399,12 +1401,29 @@ def train(params: Dict[str, Any], x: np.ndarray, y: Optional[np.ndarray] = None,
           feature_names: Optional[List[str]] = None) -> GBDTBooster:
     """Train a booster. ``mesh`` shards rows over ``axis`` (histogram psum).
 
+    ``mesh`` accepts a raw ``jax.sharding.Mesh`` (back-compat) or a
+    :class:`~synapseml_tpu.runtime.layout.SpecLayout`. A layout with a
+    populated ``model`` axis additionally engages FEATURE-PARALLEL
+    histograms (dense, non-voting paths): each model-axis shard builds the
+    histogram for its ``d / m`` feature block and stats are ``psum``'d per
+    axis (``grow.grow_tree``), so histogram work parallelizes in 2-D.
+
     ``fobj(score, y, w) -> (grad, hess)`` is the custom-objective hook (reference
     ``FObjTrait``/``updateOneIterationCustom``). ``init_booster`` continues training
     (reference batch/continued training, ``LightGBMBase.scala:46-61``).
     """
     import jax
     import jax.numpy as jnp
+
+    layout = None
+    model_axis = None
+    if mesh is not None:
+        from ..runtime.layout import as_layout
+
+        layout = as_layout(mesh, data_axis=axis)
+        mesh, axis = layout.mesh, layout.data_axis
+        if layout.model_size > 1:
+            model_axis = layout.model_axis
 
     p = dict(_DEFAULTS)
     params_c = _canonicalize_params(params)
@@ -1696,10 +1715,16 @@ def train(params: Dict[str, Any], x: np.ndarray, y: Optional[np.ndarray] = None,
     if mesh is None and C == 1 and fobj is None:
         renew_alpha = {"quantile": float(p["alpha"]),
                        "l1": 0.5, "mae": 0.5}.get(obj_name)
+    if sparse_in or cfg.parallelism == "voting":
+        # feature-parallel histograms need the dense (n, d) block slice and
+        # compose with data-parallel growth only; these paths stay
+        # data-parallel (model-axis shards replicate, still correct)
+        model_axis = None
     step_args = dict(cfg=cfg, C=C, lr=lr, boosting=boosting, d=d,
                      cat_idx=cat_idx, ff=ff, bf=bf, bfreq=bfreq,
                      use_goss=use_goss, top_rate=top_rate,
                      other_rate=other_rate, mesh=mesh, axis=axis,
+                     model_axis=model_axis,
                      pos_bf=float(p['pos_bagging_fraction']),
                      neg_bf=float(p['neg_bagging_fraction']),
                      sparse_meta=sparse_meta, renew_alpha=renew_alpha)
@@ -1727,12 +1752,10 @@ def train(params: Dict[str, Any], x: np.ndarray, y: Optional[np.ndarray] = None,
     bin_dtype = _bin_dtype(mapper.n_bins)
 
     if mesh is not None:
-        from jax.sharding import NamedSharding, PartitionSpec as Pspec
-
-        n_shards = mesh.shape[axis]
+        n_shards = layout.data_size
         pad = (-n) % n_shards
-        data_spec = Pspec(axis)
-        dev_put = lambda a, spec: jax.device_put(a, NamedSharding(mesh, spec))
+        data_spec = layout.batch()
+        dev_put = layout.put
         if dev_data:
             # device-resident dataset: RESHARD on device (device->device
             # collective placement, no host round-trip); padding rows wrap
@@ -1772,7 +1795,7 @@ def train(params: Dict[str, Any], x: np.ndarray, y: Optional[np.ndarray] = None,
                 bins=dev_put(sb.bins, data_spec),
                 ends=dev_put(sb.ends, data_spec),
                 starts=dev_put(sb.starts, data_spec),
-                zero_bin=dev_put(sb.zero_bin, Pspec()),
+                zero_bin=dev_put(sb.zero_bin, layout.replicated()),
                 d=sb.d, n_bins=sb.n_bins, n=sb.n, max_run=sb.max_run)
             if pad:
                 y = np.concatenate([y, y[:pad]])
@@ -1921,6 +1944,19 @@ def train(params: Dict[str, Any], x: np.ndarray, y: Optional[np.ndarray] = None,
         return binned_np
 
     def predict_tree_binned(tr, binned_mat, c):
+        if not isinstance(binned_mat, np.ndarray):
+            # sparse eval_set under the host loop (callbacks / mesh / dart /
+            # host-only metric): the eval matrix is a SparseBinned — replay
+            # the tree on DEVICE over the binned triple (tree bins and the
+            # triple share the compact bin space; no dense host matrix ever
+            # materializes), same path replay_tree uses for training rows
+            from .grow import GrownTree, predict_binned as _pb
+
+            gt = GrownTree(tr.parent[c], tr.feature[c], tr.bin[c],
+                           tr.gain[c], tr.leaf_value[c], tr.leaf_hess[c],
+                           tr.cat_set[c])
+            node = np.asarray(_pb(gt, binned_mat))
+            return tr.leaf_value[c][node]
         node = np.zeros(binned_mat.shape[0], dtype=np.int32)
         par, feat, bins = tr.parent[c], tr.feature[c], tr.bin[c]
         cat = tr.cat_set[c]
@@ -1967,13 +2003,6 @@ def train(params: Dict[str, Any], x: np.ndarray, y: Optional[np.ndarray] = None,
                        and not callbacks and mesh is None
                        and metric_fn is not None
                        and _dev_metric(metric_name) is not None)
-    if sparse_in and eval_binned and not use_device_eval:
-        # the host fallback loop replays trees over a host binned matrix,
-        # which sparse training deliberately never materializes
-        raise NotImplementedError(
-            "sparse eval_set needs the on-device eval path: drop callbacks/"
-            "mesh/boosting='dart' and use a device-supported metric "
-            f"(got {metric_name!r})")
     if use_device_eval and num_iter > 0:
         eval_dev = [(eb if sparse_in else jnp.asarray(eb.astype(bin_dtype)),
                      jnp.asarray(ey, jnp.float32),

@@ -111,7 +111,8 @@ def _prefix_bins(h):
 
 
 def grow_tree(binned, grad, hess, row_weight, feature_mask, cfg: TreeConfig,
-              axis_name: Optional[str] = None, cat_mask=None):
+              axis_name: Optional[str] = None, cat_mask=None,
+              model_axis_name: Optional[str] = None):
     """Grow one tree. Returns (GrownTree of device arrays, node_of_row (n,) int32).
 
     ``binned`` (n, d) int32 — or a :class:`~.sparse.SparseBinned`, which
@@ -119,6 +120,16 @@ def grow_tree(binned, grad, hess, row_weight, feature_mask, cfg: TreeConfig,
     ``grad``/``hess``/``row_weight`` (n,) f32;
     ``feature_mask`` (d,) f32 in {0,1} (feature_fraction sampling);
     ``cat_mask`` (d,) f32 in {0,1} — categorical features (None = all numeric).
+
+    ``model_axis_name`` (2-D ``SpecLayout`` meshes, ``runtime/layout.py``)
+    turns on FEATURE-PARALLEL histograms: rows stay sharded over
+    ``axis_name`` and each ``model``-axis shard histograms only its
+    ``d / m`` feature block; one ``psum`` over BOTH axes reassembles the
+    full (d, B, 3) panel on every shard (the blocks are disjoint, so the
+    cross-model sum just concatenates them). Work per device drops from
+    ``n_local * d`` to ``n_local * d / m`` — the 2-D analogue of
+    LightGBM's data+feature hybrid — while split selection and row
+    routing stay replicated (cheap, and ``binned`` is already resident).
     """
     import jax
     import jax.numpy as jnp
@@ -127,6 +138,10 @@ def grow_tree(binned, grad, hess, row_weight, feature_mask, cfg: TreeConfig,
     from .sparse import SparseBinned
 
     if isinstance(binned, SparseBinned):
+        if model_axis_name is not None:
+            raise NotImplementedError(
+                "feature-parallel histograms need the dense (n, d) layout; "
+                "sparse input trains data-parallel (model axis size 1)")
         return _grow_tree_sparse(binned, grad, hess, row_weight,
                                  feature_mask, cfg, axis_name,
                                  cat_mask=cat_mask)
@@ -137,10 +152,33 @@ def grow_tree(binned, grad, hess, row_weight, feature_mask, cfg: TreeConfig,
     has_cat = cat_mask is not None
     voting = cfg.parallelism == "voting" and axis_name is not None
     if voting:
+        if model_axis_name is not None:
+            raise ValueError(
+                "parallelism='voting' keeps histograms local by design; "
+                "it composes with a data axis only (model axis size 1)")
         k_local = min(cfg.top_k, d)
         k_global = min(2 * cfg.top_k, d)
+    if model_axis_name is not None and axis_name is None:
+        raise ValueError("model_axis_name requires axis_name (2-D layout "
+                         "meshes always carry the data axis)")
 
     def hist_of(weight):
+        if model_axis_name is not None:
+            # feature-parallel block: this shard histograms features
+            # [j*blk, (j+1)*blk); the two-axis psum both reduces row
+            # shards AND reassembles the disjoint blocks (other shards
+            # contribute exact zeros outside their block)
+            m = lax.psum(1, model_axis_name)  # static: the axis size
+            j = lax.axis_index(model_axis_name)
+            blk = -(-d // m)
+            pad = m * blk - d
+            bp = jnp.pad(binned, ((0, 0), (0, pad))) if pad else binned
+            hb = histogram(lax.dynamic_slice_in_dim(bp, j * blk, blk, axis=1),
+                           grad, hess, weight, B,
+                           method=cfg.hist_method, chunk=cfg.hist_chunk)
+            h = lax.dynamic_update_slice_in_dim(
+                jnp.zeros((m * blk, B, 3), jnp.float32), hb, j * blk, axis=0)
+            return lax.psum(h[:d], (axis_name, model_axis_name))
         h = histogram(binned, grad, hess, weight, B,
                       method=cfg.hist_method, chunk=cfg.hist_chunk)
         if axis_name is not None and not voting:
@@ -148,7 +186,10 @@ def grow_tree(binned, grad, hess, row_weight, feature_mask, cfg: TreeConfig,
         return h
 
     # -- leaf-local gather histograms (LightGBM ConstructHistograms analogue) --
-    use_leaf_local = cfg.leaf_local and n > 2 * cfg.leaf_buf_min
+    # (the gather ladder scans full-width rows; under a model axis the
+    # feature-parallel block path above is the histogram work-splitter)
+    use_leaf_local = (cfg.leaf_local and n > 2 * cfg.leaf_buf_min
+                      and model_axis_name is None)
     if use_leaf_local:
         from .histogram import histogram_panel
 

@@ -90,7 +90,8 @@ class OnnxFunction:
 
     def __init__(self, model: "ModelProto | bytes", dtype_policy: str = "float32",
                  channels_last: bool = False,
-                 external_data_dir: "str | None" = None):
+                 external_data_dir: "str | None" = None,
+                 layout=None):
         import jax
 
         if isinstance(model, (bytes, bytearray, memoryview)):
@@ -128,6 +129,27 @@ class OnnxFunction:
         self.input_names: List[str] = [vi.name for vi in self.input_infos]
         self.output_names: List[str] = [vi.name for vi in self.graph.output]
         self._validate_ops(self.graph)
+        # -- model-parallel weight sharding (runtime/layout.py SpecLayout) ----
+        # MatMul/Gemm RHS weights partition COLUMN-wise over the layout's
+        # 'model' axis and Conv kernels over output channels; jax.jit's GSPMD
+        # pass inserts the collectives. Each chip then holds 1/m of every
+        # big weight — models larger than one chip's HBM serve at all, and
+        # the matmuls themselves run tensor-parallel. Weights keep their
+        # sharded placement from __init__ (device_put) and the traced program
+        # re-pins it (with_sharding_constraint), so the intent survives
+        # however jit stages the closure constants.
+        self.layout = layout
+        self._const_specs: Dict[str, Any] = (
+            self._plan_const_specs() if layout is not None
+            and getattr(layout, "model_size", 1) > 1 else {})
+        for name, spec in self._const_specs.items():
+            const = self.constants[name]
+            if self.dtype_policy == "bfloat16":
+                # cast BEFORE placement: the executable only ever consumes
+                # the bf16 view, and the whole point of tp-sharding is HBM
+                # headroom — a resident f32 master copy would triple it
+                const = const.astype(np.dtype("bfloat16"))
+            self.constants[name] = layout.put(const, spec)
         # profiled jit entry point: every XLA compile of this model is
         # timed into smt_compile_seconds{fn=...}, its cost_analysis FLOPs
         # cached, and warm calls attribute achieved MFU to the enclosing
@@ -156,6 +178,67 @@ class OnnxFunction:
 
     def input_shapes(self) -> Dict[str, Optional[List[Any]]]:
         return {vi.name: vi.shape for vi in self.input_infos}
+
+    # -- model-parallel spec planning (pure graph analysis, no jax) --------------
+
+    def _plan_const_specs(self) -> Dict[str, Any]:
+        """Per-initializer PartitionSpec for tensor-parallel serving.
+
+        A weight is sharded only when EVERY consumer agrees on one role:
+        - ``MatMul`` input 1, rank 2  -> columns (output features) over
+          ``model``;
+        - ``Gemm`` input 1, rank 2    -> the output-feature dim (respects
+          ``transB``);
+        - ``Conv`` input 1, rank 4    -> output channels (OIHW dim 0).
+        Anything else (biases, norm params, shape operands, multi-role
+        weights) replicates — GSPMD still partitions the surrounding
+        compute. Shape arithmetic never involves these tensors, so
+        constant folding is unaffected."""
+        roles: Dict[str, set] = {}
+
+        def scan(graph):
+            for node in graph.node:
+                attrs = node.attrs()
+                for slot, name in enumerate(node.input):
+                    if not name or name not in self.constants:
+                        continue
+                    const = self.constants[name]
+                    role = None
+                    if slot == 1 and node.op_type == "MatMul" \
+                            and const.ndim == 2:
+                        role = ("col", 1)
+                    elif slot == 1 and node.op_type == "Gemm" \
+                            and const.ndim == 2:
+                        role = ("col", 0 if int(attrs.get("transB", 0))
+                                else 1)
+                    elif slot == 1 and node.op_type == "Conv" \
+                            and const.ndim == 4:
+                        role = ("conv", 0)
+                    roles.setdefault(name, set()).add(role)
+                for a in node.attribute:
+                    if a.g is not None:
+                        scan(a.g)
+                    for g in a.graphs:
+                        scan(g)
+
+        scan(self.graph)
+        for f in self.functions.values():
+            scan(f)
+        layout = self.layout
+        specs: Dict[str, Any] = {}
+        for name, rs in roles.items():
+            if len(rs) != 1 or None in rs:
+                continue  # conflicting / non-weight use: replicate
+            kind, dim = next(iter(rs))
+            const = self.constants[name]
+            if not np.issubdtype(const.dtype, np.floating):
+                continue
+            if const.shape[dim] % layout.model_size:
+                continue  # indivisible output dim: replicate
+            specs[name] = (layout.conv_weight(rank=const.ndim)
+                           if kind == "conv"
+                           else layout.col_weight(rank=const.ndim, dim=dim))
+        return specs
 
     # -- execution ---------------------------------------------------------------
 
@@ -186,11 +269,18 @@ class OnnxFunction:
 
         env: Dict[str, Any] = {"": None}
         for name, const in self.constants.items():
-            env[name] = (
+            v = (
                 const.astype(np.dtype("bfloat16"))
                 if self.dtype_policy == "bfloat16" and np.issubdtype(const.dtype, np.floating)
                 else const
             )
+            if name in self._const_specs:
+                # re-pin the tensor-parallel placement inside the traced
+                # program so GSPMD partitions the consuming matmul however
+                # jit chose to stage the closure constant
+                v = self.layout.constraint(jnp.asarray(v),
+                                           self._const_specs[name])
+            env[name] = v
         for name, arr in zip(self.input_names, arrays):
             env[name] = self._cast_policy_in(arr)
         self._run_graph(self.graph, env)

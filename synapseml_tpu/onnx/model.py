@@ -45,6 +45,10 @@ class ONNXModel(Transformer):
                          validator=ParamValidators.in_list(["float32", "bfloat16"]))
     softmax_dict = Param("col -> softmax(col) output col", dict, default={})
     argmax_dict = Param("col -> argmax(col) output col", dict, default={})
+    sharding_layout = ComplexParam(
+        "optional runtime.layout.SpecLayout: shard MatMul/Gemm/Conv weights "
+        "over the layout's 'model' axis (tensor-parallel serving — models "
+        "bigger than one chip's HBM)", object, default=None)
 
     def __init__(self, uid=None, **kw):
         super().__init__(uid=uid, **kw)
@@ -66,7 +70,9 @@ class ONNXModel(Transformer):
         if self._fn is None:
             if self.model_bytes is None:
                 raise ValueError(f"ONNXModel({self.uid}): model_bytes not set")
-            self._fn = OnnxFunction(self.model_bytes, dtype_policy=self.dtype_policy)
+            self._fn = OnnxFunction(self.model_bytes,
+                                    dtype_policy=self.dtype_policy,
+                                    layout=self.sharding_layout)
         return self._fn
 
     # -- static schema (derived from the graph's value_info; NO jax) --------------

@@ -231,46 +231,47 @@ def sequence_sharded_attention(q, k, v, mesh, axis: str = "seq",
     collectives over the ICI ring. K/V may carry fewer (grouped/GQA) heads;
     ``block_q``/``block_k`` tune the ``local='flash'`` kernel (default:
     auto-picked to divide the gathered sequence)."""
-    import jax
-    from jax.sharding import NamedSharding, PartitionSpec as P
+    from ..runtime.layout import as_layout
 
     if strategy not in ("ring", "ulysses"):
         raise ValueError(f"unknown strategy {strategy!r}")
-    n = mesh.shape[axis]
+    # canonical sharding layout (runtime/layout.py): the sequence axis is
+    # the layout's row axis; accepts a raw Mesh (back-compat) or SpecLayout
+    layout = as_layout(mesh, data_axis=axis)
+    n = layout.data_size
     S = q.shape[1]
     if S % n:
         raise ValueError(f"sequence length {S} must be divisible by the "
-                         f"{axis!r} axis size {n}")
+                         f"{layout.data_axis!r} axis size {n}")
     if local not in ("dense", "flash"):
         raise ValueError(f"unknown local attention {local!r}")
-    run = _sharded_attn_fn(mesh, axis, strategy, causal, local, interpret,
+    run = _sharded_attn_fn(layout, strategy, causal, local, interpret,
                            block_q, block_k)
-    sharding = NamedSharding(mesh, P(None, axis, None, None))
-    return run(jax.device_put(q, sharding), jax.device_put(k, sharding),
-               jax.device_put(v, sharding))
+    spec = layout.batch(rank=4, dim=1)
+    return run(layout.put(q, spec), layout.put(k, spec),
+               layout.put(v, spec))
 
 
 @lru_cache(maxsize=64)
-def _sharded_attn_fn(mesh, axis: str, strategy: str, causal: bool,
+def _sharded_attn_fn(layout, strategy: str, causal: bool,
                      local: str = "dense", interpret: bool = False,
                      block_q: Optional[int] = None,
                      block_k: Optional[int] = None):
-    # cached per (mesh, axis, strategy, causal): a fresh jit closure per call
-    # would retrace + recompile on every invocation (per layer / per step)
+    # cached per (layout, strategy, causal): a fresh jit closure per call
+    # would retrace + recompile on every invocation (per layer / per step);
+    # SpecLayout is frozen/hashable exactly so it can key this cache
     import jax
-    from jax.sharding import PartitionSpec as P
 
-    from ..runtime.topology import shard_map_compat
-
+    axis = layout.data_axis
     if strategy == "ring":
         fn = partial(ring_attention, axis_name=axis, causal=causal)
     else:
         fn = partial(ulysses_attention, axis_name=axis, causal=causal,
                      local=local, interpret=interpret,
                      block_q=block_q, block_k=block_k)
-    spec = P(None, axis, None, None)
-    return jax.jit(shard_map_compat(
+    spec = layout.batch(rank=4, dim=1)
+    return jax.jit(layout.shard_map(
         fn,
-        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        in_specs=(spec, spec, spec), out_specs=spec,
         check=False,
     ))

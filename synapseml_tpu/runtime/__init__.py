@@ -5,6 +5,7 @@ from ..core.lazyimport import lazy_module
 # PEP 562 lazy exports (lint SMT008): attribute access imports the owning
 # submodule on demand, keeping `import synapseml_tpu.runtime` jax-free
 __getattr__, __dir__, __all__ = lazy_module(__name__, {
+    "layout": ["SpecLayout", "as_layout"],
     "shared": ["SharedVariable", "clear_shared_pool", "shared_singleton"],
     "topology": ["ClusterInfo", "best_mesh_shape", "cluster_info",
                  "device_kind", "initialize_distributed", "is_tpu",

@@ -1,0 +1,273 @@
+"""Canonical GSPMD sharding layout — one named mesh + PartitionSpec module.
+
+Every distributed path in this repo used to hand-roll its own 1-D
+``jax.sharding.Mesh`` and ad-hoc ``PartitionSpec`` plumbing (gbdt/boost,
+vw/learner, parallel/ring). That caps the framework at pure data
+parallelism: a model bigger than one chip's HBM cannot serve at all, and
+GBDT histograms cannot split work over features. This module is the one
+place mesh construction and tensor placement live:
+
+- **Named 2-D meshes** ``(data, model)`` built on
+  :func:`~synapseml_tpu.runtime.topology.make_mesh`, degrading gracefully
+  to ``(1, 1)`` on a single chip and to 1-D when only one axis is
+  populated (``model_axis=None``). The same code runs from 1 chip to a
+  pod — axis sizes change, programs don't.
+- **Canonical PartitionSpecs per tensor role**: :meth:`SpecLayout.batch`
+  (rows over ``data``), :meth:`SpecLayout.replicated` (params),
+  :meth:`SpecLayout.col_weight` (column-sharded weight matrices over
+  ``model`` — tensor-parallel MatMul/Gemm), :meth:`SpecLayout.conv_weight`
+  (output channels over ``model``), :meth:`SpecLayout.feature_blocks`
+  (GBDT histogram feature blocks: rows over ``data`` x features over
+  ``model``).
+- **Placement helpers**: :meth:`SpecLayout.sharding` /
+  :meth:`SpecLayout.put` / :meth:`SpecLayout.constraint`, plus a thin
+  :meth:`SpecLayout.shard_map` that wraps
+  :func:`~synapseml_tpu.runtime.topology.shard_map_compat` with the
+  layout's mesh bound — engines never touch ``jax.sharding`` directly
+  (lint rule SMT013 enforces this for new code).
+
+Import discipline: stdlib-only at import (jax reached lazily inside
+methods), like the rest of ``runtime``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional, Sequence, Tuple
+
+__all__ = ["SpecLayout", "as_layout"]
+
+_UNSET = object()
+
+
+@dataclasses.dataclass(frozen=True)
+class SpecLayout:
+    """A named mesh plus the canonical PartitionSpecs every engine shares.
+
+    Frozen and hashable (``jax.sharding.Mesh`` hashes by device assignment
+    and axis names) so layouts key ``lru_cache``'d compiled-program caches
+    the same way raw meshes did.
+    """
+
+    mesh: Any                               # jax.sharding.Mesh
+    data_axis: str = "data"
+    model_axis: Optional[str] = "model"
+
+    # -- constructors -----------------------------------------------------------
+
+    @classmethod
+    def build(cls, data: Optional[int] = None, model: Optional[int] = None,
+              *, devices: Optional[Sequence] = None,
+              data_axis: str = "data",
+              model_axis: Optional[str] = "model") -> "SpecLayout":
+        """Build a layout over the available devices.
+
+        ``model=m`` populates the model axis with ``m`` devices and the
+        data axis with the rest (``n // m``); ``data=d`` with ``model``
+        unset leaves the model axis at 1. Neither given: all devices on
+        ``data`` (pure data parallelism, the safe default). On one chip
+        every variant degrades to a ``(1, 1)`` mesh — specs still resolve,
+        collectives become no-ops. ``model_axis=None`` builds a 1-D mesh
+        over ``data_axis`` only (e.g. the sequence-parallel ``seq`` axis).
+        """
+        from .topology import make_mesh
+
+        if devices is None:
+            import jax
+
+            devices = jax.devices()
+        n = len(devices)
+        if model_axis is None:
+            shape: Tuple[int, ...] = (int(data) if data else n,)
+            mesh = make_mesh((data_axis,), shape=shape, devices=devices)
+            return cls(mesh=mesh, data_axis=data_axis, model_axis=None)
+        if model is None and data is None:
+            d2, m2 = n, 1
+        elif model is None:
+            d2, m2 = int(data), 1
+        elif data is None:
+            m2 = int(model)
+            if m2 < 1 or n % m2:
+                raise ValueError(
+                    f"model axis size {m2} must divide the {n} available "
+                    f"devices (pass data= explicitly for a partial mesh)")
+            d2 = n // m2
+        else:
+            d2, m2 = int(data), int(model)
+        mesh = make_mesh((data_axis, model_axis), shape=(d2, m2),
+                         devices=devices)
+        return cls(mesh=mesh, data_axis=data_axis, model_axis=model_axis)
+
+    @classmethod
+    def from_mesh(cls, mesh, data_axis: Optional[str] = None,
+                  model_axis=_UNSET) -> "SpecLayout":
+        """Wrap an existing mesh. ``data_axis`` defaults to ``'data'`` when
+        the mesh has it, else the mesh's first axis; ``model_axis`` to
+        ``'model'`` when present (else None — 1-D degradation)."""
+        names = tuple(mesh.axis_names)
+        if data_axis is None:
+            data_axis = "data" if "data" in names else names[0]
+        if data_axis not in names:
+            raise ValueError(f"mesh axes {names} have no {data_axis!r} axis")
+        if model_axis is _UNSET:
+            model_axis = "model" if ("model" in names
+                                     and data_axis != "model") else None
+        if model_axis is not None and model_axis not in names:
+            raise ValueError(f"mesh axes {names} have no {model_axis!r} axis")
+        return cls(mesh=mesh, data_axis=data_axis, model_axis=model_axis)
+
+    # -- sizes ------------------------------------------------------------------
+
+    @property
+    def data_size(self) -> int:
+        return int(self.mesh.shape[self.data_axis])
+
+    @property
+    def model_size(self) -> int:
+        if self.model_axis is None:
+            return 1
+        return int(self.mesh.shape[self.model_axis])
+
+    @property
+    def n_devices(self) -> int:
+        return self.data_size * self.model_size
+
+    @property
+    def axis_names(self) -> Tuple[str, ...]:
+        return tuple(self.mesh.axis_names)
+
+    @property
+    def is_single_device(self) -> bool:
+        return self.n_devices == 1
+
+    def describe(self) -> dict:
+        """JSON-able mesh summary (stamped into MULTICHIP artifacts)."""
+        out = {self.data_axis: self.data_size}
+        if self.model_axis is not None:
+            out[self.model_axis] = self.model_size
+        return out
+
+    # -- canonical specs per tensor role ---------------------------------------
+
+    def batch(self, rank: int = 1, dim: int = 0):
+        """Batch rows sharded over ``data`` at position ``dim`` of a
+        rank-``rank`` tensor (everything else replicated) — the spec for
+        feature matrices, labels, weights, activations."""
+        from jax.sharding import PartitionSpec as P
+
+        axes = [None] * rank
+        axes[dim] = self.data_axis
+        return P(*axes)
+
+    def replicated(self):
+        """Fully replicated (scalars, RNG keys, small parameters)."""
+        from jax.sharding import PartitionSpec as P
+
+        return P()
+
+    def col_weight(self, rank: int = 2, dim: Optional[int] = None):
+        """Column-sharded weight matrix: output-feature dim (default: last)
+        over ``model`` — the tensor-parallel MatMul/Gemm layout. Degrades
+        to replicated on a 1-D layout."""
+        from jax.sharding import PartitionSpec as P
+
+        axes: list = [None] * rank
+        if self.model_axis is not None:
+            axes[rank - 1 if dim is None else dim] = self.model_axis
+        return P(*axes)
+
+    def conv_weight(self, rank: int = 4):
+        """Conv kernel (OIHW): output channels over ``model``."""
+        return self.col_weight(rank=rank, dim=0)
+
+    def feature_blocks(self):
+        """GBDT histogram layout: rows over ``data`` x feature blocks over
+        ``model`` (stats ``psum`` per axis)."""
+        from jax.sharding import PartitionSpec as P
+
+        if self.model_axis is None:
+            return P(self.data_axis)
+        return P(self.data_axis, self.model_axis)
+
+    # -- placement --------------------------------------------------------------
+
+    def sharding(self, spec):
+        from jax.sharding import NamedSharding
+
+        return NamedSharding(self.mesh, spec)
+
+    def put(self, x, spec):
+        """``device_put`` onto the layout (host->device or resharding)."""
+        import jax
+
+        return jax.device_put(x, self.sharding(spec))
+
+    def constraint(self, x, spec):
+        """``with_sharding_constraint`` inside a traced program — pins the
+        placement GSPMD must honor (jit inserts the collectives)."""
+        import jax
+
+        return jax.lax.with_sharding_constraint(x, self.sharding(spec))
+
+    def shard_map(self, f, in_specs, out_specs, check: bool = True):
+        """``shard_map`` over the layout's mesh, axis names resolved from
+        the layout (drift-proof through ``shard_map_compat``)."""
+        from .topology import shard_map_compat
+
+        return shard_map_compat(f, mesh=self.mesh, in_specs=in_specs,
+                                out_specs=out_specs, check=check)
+
+    # -- persistence (core/serialization state_dict protocol) -------------------
+
+    def state_dict(self) -> dict:
+        """Axis names + sizes only — a Mesh is bound to live devices and
+        cannot travel; the loading process rebuilds it over ITS devices."""
+        return {"data_axis": self.data_axis,
+                "model_axis": self.model_axis or "",
+                "data": self.data_size,
+                "model": self.model_size}
+
+    @staticmethod
+    def from_state_dict(d: dict) -> "SpecLayout":
+        """Rebuild on the loading process's devices. A layout never changes
+        results (placement only — parity-tested), so when this process has
+        fewer devices than the saved shape the layout degrades to what fits
+        (ultimately ``(1, 1)``) instead of failing the load — a 1-chip
+        serving worker can load a pipeline saved on an 8-chip trainer."""
+        import jax
+
+        data_axis = str(d["data_axis"])
+        model_axis = str(d.get("model_axis") or "") or None
+        want_data, want_model = int(d["data"]), int(d.get("model", 1))
+        n = len(jax.devices())
+        if model_axis is None:
+            return SpecLayout.build(data=min(want_data, n),
+                                    data_axis=data_axis, model_axis=None)
+        if want_data * want_model > n:
+            import logging
+
+            logging.getLogger("synapseml_tpu.layout").warning(
+                "saved layout (%s=%d, %s=%d) needs %d devices, have %d; "
+                "degrading", data_axis, want_data, model_axis, want_model,
+                want_data * want_model, n)
+            want_model = max(1, min(want_model, n))
+            want_data = max(1, min(want_data, n // want_model))
+        return SpecLayout.build(data=want_data, model=want_model,
+                                data_axis=data_axis, model_axis=model_axis)
+
+
+from ..core.serialization import register_state_class
+
+register_state_class(SpecLayout)
+
+
+def as_layout(mesh_or_layout, data_axis: str = "data") -> SpecLayout:
+    """Coerce an engine's ``mesh=`` argument (a raw ``jax.sharding.Mesh``
+    — back-compat — or a :class:`SpecLayout`) into a layout. ``data_axis``
+    is the caller's row axis name and is honored when the mesh has it."""
+    if isinstance(mesh_or_layout, SpecLayout):
+        return mesh_or_layout
+    names = tuple(getattr(mesh_or_layout, "axis_names", ()))
+    return SpecLayout.from_mesh(
+        mesh_or_layout,
+        data_axis=data_axis if data_axis in names else None)

@@ -149,14 +149,15 @@ def train_linear(
         carry, _ = lax.scan(batch_step, state, (bi, bv, by, bw))
         return carry
 
-    axis_name = axis if mesh is not None else None
-
     if mesh is not None:
-        from jax.sharding import NamedSharding, PartitionSpec as P
+        # canonical sharding layout (runtime/layout.py): accepts a raw Mesh
+        # (back-compat) or a SpecLayout; rows shard over the data axis, the
+        # state replicates, and pass-boundary pmeans ride the data axis
+        from ..runtime.layout import as_layout
 
-        from ..runtime.topology import shard_map_compat
-
-        shards = mesh.shape[axis]
+        layout = as_layout(mesh, data_axis=axis)
+        axis_name = layout.data_axis
+        shards = layout.data_size
         per = -(-n // shards)  # rows per shard, rounded up
         pad_rows = per * shards - n
         if pad_rows:
@@ -189,18 +190,17 @@ def train_linear(
                 jax.lax.pmean(b, axis_name), jax.lax.pmean(bg2, axis_name),
                 jax.lax.pmax(s, axis_name))
 
-        ds = P(axis)
-        sharded_pass = shard_map_compat(
-            pass_fn, mesh=mesh,
-            in_specs=(P(), ds, ds, ds, ds), out_specs=P(),
+        ds = layout.batch()
+        rep = layout.replicated()
+        step_fn = layout.shard_map(
+            pass_fn,
+            in_specs=(rep, ds, ds, ds, ds), out_specs=rep,
             check=False,
         )
-        step_fn = sharded_pass
-        args = (jax.device_put(bi, NamedSharding(mesh, ds)),
-                jax.device_put(bv, NamedSharding(mesh, ds)),
-                jax.device_put(by, NamedSharding(mesh, ds)),
-                jax.device_put(bw, NamedSharding(mesh, ds)))
+        args = (layout.put(bi, ds), layout.put(bv, ds),
+                layout.put(by, ds), layout.put(bw, ds))
     else:
+        axis_name = None
         nb = -(-n // batch_size)
         pad_rows = nb * batch_size - n
 

@@ -70,9 +70,21 @@ def _ds_peaks(seed=106, n=2000):
     return x, y
 
 
+def _ds_breast_cancer():
+    """sklearn's bundled REAL breast-cancer dataset (569 x 30) — the same
+    data behind BASELINE.md's reference AUC row (LightGBMClassifier 0.9920,
+    benchmarks_VerifyLightGBMClassifier.csv:22). Bundled with sklearn:
+    zero-egress, fully deterministic."""
+    from sklearn.datasets import load_breast_cancer
+
+    x, y = load_breast_cancer(return_X_y=True)
+    return np.asarray(x, np.float64), np.asarray(y, np.float64)
+
+
 CLF_DATASETS: Dict[str, Tuple] = {
     "linear10": _ds_linear, "xor": _ds_xor,
     "imbalanced": _ds_imbalanced, "categorical16": _ds_categorical,
+    "breast_cancer": _ds_breast_cancer,
 }
 REG_DATASETS: Dict[str, Tuple] = {"friedman": _ds_friedman, "peaks": _ds_peaks}
 
@@ -110,6 +122,12 @@ def measure_classifier(dataset: str, variant: str) -> float:
               "min_data_in_leaf": 10, "seed": 0, **CLF_VARIANTS[variant]}
     if dataset == "categorical16":
         params["categorical_feature"] = [0]
+    if dataset == "breast_cancer":
+        # LightGBM-default-shaped config, matching the spirit of the
+        # reference's benchmarks_VerifyLightGBMClassifier.csv:22 run
+        # (0.9920) rather than the small-synthetic config above
+        params.update(num_iterations=100, num_leaves=31, min_data_in_leaf=20,
+                      **CLF_VARIANTS[variant])
     b = train(params, xtr, ytr)
     return float(auc(yte, b.predict(xte)))
 

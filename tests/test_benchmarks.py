@@ -30,6 +30,33 @@ def test_classifier_benchmark(row):
     _compare(bu.measure_classifier(row["dataset"], row["variant"]), row)
 
 
+import functools
+
+
+@functools.lru_cache(maxsize=None)
+def _measure_realdata(dataset, variant):
+    # the reference-band floor test reuses the ratchet row's training run
+    # (100 iterations each — no point training the identical config twice)
+    return bu.measure_classifier(dataset, variant)
+
+
+@pytest.mark.parametrize("row", _rows("benchmarks_gbdt_realdata.csv"))
+def test_realdata_classifier_benchmark(row):
+    """REAL-data quality ratchet (ROADMAP item 6): sklearn's bundled
+    breast-cancer dataset under a LightGBM-default-shaped config, measured
+    values committed like every other ratchet row."""
+    _compare(_measure_realdata(row["dataset"], row["variant"]), row)
+
+
+def test_realdata_gbdt_tracks_reference_auc():
+    """BASELINE.md row 21: the reference LightGBMClassifier scores 0.9920
+    AUC on breast-cancer (benchmarks_VerifyLightGBMClassifier.csv:22).
+    The TPU engine must stay inside the reference band — a quality
+    regression vs the REAL engine fails here, not just vs our own
+    committed number."""
+    assert _measure_realdata("breast_cancer", "gbdt") >= 0.9920 - 0.01
+
+
 @pytest.mark.parametrize("row", _rows("benchmarks_gbdt_regressor.csv"))
 def test_regressor_benchmark(row):
     _compare(bu.measure_regressor(row["dataset"], row["variant"]), row)

@@ -208,6 +208,74 @@ def test_distributed_matches_single_device(data, eight_device_mesh):
     assert np.corrcoef(pd_, p1)[0, 1] > 0.999
 
 
+def test_layout_single_chip_matches_pre_layout_bitwise(data):
+    """The layout-adopted path on ONE chip ((1, 1) SpecLayout) reproduces
+    the plain single-device train bit-for-bit (no sampling, so the mesh
+    path's RNG folds are inert and n divides the shard count)."""
+    from synapseml_tpu.runtime.layout import SpecLayout
+
+    x, y, _, _ = data
+    params = {"objective": "binary", "num_iterations": 8, "num_leaves": 15,
+              "min_data_in_leaf": 5}
+    b_plain = train(params, x[:1200], y[:1200])
+    b_lay = train(params, x[:1200], y[:1200],
+                  mesh=SpecLayout.build(data=1, model=1))
+    np.testing.assert_array_equal(b_lay.feature, b_plain.feature)
+    np.testing.assert_array_equal(b_lay.parent, b_plain.parent)
+    np.testing.assert_array_equal(b_lay.bin, b_plain.bin)
+    np.testing.assert_array_equal(b_lay.leaf_value, b_plain.leaf_value)
+
+
+def test_layout_wraps_raw_mesh_bitwise(data):
+    """as_layout(raw 1-D Mesh) is a pure re-plumbing: same shard count,
+    same programs, identical trees to passing the Mesh directly."""
+    from jax.sharding import Mesh
+
+    from synapseml_tpu.runtime.layout import SpecLayout
+
+    x, y, _, _ = data
+    params = {"objective": "binary", "num_iterations": 6, "num_leaves": 15,
+              "min_data_in_leaf": 5}
+    raw = Mesh(np.asarray(jax.devices()[:8]), ("data",))
+    b_raw = train(params, x[:2400], y[:2400], mesh=raw)
+    b_lay = train(params, x[:2400], y[:2400],
+                  mesh=SpecLayout.build(data=8, model=1))
+    np.testing.assert_array_equal(b_lay.feature, b_raw.feature)
+    np.testing.assert_array_equal(b_lay.leaf_value, b_raw.leaf_value)
+
+
+def test_feature_parallel_matches_data_parallel(data):
+    """2-D (4, 2) layout — feature-parallel histograms (features over
+    'model', stats psum'd per axis) — grows the SAME trees as the (4, 1)
+    data-parallel layout: the reassembled histogram panel is numerically
+    identical, only the per-device work drops to d/m."""
+    from synapseml_tpu.runtime.layout import SpecLayout
+
+    x, y, _, _ = data
+    params = {"objective": "binary", "num_iterations": 8, "num_leaves": 15,
+              "min_data_in_leaf": 5}
+    b_fp = train(params, x[:2400], y[:2400],
+                 mesh=SpecLayout.build(data=4, model=2))
+    b_dp = train(params, x[:2400], y[:2400],
+                 mesh=SpecLayout.build(data=4, model=1))
+    np.testing.assert_array_equal(b_fp.feature, b_dp.feature)
+    np.testing.assert_array_equal(b_fp.parent, b_dp.parent)
+    np.testing.assert_array_equal(b_fp.bin, b_dp.bin)
+    np.testing.assert_allclose(b_fp.leaf_value, b_dp.leaf_value,
+                               rtol=1e-6, atol=1e-7)
+
+
+def test_feature_parallel_2d_mesh_via_raw_mesh(data, eight_device_mesh):
+    """Passing a raw 2-D (data, model) Mesh engages the same
+    feature-parallel path through as_layout — and trains accurately with
+    bagging/GOSS in the mix (the sampled paths ride the same layout)."""
+    x, y, _, _ = data
+    params = {"objective": "binary", "num_iterations": 12, "num_leaves": 15,
+              "min_data_in_leaf": 5, "boosting": "goss", "seed": 3}
+    b = train(params, x[:2400], y[:2400], mesh=eight_device_mesh)
+    assert _auc(y[2400:], b.predict(x[2400:])) > 0.9
+
+
 def test_gbdt_dataset_reuse(data):
     """GBDTDataset (SharedState analogue): bin + upload once, identical
     models across fits, device buffer actually shared."""

@@ -202,6 +202,52 @@ def test_sparse_eval_early_stopping():
     assert len(b.evals_result) <= 50
 
 
+def test_sparse_eval_host_loop_fallback():
+    """ROADMAP item 2 guard CLOSED: sparse eval_set no longer requires the
+    on-device eval path. With callbacks forcing the host loop, eval trees
+    replay on device over the SparseBinned eval matrix (no dense host
+    matrix), and the metrics match the device-eval path."""
+    X, y = _sparse_data(1200, 200)
+    params = {"objective": "binary", "num_iterations": 12, "num_leaves": 15,
+              "min_data_in_leaf": 5}
+    b_dev = train(params, X[:900], y[:900], eval_set=[(X[900:], y[900:])])
+    seen = []
+    b_host = train(params, X[:900], y[:900], eval_set=[(X[900:], y[900:])],
+                   callbacks=[lambda info: seen.append(info["iteration"])])
+    assert seen == list(range(12))  # the host loop actually ran
+    m_dev = [r["eval0_binary_logloss"] for r in b_dev.evals_result]
+    m_host = [r["eval0_binary_logloss"] for r in b_host.evals_result]
+    np.testing.assert_allclose(m_host, m_dev, rtol=1e-4, atol=1e-5)
+    # same training stream -> same trees either way
+    np.testing.assert_allclose(b_host.predict(X[900:]), b_dev.predict(X[900:]),
+                               rtol=1e-6, atol=1e-7)
+
+
+def test_sparse_eval_host_metric_fallback():
+    """A host-only metric (no device twin) used to raise on sparse input;
+    now it falls back to the host loop and records per-iteration evals."""
+    X, y = _sparse_data(900, 150)
+    b = train({"objective": "binary", "num_iterations": 8, "num_leaves": 15,
+               "min_data_in_leaf": 5, "metric": "auc",
+               "early_stopping_round": 4},
+              X[:700], y[:700], eval_set=[(X[700:], y[700:])])
+    assert b.evals_result and "eval0_auc" in b.evals_result[0]
+    assert b.evals_result[-1]["eval0_auc"] > 0.7
+
+
+def test_sparse_dart_eval_set():
+    """dart + sparse + eval_set (host loop incl. the dart rescale sync of
+    eval margins over SparseBinned) trains and records evals."""
+    X, y = _sparse_data(600, 80)
+    b = train({"objective": "binary", "boosting": "dart",
+               "num_iterations": 8, "num_leaves": 7, "min_data_in_leaf": 5,
+               "drop_rate": 0.5, "seed": 3},
+              X[:450], y[:450], eval_set=[(X[450:], y[450:])])
+    assert len(b.evals_result) == 8
+    assert np.isfinite([r["eval0_binary_logloss"]
+                        for r in b.evals_result]).all()
+
+
 def _cat_sparse_data(n=800, d=60, seed=0):
     """Sparse matrix whose column 0 is an informative categorical."""
     rng = np.random.default_rng(seed)

@@ -59,6 +59,7 @@ _GATED_MODULES = [
     "synapseml_tpu.parallel",
     "synapseml_tpu.recommendation",
     "synapseml_tpu.runtime",
+    "synapseml_tpu.runtime.layout",
     "synapseml_tpu.vw",
 ]
 

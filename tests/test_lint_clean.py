@@ -645,6 +645,66 @@ def test_smt012_out_of_scope_paths_not_flagged(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# SMT013 — ad-hoc mesh construction outside runtime/layout.py
+# ---------------------------------------------------------------------------
+
+def test_smt013_true_positive(tmp_path):
+    findings = run_rule(tmp_path, "SMT013", """\
+        import jax.sharding
+        from jax.sharding import Mesh
+        from jax import sharding as shd
+
+        def private_mesh(devs):
+            return Mesh(devs, ("data",))
+
+        def dotted(devs):
+            return jax.sharding.Mesh(devs, ("rows",))
+
+        def via_module_alias(devs):
+            return shd.Mesh(devs, ("cols",))
+
+        def via_topology():
+            from synapseml_tpu.runtime.topology import make_mesh
+            return make_mesh(("data",))
+        """)
+    assert [f.line for f in findings] == [6, 9, 12, 16]
+    assert all(f.code == "SMT013" for f in findings)
+    assert "SpecLayout" in findings[0].message
+
+
+def test_smt013_true_negative(tmp_path):
+    findings = run_rule(tmp_path, "SMT013", """\
+        def through_the_layout():
+            from synapseml_tpu.runtime.layout import SpecLayout
+            lay = SpecLayout.build(model=2)
+            return lay.shard_map, lay.mesh
+
+        class Mesh:  # a local class named Mesh is not jax's
+            pass
+
+        def unrelated(x):
+            return x.Mesh  # attribute access, not a construction call
+        """)
+    assert findings == []
+
+
+def test_smt013_exempts_the_layout_and_topology_modules(tmp_path):
+    d = tmp_path / "runtime"
+    d.mkdir()
+    src = textwrap.dedent("""\
+        from jax.sharding import Mesh
+
+        def build(devs, names):
+            return Mesh(devs, names)
+        """)
+    (d / "layout.py").write_text(src)
+    (d / "topology.py").write_text(src)
+    (d / "elsewhere.py").write_text(src)
+    report = analyze_paths([str(tmp_path)], select=["SMT013"], use_acks=False)
+    assert [f.path for f in report["findings"]] == ["runtime/elsewhere.py"]
+
+
+# ---------------------------------------------------------------------------
 # SARIF output
 # ---------------------------------------------------------------------------
 

@@ -229,6 +229,46 @@ def test_smt104_true_negative_declared_axis():
                             mesh_axes=("data",)), "SMT104") == []
 
 
+def _layout_2d_psum_fn(psum_axes):
+    """Collectives over a 2-D (data, model) SpecLayout mesh — the
+    feature-parallel shape (axis_index on 'model', psum over both axes)."""
+    import jax.numpy as jnp
+
+    from synapseml_tpu.runtime.layout import SpecLayout
+
+    layout = SpecLayout.build(data=1, model=1,
+                              devices=jax.devices("cpu")[:1])
+
+    def body(x):
+        j = jax.lax.axis_index("model")
+        part = jnp.where(j == 0, x, jnp.zeros_like(x))
+        return jax.lax.psum(part, psum_axes)
+
+    return layout.shard_map(body, in_specs=(layout.batch(),),
+                            out_specs=layout.replicated(), check=False)
+
+
+def test_smt104_2d_layout_mesh_true_negative():
+    """A 2-D layout entry declaring both axes passes: psum over
+    ('data', 'model') + model-axis axis_index all bind declared names."""
+    fn = _layout_2d_psum_fn(("data", "model"))
+    assert _findings(_entry("fix.layout2d", fn, (np.ones(4, np.float32),),
+                            mesh_axes=("data", "model")), "SMT104") == []
+
+
+def test_smt104_2d_layout_mesh_catches_missing_model_axis():
+    """The same 2-D program against a 1-D declaration: the 'model'
+    collectives are findings — exactly the drift SMT104 exists to catch
+    when an engine adopts the layout but its entry declaration lags."""
+    fn = _layout_2d_psum_fn(("data", "model"))
+    fs = _findings(_entry("fix.layout2d.miss", fn,
+                          (np.ones(4, np.float32),),
+                          mesh_axes=("data",)), "SMT104")
+    assert fs and any("'model'" in f.message for f in fs)
+    assert all("data" not in f.message.split("declares")[0]
+               or "'model'" in f.message for f in fs)
+
+
 # ---------------------------------------------------------------------------
 # SMT105 — HBM-bloat closure constants
 # ---------------------------------------------------------------------------

@@ -1,0 +1,43 @@
+"""The committed MULTICHIP artifact: the driver's multi-chip gate output.
+
+Since ISSUE 14 the dryrun runs every engine over ONE canonical 2-D
+``(data, model)`` ``SpecLayout`` mesh (``runtime/layout.py``) — this test
+pins the committed artifact to that shape so a regression back to 1-D
+data-parallel-only dryruns fails CI, not just review.
+"""
+
+import glob
+import json
+import os
+import re
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _latest_artifact():
+    paths = glob.glob(os.path.join(REPO, "MULTICHIP_r*.json"))
+    assert paths, "no MULTICHIP_r*.json artifacts committed"
+
+    def rnd(p):
+        return int(re.search(r"MULTICHIP_r(\d+)", os.path.basename(p)).group(1))
+
+    return max(paths, key=rnd)
+
+
+def test_latest_multichip_artifact_is_ok():
+    with open(_latest_artifact()) as f:
+        art = json.load(f)
+    assert art["ok"] is True
+    assert art["rc"] == 0
+    assert not art["skipped"]
+    assert art["n_devices"] >= 8
+
+
+def test_latest_multichip_artifact_exercises_2d_mesh():
+    with open(_latest_artifact()) as f:
+        art = json.load(f)
+    mesh = art.get("mesh")
+    assert mesh, "artifact missing the mesh stamp (layout.describe())"
+    assert set(mesh) == {"data", "model"}
+    assert mesh["model"] >= 2, "model axis unpopulated: not a 2-D dryrun"
+    assert mesh["data"] * mesh["model"] == art["n_devices"]

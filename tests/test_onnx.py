@@ -384,3 +384,102 @@ def test_onnx_model_empty_table():
     )
     out = m.transform(Table({"c": np.zeros((0, 4), np.float32)}))
     assert out["out"].shape == (0, 3)
+
+
+# -- model-parallel (tensor-parallel) serving: runtime/layout.py --------------------
+
+def _tp_mlp_bytes(rng, d=32, h=64, out=8):
+    w1 = (rng.normal(size=(d, h)) / np.sqrt(d)).astype(np.float32)
+    b1 = rng.normal(size=(h,)).astype(np.float32)
+    w2 = (rng.normal(size=(h, out)) / np.sqrt(h)).astype(np.float32)
+    g = make_graph(
+        [node("MatMul", ["x", "w1"], ["h0"]),
+         node("Add", ["h0", "b1"], ["h1"]),
+         node("Relu", ["h1"], ["h2"]),
+         node("MatMul", ["h2", "w2"], ["y"])],
+        "tp_mlp",
+        [value_info("x", np.float32, [None, d])],
+        [value_info("y", np.float32, [None, out])],
+        {"w1": w1, "b1": b1, "w2": w2})
+    return serialize_model(make_model(g))
+
+
+def test_tp_sharded_matmul_weights_match_single_device():
+    """MatMul initializer weights column-shard over the layout 'model' axis
+    (jit-inserted collectives); outputs must match the unsharded graph."""
+    from synapseml_tpu.runtime.layout import SpecLayout
+
+    rng = np.random.default_rng(7)
+    mb = _tp_mlp_bytes(rng)
+    x = rng.normal(size=(16, 32)).astype(np.float32)
+    ref = np.asarray(OnnxFunction(mb)({"x": x})["y"])
+    layout = SpecLayout.build(data=2, model=4)
+    fn_tp = OnnxFunction(mb, layout=layout)
+    # both MatMul weights sharded column-wise; the bias replicates
+    assert set(fn_tp._const_specs) == {"w1", "w2"}
+    from jax.sharding import PartitionSpec as P
+
+    assert fn_tp._const_specs["w1"] == P(None, "model")
+    out = np.asarray(fn_tp({"x": x})["y"])
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_tp_sharding_degrades_to_single_chip():
+    """(1, 1) layout: no weight sharded, outputs bit-identical."""
+    import jax
+
+    from synapseml_tpu.runtime.layout import SpecLayout
+
+    rng = np.random.default_rng(8)
+    mb = _tp_mlp_bytes(rng)
+    x = rng.normal(size=(4, 32)).astype(np.float32)
+    ref = np.asarray(OnnxFunction(mb)({"x": x})["y"])
+    lay = SpecLayout.build(devices=jax.devices()[:1])
+    fn = OnnxFunction(mb, layout=lay)
+    assert fn._const_specs == {}
+    np.testing.assert_array_equal(np.asarray(fn({"x": x})["y"]), ref)
+
+
+def test_tp_sharding_respects_gemm_transb_and_indivisible_dims():
+    """Gemm transB=1 weights shard dim 0 (the output-feature dim); a weight
+    whose output dim does not divide the model axis replicates instead of
+    erroring."""
+    from jax.sharding import PartitionSpec as P
+
+    from synapseml_tpu.runtime.layout import SpecLayout
+
+    rng = np.random.default_rng(9)
+    wt = (rng.normal(size=(6, 16)) / 4).astype(np.float32)  # (N=6, K=16)
+    bias = np.zeros(6, np.float32)
+    w_odd = rng.normal(size=(16, 5)).astype(np.float32)  # 5 cols: indivisible
+    g = make_graph(
+        [node("Gemm", ["x", "wt", "bias"], ["h"], transB=1),
+         node("MatMul", ["x", "w_odd"], ["z"])],
+        "gemm_tp",
+        [value_info("x", np.float32, [None, 16])],
+        [value_info("h", np.float32, [None, 6]),
+         value_info("z", np.float32, [None, 5])],
+        {"wt": wt, "bias": bias, "w_odd": w_odd})
+    mb = serialize_model(make_model(g))
+    x = rng.normal(size=(8, 16)).astype(np.float32)
+    ref = OnnxFunction(mb)({"x": x})
+    fn = OnnxFunction(mb, layout=SpecLayout.build(data=4, model=2))
+    assert fn._const_specs == {"wt": P("model", None)}  # w_odd replicated
+    out = fn({"x": x})
+    for k in ("h", "z"):
+        np.testing.assert_allclose(np.asarray(out[k]), np.asarray(ref[k]),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_tp_sharding_bf16_policy():
+    """The bfloat16 MXU policy composes with tensor-parallel weights."""
+    from synapseml_tpu.runtime.layout import SpecLayout
+
+    rng = np.random.default_rng(10)
+    mb = _tp_mlp_bytes(rng)
+    x = rng.normal(size=(8, 32)).astype(np.float32)
+    ref = np.asarray(OnnxFunction(mb, dtype_policy="bfloat16")({"x": x})["y"])
+    fn = OnnxFunction(mb, dtype_policy="bfloat16",
+                      layout=SpecLayout.build(data=2, model=4))
+    out = np.asarray(fn({"x": x})["y"])
+    np.testing.assert_allclose(out, ref, rtol=2e-2, atol=2e-2)

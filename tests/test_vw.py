@@ -145,6 +145,43 @@ def test_linear_learner_distributed(eight_device_mesh):
     assert 1 - np.var(y - p) / np.var(y) > 0.85
 
 
+def test_linear_learner_layout_matches_raw_mesh_bitwise(eight_device_mesh):
+    """The layout-adopted vw path (runtime/layout.py) is a pure
+    re-plumbing of the old private 1-D mesh code: a SpecLayout with the
+    same shard count yields BIT-identical learner state."""
+    from jax.sharding import Mesh
+
+    from synapseml_tpu.runtime.layout import SpecLayout
+
+    rng = np.random.default_rng(4)
+    n, K, bits = 1024, 4, 10
+    idx = rng.integers(0, 1 << bits, size=(n, K)).astype(np.int32)
+    val = rng.normal(size=(n, K)).astype(np.float32)
+    y = rng.normal(size=n)
+    mesh = Mesh(np.asarray(jax.devices()[:8]), ("data",))
+    st_raw = train_linear(idx, val, y, num_bits=bits, num_passes=3, mesh=mesh)
+    st_lay = train_linear(idx, val, y, num_bits=bits, num_passes=3,
+                          mesh=SpecLayout.build(data=8, model=1))
+    for a, b in zip(st_raw, st_lay):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_linear_learner_layout_single_chip_matches_plain_bitwise():
+    """(1, 1) layout degradation: identical state to the meshless path."""
+    from synapseml_tpu.runtime.layout import SpecLayout
+
+    rng = np.random.default_rng(5)
+    n, K, bits = 512, 4, 10
+    idx = rng.integers(0, 1 << bits, size=(n, K)).astype(np.int32)
+    val = rng.normal(size=(n, K)).astype(np.float32)
+    y = rng.normal(size=n)
+    st_plain = train_linear(idx, val, y, num_bits=bits, num_passes=2)
+    st_lay = train_linear(idx, val, y, num_bits=bits, num_passes=2,
+                          mesh=SpecLayout.build(data=1, model=1))
+    for a, b in zip(st_plain, st_lay):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
 def test_pad_examples_masks_bits():
     col = np.empty(2, dtype=object)
     col[0] = (np.array([2 ** 30, 5], np.uint32), np.array([1.0, 2.0], np.float32))

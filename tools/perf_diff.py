@@ -52,6 +52,7 @@ PRIMARY = {
     "vit_to_gbdt_pipeline": "images_per_sec_end_to_end",
     "flash_attention_32k": "tflops_nominal",
     "flash_attention_gqa": "tflops_nominal",
+    "onnx_tp_sharding": "rows_per_sec",
 }
 
 
