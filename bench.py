@@ -1194,42 +1194,177 @@ def load_waivers(path=None):
         return waivers
     with open(path) as f:
         for line in f:
-            m = re.match(r"\s*\|\s*(\d+)\s*\|\s*([A-Za-z0-9_]+)\s*\|", line)
+            # config may carry a gate prefix: "mfu:<lane>" waives an MFU
+            # floor violation, "flat:<lane>" a stagnation violation
+            m = re.match(r"\s*\|\s*(\d+)\s*\|\s*([A-Za-z0-9_:]+)\s*\|", line)
             if m:
                 waivers.add((int(m.group(1)), m.group(2)))
     return waivers
 
 
-def unwaived_regressions(here=None, threshold=RATCHET_THRESHOLD,
-                         waivers=None):
-    """Scan every committed ``BENCH_r{N}.json`` (armored loader — damaged
-    artifacts recover what they can) for per-lane ``vs_prev_round`` ratios
-    below ``threshold`` without a ``BENCH_ACKS.md`` waiver. Returns
-    ``[(round, config, ratio), ...]`` — empty means the ratchet holds."""
+def _committed_rounds(here=None):
+    """Every committed round's recovered ``extra`` dict: ``{round: extra}``
+    (the armored loader recovers what it can from damaged artifacts)."""
     import glob
     import os
     import re
 
     if here is None:
         here = os.path.dirname(os.path.abspath(__file__))
-    if waivers is None:
-        waivers = load_waivers(os.path.join(here, "BENCH_ACKS.md"))
-    offenders = []
+    out = {}
     for path in sorted(glob.glob(os.path.join(here, "BENCH_r*.json"))):
         m = re.search(r"BENCH_r(\d+)\.json$", path)
         if not m:
             continue
         rnd = int(m.group(1))
         got = _load_round_file(path, rnd)
-        if got is None:
+        if got is not None:
+            out[rnd] = got[2]
+    return out
+
+
+# ---------------------------------------------------------------------------
+# MFU ratchet (ROADMAP item 6): floors + a flat-lane stagnation detector
+# over the committed BENCH_r*.json series. "ViT flat for three rounds at
+# 0.354 MFU" is a failing test from here on, not a VERDICT footnote.
+# ---------------------------------------------------------------------------
+
+# which key inside a lane's extra dict carries its achieved MFU
+MFU_KEYS = {
+    "resnet50_onnx": "mfu",
+    "bert_base_onnx": "mfu",
+    "vit_to_gbdt_pipeline": "mfu_vit_only",
+    "flash_attention_32k": "mfu_vs_bf16_peak",
+    "flash_attention_gqa": "mfu_vs_bf16_peak",
+}
+
+# per-lane achieved-MFU floor: set just under the best committed value so
+# the floor catches REGRESSIONS (the stagnation detector below is what
+# pressures flat lanes upward). A lane whose MFU is null (unknown device
+# peak — e.g. a CPU fallback round) is skipped, never guessed.
+MFU_FLOORS = {
+    "resnet50_onnx": 0.40,        # r05: 0.4738
+    "bert_base_onnx": 0.45,       # r05: 0.4938
+    "vit_to_gbdt_pipeline": 0.30,  # r05: 0.3545 (the flat lane)
+    "flash_attention_32k": 0.25,  # r05: 0.2956 (waived-regressed lane)
+    "flash_attention_gqa": 0.25,
+}
+# floors ratchet FORWARD: rounds before this predate the floors (r02's
+# resnet at 0.17 MFU was the starting point, not a regression)
+MFU_FLOOR_FROM_ROUND = 6
+
+STAGNATION_ROUNDS = 3    # trailing window of committed rounds
+STAGNATION_TOL = 0.02    # lane moved < 2% across the window = flat
+STAGNATION_MFU_BAR = 0.45  # flat is only a finding with MFU headroom left
+
+
+def mfu_violations(here=None, floors=None, waivers=None,
+                   from_round=MFU_FLOOR_FROM_ROUND):
+    """Committed rounds >= ``from_round`` whose lane MFU fell below its
+    floor, unless waived as ``(round, "mfu:<lane>")`` in ``BENCH_ACKS.md``.
+    Returns ``[(round, "mfu:<lane>", mfu), ...]``."""
+    import os
+
+    if here is None:
+        here = os.path.dirname(os.path.abspath(__file__))
+    if floors is None:
+        floors = MFU_FLOORS
+    if waivers is None:
+        waivers = load_waivers(os.path.join(here, "BENCH_ACKS.md"))
+    offenders = []
+    for rnd, extra in sorted(_committed_rounds(here).items()):
+        if rnd < from_round:
             continue
-        _, _, extra = got
+        for lane, floor in floors.items():
+            key = MFU_KEYS.get(lane)
+            entry = extra.get(lane)
+            if key is None or not isinstance(entry, dict):
+                continue
+            mfu = entry.get(key)
+            if not isinstance(mfu, (int, float)):
+                continue  # null MFU (unknown peak) is skipped, not judged
+            if mfu < floor and (rnd, f"mfu:{lane}") not in waivers:
+                offenders.append((rnd, f"mfu:{lane}", mfu))
+    return offenders
+
+
+def stagnation_violations(here=None, n_rounds=STAGNATION_ROUNDS,
+                          tol=STAGNATION_TOL, mfu_bar=STAGNATION_MFU_BAR,
+                          waivers=None):
+    """Flat-lane detector: an MFU-tracked lane whose primary metric moved
+    less than ``tol`` across ``n_rounds`` consecutive committed rounds,
+    while its latest achieved MFU sits under ``mfu_bar`` (stagnating WITH
+    headroom — BERT parked at 0.49 MFU is near the practical ceiling and
+    exempt; ViT parked at 0.35 is leaving 40% of the device on the
+    table). Rounds inside the window with no value (an errored lane)
+    count as no-progress; at least two values must exist to judge.
+    Waive as ``(round, "flat:<lane>")``. Returns
+    ``[(round, "flat:<lane>", latest_value), ...]``."""
+    import os
+
+    if here is None:
+        here = os.path.dirname(os.path.abspath(__file__))
+    if waivers is None:
+        waivers = load_waivers(os.path.join(here, "BENCH_ACKS.md"))
+    rounds = _committed_rounds(here)
+    offenders = []
+    for end in sorted(rounds):
+        window = [r for r in range(end - n_rounds + 1, end + 1)
+                  if r in rounds]
+        if len(window) < n_rounds or window[-1] != end:
+            continue  # the full trailing window must be committed
+        for lane, metric in _PRIMARY.items():
+            key = MFU_KEYS.get(lane)
+            if key is None:
+                continue  # ratio/robustness lanes are SUPPOSED to be flat
+            vals = []
+            mfu = None
+            for r in window:
+                entry = rounds[r].get(lane)
+                if isinstance(entry, dict) \
+                        and isinstance(entry.get(metric), (int, float)):
+                    vals.append(entry[metric])
+                    if isinstance(entry.get(key), (int, float)):
+                        mfu = entry[key]  # latest available MFU wins
+            if len(vals) < 2 or not vals[-1]:
+                continue
+            flat = (max(vals) / max(min(vals), 1e-12)) - 1.0 < tol
+            if (flat and mfu is not None and mfu < mfu_bar
+                    and (end, f"flat:{lane}") not in waivers):
+                offenders.append((end, f"flat:{lane}", vals[-1]))
+    return offenders
+
+
+def unwaived_regressions(here=None, threshold=RATCHET_THRESHOLD,
+                         waivers=None):
+    """The one CI gate (tests/test_bench_ratchet.py asserts it empty):
+    scans every committed ``BENCH_r{N}.json`` (armored loader — damaged
+    artifacts recover what they can) for
+
+    - per-lane ``vs_prev_round`` ratios below ``threshold``
+      (``(round, lane, ratio)``),
+    - lane MFU under its :data:`MFU_FLOORS` floor
+      (``(round, "mfu:<lane>", mfu)``), and
+    - flat-with-headroom stagnation (``(round, "flat:<lane>", value)``),
+
+    each without a matching ``BENCH_ACKS.md`` waiver row. Empty means the
+    ratchet holds."""
+    import os
+
+    if here is None:
+        here = os.path.dirname(os.path.abspath(__file__))
+    if waivers is None:
+        waivers = load_waivers(os.path.join(here, "BENCH_ACKS.md"))
+    offenders = []
+    for rnd, extra in sorted(_committed_rounds(here).items()):
         vpr = extra.get("vs_prev_round") or {}
         for config, ratio in (vpr.get("per_config") or {}).items():
             if not isinstance(ratio, (int, float)):
                 continue
             if ratio < threshold and (rnd, config) not in waivers:
                 offenders.append((rnd, config, ratio))
+    offenders.extend(mfu_violations(here=here, waivers=waivers))
+    offenders.extend(stagnation_violations(here=here, waivers=waivers))
     return offenders
 
 

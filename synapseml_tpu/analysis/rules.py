@@ -861,6 +861,130 @@ class AdHocMeshConstruction(Rule):
         return findings
 
 
+_WRONG_UNIT_SUFFIXES: Dict[str, str] = {
+    # non-base-unit spellings -> the base unit Prometheus names use
+    "_ms": "_seconds", "_millis": "_seconds", "_milliseconds": "_seconds",
+    "_micros": "_seconds", "_us": "_seconds", "_nanos": "_seconds",
+    "_ns": "_seconds", "_sec": "_seconds", "_secs": "_seconds",
+    "_mins": "_seconds", "_minutes": "_seconds", "_hours": "_seconds",
+    "_kb": "_bytes", "_mb": "_bytes", "_gb": "_bytes",
+    "_kib": "_bytes", "_mib": "_bytes", "_gib": "_bytes",
+}
+
+# names that scream unbounded cardinality when they reach a label value:
+# request/trace/span ids are unique per event, ports are unique per
+# process incarnation — a label dict keyed by one grows the registry
+# without bound and makes every scrape slower forever
+_UNBOUNDED_LABEL_NAMES = {"rid", "request_id", "trace_id", "span_id",
+                          "uuid", "request_uuid", "port"}
+
+
+@register
+class MetricNameDiscipline(Rule):
+    """SMT014 — metric-name discipline on registry calls.
+
+    Two invariants the whole exposition pipeline leans on:
+
+    - **Unit-suffixed names.** Counters end ``_total`` (the OpenMetrics
+      renderer strips it for family metadata — a counter without it
+      produces spec-invalid OM and a failed scrape); nothing else ends
+      ``_total``; timings/sizes use the base units ``_seconds``/``_bytes``
+      (a ``_ms``/``_kb`` family breaks every recording rule and dashboard
+      that assumes base units). Unitless gauges/histograms (ratios, MFU,
+      batch sizes) are fine.
+    - **Bounded label values.** ``labels(...)`` must never interpolate an
+      unbounded value — a request id, trace id, span id, or port: one
+      series per REQUEST is a memory leak wearing a label dict, and trace
+      ids already have a first-class channel (exemplars). Detection is by
+      value-expression name (``rid`` / ``request_id`` / ``trace_id`` /
+      ``span_id`` / ``uuid`` / ``port``, bare or as an attribute or inside
+      an f-string) and by direct ``uuid.*()`` calls. Bounded composite
+      labels (``server_label = host:port`` retired on ``close()``) pass —
+      the rule flags the raw signals, not every string containing digits.
+    """
+
+    code = "SMT014"
+    name = "metric-name-discipline"
+    rationale = ("non-base-unit or suffix-confused metric names break the "
+                 "exposition contract; unbounded label values grow the "
+                 "registry per request instead of per component")
+
+    _CTORS = ("counter", "gauge", "histogram")
+
+    def _name_findings(self, module: Module, node: ast.Call,
+                       kind: str) -> Iterable[Finding]:
+        if not (node.args and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)):
+            return  # dynamic name: the runtime schema check owns it
+        mname = node.args[0].value
+        if kind == "counter" and not mname.endswith("_total"):
+            yield self.finding(
+                module, node.args[0],
+                f"counter {mname!r} must end in '_total' (the OpenMetrics "
+                f"renderer names counter families by stripping it)")
+        elif kind != "counter" and mname.endswith("_total"):
+            yield self.finding(
+                module, node.args[0],
+                f"{kind} {mname!r} ends in '_total', the counter "
+                f"convention; rename or make it a counter")
+        for suf, base in _WRONG_UNIT_SUFFIXES.items():
+            if mname.endswith(suf):
+                yield self.finding(
+                    module, node.args[0],
+                    f"metric {mname!r} uses non-base unit {suf!r}; record "
+                    f"base units ({base!r}) and let the dashboard scale")
+                break
+
+    @staticmethod
+    def _unbounded_expr(expr: ast.AST) -> Optional[str]:
+        """The offending name when ``expr`` is an unbounded-cardinality
+        value (bare name, attribute, uuid call, or an f-string
+        interpolating one); None when it looks bounded."""
+        if isinstance(expr, ast.Name) and expr.id in _UNBOUNDED_LABEL_NAMES:
+            return expr.id
+        if isinstance(expr, ast.Attribute):
+            if expr.attr in _UNBOUNDED_LABEL_NAMES:
+                return expr.attr
+            if isinstance(expr.value, (ast.Call, ast.Attribute)):
+                # uuid.uuid4().hex and friends: the id hides one hop down
+                return MetricNameDiscipline._unbounded_expr(expr.value)
+        if isinstance(expr, ast.Call):
+            dn = dotted_name(expr.func)
+            if dn and (dn.startswith("uuid.")
+                       or dn.split(".")[-1] in ("uuid4", "uuid1")):
+                return dn
+        if isinstance(expr, ast.JoinedStr):
+            for v in expr.values:
+                if isinstance(v, ast.FormattedValue):
+                    got = MetricNameDiscipline._unbounded_expr(v.value)
+                    if got is not None:
+                        return got
+        return None
+
+    def check(self, module: Module) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        for node in ast.walk(module.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)):
+                continue
+            attr = node.func.attr
+            if attr in self._CTORS:
+                findings.extend(self._name_findings(module, node, attr))
+            elif attr == "labels":
+                values = list(node.args) + [kw.value for kw in node.keywords]
+                for v in values:
+                    bad = self._unbounded_expr(v)
+                    if bad is not None:
+                        findings.append(self.finding(
+                            module, v,
+                            f"unbounded value {bad!r} interpolated into a "
+                            f"label: one series per request/trace/port "
+                            f"incarnation grows the registry without "
+                            f"bound — use a bounded label (trace ids "
+                            f"belong in exemplars)"))
+        return findings
+
+
 # cache of "does this file use jax" verdicts, keyed by absolute path
 _JAX_USING_CACHE: Dict[str, bool] = {}
 

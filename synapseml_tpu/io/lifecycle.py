@@ -396,12 +396,16 @@ def wait_until(pred: Callable[[], bool], timeout_s: float,
 @dataclasses.dataclass
 class FleetObservation:
     """One control-loop sample: the windowed fleet p99 (None while the
-    window is empty), the worst worker queue-wait estimate, and the live
-    worker count."""
+    window is empty), the worst worker queue-wait estimate, the live
+    worker count, and whether the fleet SLO monitor's fast window pair is
+    burning (``observability/slo.py`` — shed-heavy overload burns budget
+    without ever showing up in the latency histogram, so p99 alone would
+    sleep through it)."""
 
     p99_s: Optional[float]
     queue_wait_s: float
     n_workers: int
+    burn: bool = False
 
 
 class Autoscaler:
@@ -459,11 +463,16 @@ class Autoscaler:
             _logger.exception("autoscaler observation failed; skipping tick")
             return None
         slo_s = cfg.slo_p99_ms / 1e3
+        # an active fast-window burn (observability/slo.py) is a breach in
+        # its own right: a fleet shedding half its traffic can have a
+        # spotless p99 — the histogram only sees requests that were served
         breach = ((obs.p99_s is not None and obs.p99_s > slo_s)
-                  or obs.queue_wait_s > cfg.queue_wait_slo_s)
+                  or obs.queue_wait_s > cfg.queue_wait_slo_s
+                  or obs.burn)
         idle = ((obs.p99_s is None or obs.p99_s < slo_s
                  * cfg.idle_p99_fraction)
-                and obs.queue_wait_s < 0.1 * cfg.queue_wait_slo_s)
+                and obs.queue_wait_s < 0.1 * cfg.queue_wait_slo_s
+                and not obs.burn)
         self._breach_streak = self._breach_streak + 1 if breach else 0
         self._idle_streak = self._idle_streak + 1 if idle else 0
 
@@ -493,6 +502,7 @@ class Autoscaler:
                 "queue_wait_s": round(obs.queue_wait_s, 4),
                 "n_workers": obs.n_workers,
                 "slo_p99_ms": cfg.slo_p99_ms,
+                "burn": obs.burn,
             }
             self.decisions.append(decision)
             self._m_decisions.labels(direction).inc()
@@ -541,16 +551,24 @@ class ProcessFleetAdapter:
     bad minute). Queue-wait is the worst worker's ``/healthz`` estimate.
     """
 
-    def __init__(self, fleet, cfg: Optional[LifecycleConfig] = None):
+    def __init__(self, fleet, cfg: Optional[LifecycleConfig] = None,
+                 slo_monitor=None):
+        from ..observability import SLOConfig, SLOMonitor
+
         self.fleet = fleet
         self.cfg = cfg or LifecycleConfig.from_env()
         self._prev_counts: Optional[List[int]] = None
+        # the fleet SLO burn monitor (observability/slo.py): sampled with
+        # the SAME merged snapshot every tick already fetches, so the
+        # autoscaler's breach signal includes fast-window budget burn
+        self.slo = slo_monitor if slo_monitor is not None \
+            else SLOMonitor(SLOConfig.from_env(), name="autoscaler")
 
-    def _bucket_counts(self) -> Tuple[Optional[list], List[int]]:
+    def _bucket_counts(self) -> Tuple[Optional[list], List[int], dict]:
         snap = self.fleet.metrics_snapshot()
         fam = (snap.get("families") or {}).get("smt_serving_latency_seconds")
         if fam is None:
-            return None, []
+            return None, [], snap
         workers = {a[len("http://"):] for a in self.fleet.live_addresses()}
         labelnames = list(fam.get("labelnames") or [])
         counts = [0] * (len(fam.get("buckets") or []) + 1)
@@ -561,10 +579,14 @@ class ProcessFleetAdapter:
             for i, c in enumerate(s["counts"]):
                 if i < len(counts):
                     counts[i] += c
-        return fam.get("buckets") or [], counts
+        return fam.get("buckets") or [], counts, snap
 
     def observe(self) -> FleetObservation:
-        buckets, counts = self._bucket_counts()
+        buckets, counts, snap = self._bucket_counts()
+        try:
+            self.slo.observe(snap)
+        except Exception:
+            _logger.debug("fleet SLO sample failed", exc_info=True)
         p99 = None
         if buckets is not None:
             prev = self._prev_counts
@@ -585,7 +607,8 @@ class ProcessFleetAdapter:
                 queue_wait = max(queue_wait,
                                  float(hz.get("queue_wait_s") or 0.0))
         return FleetObservation(p99_s=p99, queue_wait_s=queue_wait,
-                                n_workers=len(addrs))
+                                n_workers=len(addrs),
+                                burn=self.slo.fast_burn_active())
 
     def scale_up(self) -> bool:
         return self.fleet.add_worker() is not None

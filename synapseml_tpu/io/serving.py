@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import contextlib
 import json
+import os
 import threading
 import time
 import uuid
@@ -32,8 +33,8 @@ from ..core.telemetry import get_logger
 from ..observability import CONTENT_TYPE as _PROM_CONTENT_TYPE
 from ..observability import OPENMETRICS_CONTENT_TYPE as \
     _OPENMETRICS_CONTENT_TYPE
-from ..observability import (get_registry, render_openmetrics,
-                             render_prometheus, tracing)
+from ..observability import (SLOConfig, SLOMonitor, get_registry,
+                             render_openmetrics, render_prometheus, tracing)
 from ..runtime.shared import shared_singleton
 from . import faultinject
 from .http_schema import HTTPRequestData, HTTPResponseData
@@ -41,7 +42,9 @@ from .resilience import parse_deadline, remaining_s
 
 __all__ = ["ServingServer", "MicroBatchServingEngine", "serve",
            "serve_metrics_exposition", "serve_traces_exposition",
-           "serve_timeline_exposition", "join_or_leak", "drain_engine",
+           "serve_timeline_exposition", "serve_slo_exposition",
+           "join_or_leak", "drain_engine", "choose_batch_size",
+           "attribute_batch_cost", "microbatch_target_s",
            "prewarm_pipeline", "request_to_string", "string_to_response"]
 
 _logger = get_logger("io.serving")
@@ -95,6 +98,13 @@ class ServingServer:
         # by the single engine thread; read lock-free in handler threads
         # (a stale float makes the estimate slightly stale, never wrong).
         self._svc_ewma_s: Optional[float] = None
+        # per-request device-cost model (engines report each batch's
+        # profiled FLOPs via note_batch_cost): an EWMA of FLOPs/request
+        # and FLOPs/entity-byte. Written only by the engine thread, read
+        # lock-free in handlers — the cost-aware shedder uses it to
+        # displace the most EXPENSIVE queued work first under overload.
+        self._cost_per_req: Optional[float] = None
+        self._cost_per_byte: Optional[float] = None
         # fleet-lifecycle wiring (io/lifecycle.py): the engine attaches its
         # generation-tagged pipeline slot here so /healthz can report
         # {state, generation, inflight} and /control/{drain,resume,swap}
@@ -142,6 +152,12 @@ class ServingServer:
                     # Perfetto); same server-answers rule as /traces
                     serve_timeline_exposition(self)
                     return
+                if method == "GET" and op_path == "/slo":
+                    # burn-rate / error-budget state (observability/slo.py);
+                    # server-answered like /metrics — reading the budget of
+                    # a wedged engine is exactly when you need it
+                    outer._serve_slo(self)
+                    return
                 if method == "GET" and op_path == "/healthz":
                     # the dedicated cheap liveness/lifecycle endpoint: the
                     # router's re-admission prober and the autoscaler read
@@ -172,38 +188,9 @@ class ServingServer:
                     except OSError:
                         pass
                     return
-                # deadline-aware load shedding AT THE DOOR: work that
-                # cannot possibly answer in time must never occupy a batch
-                # slot. Requests without the deadline header (legacy
-                # clients talking straight to a worker) keep the old
-                # behavior; the routing front door always stamps one.
-                deadline = parse_deadline(self.headers)
-                if deadline is not None:
-                    rem = remaining_s(deadline)
-                    if rem <= 0:
-                        outer._shed("expired", count_received=True)
-                        try:
-                            self.send_error(504, "deadline already expired")
-                        except OSError:
-                            pass
-                        return
-                    est = outer.estimated_queue_wait_s()
-                    if est > rem:
-                        # the queue ahead of this request already costs
-                        # more than its remaining deadline: answer 429 now
-                        # with honest backpressure instead of a doomed 504
-                        # at the deadline — bounded p99 under overload
-                        outer._shed("overload", count_received=True)
-                        try:
-                            self.send_response(429)
-                            self.send_header(
-                                "Retry-After",
-                                str(max(1, int(est - rem) + 1)))
-                            self.send_header("Content-Length", "0")
-                            self.end_headers()
-                        except OSError:
-                            pass
-                        return
+                # schema admission BEFORE displacement: a request that is
+                # going to be 400'd anyway must never evict valid queued
+                # work via the cost-displacement path below
                 if method == "POST" and outer.admission_schema is not None:
                     errs = admission_errors(outer.admission_schema, body)
                     if errs:
@@ -227,6 +214,47 @@ class ServingServer:
                         except OSError:
                             pass  # client went away
                         return
+                # deadline-aware load shedding AT THE DOOR: work that
+                # cannot possibly answer in time must never occupy a batch
+                # slot. Requests without the deadline header (legacy
+                # clients talking straight to a worker) keep the old
+                # behavior; the routing front door always stamps one.
+                deadline = parse_deadline(self.headers)
+                if deadline is not None:
+                    rem = remaining_s(deadline)
+                    if rem <= 0:
+                        outer._shed("expired", count_received=True)
+                        try:
+                            self.send_error(504, "deadline already expired")
+                        except OSError:
+                            pass
+                        return
+                    est = outer.estimated_queue_wait_s()
+                    # posture escalation (observability/slo.py): with the
+                    # error budget near exhaustion the margin drops below
+                    # 1.0 and shedding starts BEFORE the queue estimate
+                    # fully swallows the deadline
+                    allowed = rem * outer.slo.shed_margin()
+                    if est > allowed:
+                        # the queue ahead of this request already costs
+                        # more than its remaining deadline: before 429'ing
+                        # the newcomer, try displacing strictly MORE
+                        # EXPENSIVE queued work (per-stage cost EWMA) —
+                        # under 429-pressure the costly requests shed
+                        # first, not whoever arrived last
+                        if not outer._admit_by_displacement(
+                                body, est, allowed):
+                            outer._shed("overload", count_received=True)
+                            try:
+                                self.send_response(429)
+                                self.send_header(
+                                    "Retry-After",
+                                    str(max(1, int(est - rem) + 1)))
+                                self.send_header("Content-Length", "0")
+                                self.end_headers()
+                            except OSError:
+                                pass
+                            return
                 req = HTTPRequestData(
                     url=self.path, method=method,
                     headers=dict(self.headers.items()), entity=body)
@@ -333,6 +361,21 @@ class ServingServer:
         # under the GIL; only the latency histogram observes per reply.
         self.server_label = f"{self.host}:{self.port}"
         reg = self._reg = get_registry()
+        # SLO burn-rate monitor over THIS server's series (GET /slo; the
+        # deadline shedder consults its posture): fed passively once per
+        # rate-limit gap from the engine's per-batch hook, and on every
+        # /slo read — the worker reacts to budget state without waiting
+        # for anyone to scrape it
+        self.slo = SLOMonitor(SLOConfig.from_env(),
+                              label_filter={"server": {self.server_label}},
+                              name=self.server_label)
+        # ledger baseline: deltas (and therefore the budget) count from
+        # server start — this server's labeled series don't exist yet, so
+        # the baseline reads zero even on a long-lived shared registry
+        try:
+            self.slo.observe(reg.snapshot(), force=True)
+        except Exception:
+            _logger.debug("SLO baseline sample failed", exc_info=True)
         self._m_requests = reg.counter(
             "smt_serving_requests_total", "HTTP requests received",
             ("server",)).labels(self.server_label)
@@ -388,12 +431,103 @@ class ServingServer:
 
     def note_batch(self, n_requests: int, seconds: float) -> None:
         """Engines report each processed batch here; feeds the per-request
-        service-time EWMA behind ``estimated_queue_wait_s``."""
+        service-time EWMA behind ``estimated_queue_wait_s`` and (rate-
+        limited) the SLO monitor's sample ring."""
         if n_requests <= 0 or seconds < 0:
             return
         per = seconds / n_requests
         cur = self._svc_ewma_s
         self._svc_ewma_s = per if cur is None else 0.8 * cur + 0.2 * per
+        try:
+            # deferred-snapshot form: a busy engine pays one registry
+            # snapshot per sample gap, not one per batch
+            self.slo.maybe_observe(self._reg.snapshot)
+        except Exception:
+            _logger.debug("SLO sample failed", exc_info=True)
+
+    def note_batch_cost(self, flops: float, n_requests: int,
+                        total_entity_bytes: int) -> None:
+        """Engines report each batch's profiled device cost
+        (``observability.profiling.cost_snapshot`` delta). Maintains the
+        FLOPs-per-request and FLOPs-per-entity-byte EWMAs behind
+        ``estimated_request_cost`` — the cost-aware shedder's model."""
+        if flops <= 0 or n_requests <= 0:
+            return
+        per = flops / n_requests
+        cur = self._cost_per_req
+        self._cost_per_req = per if cur is None else 0.8 * cur + 0.2 * per
+        if total_entity_bytes > 0:
+            pb = flops / total_entity_bytes
+            cur = self._cost_per_byte
+            self._cost_per_byte = pb if cur is None \
+                else 0.8 * cur + 0.2 * pb
+
+    def estimated_request_cost(self, n_entity_bytes: int) -> float:
+        """Estimated device FLOPs for a request with this payload size:
+        the per-byte EWMA when the model has one (payload size is the one
+        admission-time signal that differentiates requests), else the flat
+        per-request EWMA, else 0.0 — on ignorance every request costs the
+        same and the shedder keeps its old arrival-order behavior."""
+        pb = self._cost_per_byte
+        if pb is not None:
+            return pb * n_entity_bytes
+        return self._cost_per_req or 0.0
+
+    def _admit_by_displacement(self, body: Optional[bytes], est: float,
+                               allowed_s: float) -> bool:
+        """Cost-aware overload admission: try to admit the arriving
+        request by shedding strictly MORE EXPENSIVE queued requests
+        (429, ``reason="cost"``) until the queue estimate fits inside
+        ``allowed_s``. Only deadline-carrying queued work is displaceable
+        (legacy no-deadline requests keep their never-shed contract).
+        False = displacement cannot free enough: the caller sheds the
+        newcomer exactly as before the cost model existed."""
+        svc = self._svc_ewma_s
+        if svc is None or svc <= 0:
+            return False
+        need = est - allowed_s
+        k = int(need / svc) + 1  # queued requests to displace
+        arriving = self.estimated_request_cost(len(body or b""))
+        victims: List[_Pending] = []
+        with self._lock:
+            cand = []
+            for rid in self._queue:
+                slot = self._pending.get(rid)
+                if slot is None or slot.deadline is None:
+                    continue
+                cost = self.estimated_request_cost(
+                    len(slot.request.entity or b""))
+                if cost > arriving:
+                    cand.append((cost, rid))
+            if len(cand) < k:
+                return False
+            cand.sort(reverse=True)  # most expensive first
+            for _cost, rid in cand[:k]:
+                victims.append(self._pending.pop(rid))
+                self._queue.remove(rid)
+        for slot in victims:
+            self._shed("cost")
+            self._finish(slot, HTTPResponseData(
+                429, "shed for cheaper work under overload",
+                {"Retry-After": "1"}), shed=True)
+        return True
+
+    def _slots_for(self, rids) -> Dict[str, "_Pending"]:
+        """rid -> still-pending slot (cost attribution joins batch results
+        back to their request spans)."""
+        with self._lock:
+            return {rid: self._pending[rid] for rid in rids
+                    if rid in self._pending}
+
+    def _serve_slo(self, handler) -> None:
+        """``GET /slo``: sample the registry NOW (force — a human asking
+        for the budget deserves a fresh number) and serve the monitor's
+        status as JSON."""
+        try:
+            self.slo.observe(self._reg.snapshot(), force=True)
+        except Exception:
+            _logger.debug("SLO sample failed during /slo", exc_info=True)
+        serve_slo_exposition(handler, self.slo.status())
 
     def estimated_queue_wait_s(self) -> float:
         """Queue depth × observed per-request service time (from the
@@ -517,7 +651,7 @@ class ServingServer:
         for slot in expired:
             self._shed("expired")
             self._finish(slot, HTTPResponseData(
-                504, "deadline expired in queue"))
+                504, "deadline expired in queue"), shed=True)
         return out
 
     def _trace_slots(self, rids) -> List[_Pending]:
@@ -535,13 +669,19 @@ class ServingServer:
             return
         self._finish(slot, response)
 
-    def _finish(self, slot: _Pending, response: HTTPResponseData) -> None:
+    def _finish(self, slot: _Pending, response: HTTPResponseData,
+                shed: bool = False) -> None:
         """Finalize an already-claimed slot (the caller popped it from
-        ``_pending``): release the handler thread, record latency + trace."""
+        ``_pending``): release the handler thread, record latency + trace.
+        ``shed=True`` (queue-expiry / cost displacement) skips the latency
+        recording: the shed is already counted in
+        ``smt_serving_shed_total``, and the SLI (``observability/slo.py``)
+        counts every shed as one bad event on the invariant that sheds
+        NEVER reach the latency histogram — a second, fast "reply" sample
+        would double-count the request in ``total`` and deflate burn
+        rates exactly during a shed-heavy overload."""
         slot.response = response
         slot.event.set()
-        lat = time.perf_counter() - slot.t_enqueue
-        self._latencies.append(lat)
         exemplar = None
         tr = slot.trace
         if tr is not None:
@@ -555,6 +695,10 @@ class ServingServer:
             # and a dangling exemplar is worse than none
             if tr.tracer.is_retained(tr.trace_id):
                 exemplar = tr.trace_id
+        if shed:
+            return
+        lat = time.perf_counter() - slot.t_enqueue
+        self._latencies.append(lat)
         # same sample into the MERGEABLE histogram: fleet quantiles come
         # from these buckets combined across workers (merge.py). The
         # exemplar is passed explicitly — respond() runs after the
@@ -598,7 +742,7 @@ class ServingServer:
         for series in (self._m_requests, self._m_responses, self._m_latency,
                        self._m_admission_rejects):
             series.remove()
-        for reason in ("expired", "overload", "shutdown"):
+        for reason in ("expired", "overload", "cost", "shutdown"):
             self._m_shed.remove(self.server_label, reason)
 
 
@@ -677,9 +821,10 @@ def resolve_admission_schema(pipeline, admission_schema):
 
 def engine_metrics(reg, server_label: str, engine: str):
     """The per-engine metric series shared by the micro-batch and continuous
-    engines: (batches counter, batch-size histogram, pipeline-error counter),
-    labeled (server, engine). One definition so the two engines cannot fork
-    the family schema."""
+    engines: (batches counter, batch-size histogram, pipeline-error counter,
+    request-FLOPs histogram, request-HBM-bytes histogram, chosen-batch-size
+    gauge), labeled (server, engine). One definition so the two engines
+    cannot fork the family schema."""
     batches = reg.counter(
         "smt_serving_batches_total", "pipeline batches processed",
         ("server", "engine")).labels(server_label, engine)
@@ -689,7 +834,114 @@ def engine_metrics(reg, server_label: str, engine: str):
     errors = reg.counter(
         "smt_serving_pipeline_errors_total", "batches answered 500",
         ("server", "engine")).labels(server_label, engine)
-    return batches, batch_size, errors
+    # per-request device-cost attribution (observability ISSUE 15): the
+    # profiled FLOPs/bytes of each batch split over its fused requests,
+    # with each sample tagged by ITS request's trace-id exemplar
+    req_flops = reg.histogram(
+        "smt_request_flops",
+        "profiled device FLOPs attributed per request "
+        "(batch cost / fused requests)",
+        ("server", "engine")).labels(server_label, engine)
+    req_bytes = reg.histogram(
+        "smt_request_hbm_bytes",
+        "profiled bytes accessed attributed per request",
+        ("server", "engine")).labels(server_label, engine)
+    chosen = reg.gauge(
+        "smt_serving_chosen_batch_size",
+        "adaptive micro-batch size chosen for the next drain "
+        "(queue depth x service-time EWMA vs the batch latency target)",
+        ("server", "engine")).labels(server_label, engine)
+    return batches, batch_size, errors, req_flops, req_bytes, chosen
+
+
+def microbatch_target_s() -> float:
+    """The adaptive batch-sizing latency target (``SMT_MICROBATCH_TARGET_MS``,
+    default 250 ms; <= 0 disables adaptive sizing)."""
+    try:
+        return float(os.environ.get("SMT_MICROBATCH_TARGET_MS", 250.0)) / 1e3
+    except (TypeError, ValueError):
+        return 0.25
+
+
+def choose_batch_size(server: "ServingServer", max_batch: int,
+                      target_s: float) -> int:
+    """Pick the next drain's batch bound from the live signals the server
+    already tracks (ROADMAP item 4's last leftover).
+
+    Latency mode: ``n = target_s / svc_ewma`` bounded to [1, max_batch] —
+    a batch should take about the target, so one slow batch cannot tax
+    every fused request with multi-target latency. Backlog mode: when the
+    queue ALONE already costs more than 2x the target (depth x svc), the
+    target is unmeetable and throughput wins — drain at ``max_batch`` so
+    fusion amortizes the overhead. Cold signals (no EWMA yet) keep the
+    old fixed ``max_batch`` behavior."""
+    svc = server._svc_ewma_s
+    if target_s <= 0 or svc is None or svc <= 0:
+        return max_batch
+    depth = len(server._queue)  # lock-free len read: staleness is fine
+    if depth * svc > 2.0 * target_s:
+        return max_batch
+    return max(1, min(int(target_s / svc) or 1, max_batch))
+
+
+def attribute_batch_cost(server: "ServingServer", rids, reqs, cost0,
+                         flops_hist, bytes_hist) -> None:
+    """Attribute one batch's profiled device cost to its requests.
+
+    ``cost0`` is the engine's ``profiling.cost_snapshot()`` read from
+    before ``pipeline.transform``; the delta is the batch's cost. Each
+    fused request gets an equal share observed into
+    ``smt_request_flops`` / ``smt_request_hbm_bytes`` (exemplar = that
+    request's own trace id) and stamped onto its request span, so the
+    cost is visible in ``/traces`` and ``tools/trace_dump.py``; the
+    batch totals land on the active pipeline span. The per-batch totals
+    also feed the server's cost EWMAs (``note_batch_cost``) — the model
+    behind expensive-first shedding. Must run INSIDE the batch's traced
+    context and in the engine thread (the cost accumulator is
+    thread-local). Never raises: accounting must never turn a
+    successfully-transformed batch into 500s (same invariant as the
+    span profiler hook)."""
+    try:
+        _attribute_batch_cost(server, rids, reqs, cost0,
+                              flops_hist, bytes_hist)
+    except Exception:
+        _logger.exception("per-request cost attribution failed")
+
+
+def _attribute_batch_cost(server: "ServingServer", rids, reqs, cost0,
+                          flops_hist, bytes_hist) -> None:
+    from ..observability.profiling import cost_snapshot
+
+    f1, b1 = cost_snapshot()
+    dflops, dbytes = f1 - cost0[0], b1 - cost0[1]
+    n = len(rids)
+    if n <= 0:
+        return
+    total_bytes = sum(len(r.entity or b"") for r in reqs)
+    server.note_batch_cost(dflops, n, total_bytes)
+    if dflops <= 0 and dbytes <= 0:
+        return  # nothing profiled ran: no zero-noise series
+    share_f, share_b = dflops / n, dbytes / n
+    sp = tracing.current_span()
+    if sp is not None:  # the pipeline span carries the batch totals
+        sp.set_attribute("flops", dflops)
+        if dbytes > 0:
+            sp.set_attribute("hbm_bytes", dbytes)
+    slots = server._slots_for(rids)
+    for rid in rids:
+        slot = slots.get(rid)
+        tr = slot.trace if slot is not None else None
+        tid = tr.trace_id if tr is not None else None
+        # ambient=False: a request without its own trace gets NO exemplar
+        # — the fallback would stamp the batch leader's trace id on it
+        if dflops > 0:
+            flops_hist.observe(share_f, exemplar=tid, ambient=False)
+        if dbytes > 0:
+            bytes_hist.observe(share_b, exemplar=tid, ambient=False)
+        if tr is not None:
+            tr.set_attribute("flops", share_f)
+            if dbytes > 0:
+                tr.set_attribute("hbm_bytes", share_b)
 
 
 def serve_metrics_exposition(handler, snapshot: Optional[dict] = None) -> None:
@@ -734,6 +986,24 @@ def serve_traces_exposition(handler, payload: Optional[dict] = None) -> None:
     if payload is None:
         payload = tracing.get_tracer().snapshot()
     body = json.dumps(payload).encode()
+    try:
+        handler.send_response(200)
+        handler.send_header("Content-Type", "application/json")
+        handler.send_header("Content-Length", str(len(body)))
+        handler.end_headers()
+        handler.wfile.write(body)
+    except OSError:
+        pass  # reader went away
+
+
+def serve_slo_exposition(handler, status: dict) -> None:
+    """Answer a ``GET /slo`` on ``handler``: the burn-rate monitor's
+    :meth:`~synapseml_tpu.observability.slo.SLOMonitor.status` dict as
+    JSON. Callers sample their monitor first (a worker forces a fresh
+    registry sample; the routing front door samples its MERGED fleet
+    snapshot) — this helper only renders. ``tools/slo_report.py`` renders
+    the human view client-side."""
+    body = json.dumps(status).encode()
     try:
         handler.send_response(200)
         handler.send_header("Content-Type", "application/json")
@@ -853,8 +1123,10 @@ class MicroBatchServingEngine:
         # the previous batch transforms
         self._work = threading.Event()
         server._on_enqueue = self._work.set
+        self._batch_target_s = microbatch_target_s()
         self._m_reg = get_registry()
-        self._m_batches, self._m_batch_size, self._m_pipeline_errors = \
+        (self._m_batches, self._m_batch_size, self._m_pipeline_errors,
+         self._m_req_flops, self._m_req_bytes, self._m_chosen) = \
             engine_metrics(self._m_reg, server.server_label, "microbatch")
         self._m_reg.register_collector(self._collect_metrics)
 
@@ -876,12 +1148,20 @@ class MicroBatchServingEngine:
         return self
 
     def _run(self):
+        from ..observability.profiling import cost_snapshot
+
         while not self._stop.is_set():
-            batch = self.server.get_requests(self.max_batch)
+            # adaptive micro-batch sizing from the live queue-depth and
+            # service-EWMA signals (bounded by max_batch); the chosen
+            # bound is a scrapeable gauge
+            limit = choose_batch_size(self.server, self.max_batch,
+                                      self._batch_target_s)
+            batch = self.server.get_requests(limit)
             if not batch:
                 self._work.wait(timeout=self.interval)
                 self._work.clear()
                 continue
+            self._m_chosen.set(limit)
             ids = [rid for rid, _ in batch]
             reqs = np.empty(len(batch), dtype=object)
             reqs[:] = [r for _, r in batch]
@@ -889,6 +1169,7 @@ class MicroBatchServingEngine:
             # one slot read per batch: the atomic hot-swap flip point
             pipeline, _generation = self.lifecycle.current()
             t0 = time.perf_counter()
+            c0 = cost_snapshot()
             try:
                 with traced_batch(self.server, ids, "microbatch"):
                     out = pipeline.transform(table)
@@ -897,6 +1178,11 @@ class MicroBatchServingEngine:
                     # observed INSIDE the batch trace so the bucket gets
                     # the leader request's exemplar
                     self._m_batch_size.observe(len(batch))
+                    # per-request device-cost attribution (same trace
+                    # context: the batch totals land on the pipeline span)
+                    attribute_batch_cost(self.server, ids, reqs, c0,
+                                         self._m_req_flops,
+                                         self._m_req_bytes)
             except Exception as e:  # reply 500s rather than hanging clients
                 _logger.exception("serving pipeline failed")
                 for rid in ids:
@@ -935,7 +1221,8 @@ class MicroBatchServingEngine:
         self.server.close()
         self._m_reg.unregister_collector(self._collect_metrics)
         for series in (self._m_batches, self._m_batch_size,
-                       self._m_pipeline_errors):
+                       self._m_pipeline_errors, self._m_req_flops,
+                       self._m_req_bytes, self._m_chosen):
             series.remove()
         if self._error is not None:
             _logger.warning("serving engine saw pipeline errors; last: %s", self._error)

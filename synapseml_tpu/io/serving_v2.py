@@ -41,8 +41,9 @@ import numpy as np
 
 from ..core import Table, Transformer
 from ..core.telemetry import get_logger
-from ..observability import (get_registry, histogram_quantile,
-                             merge_snapshots, merge_traces, tracing)
+from ..observability import (SLOConfig, SLOMonitor, get_registry,
+                             histogram_quantile, merge_snapshots,
+                             merge_traces, tracing)
 from . import faultinject
 from .http_schema import HTTPResponseData
 from .lifecycle import (LifecycleConfig, LoadAwareBalancer, WorkerLifecycle,
@@ -52,10 +53,12 @@ from .resilience import (BreakerBoard, FleetHealth, HEALTHY, HealthProber,
                          HedgePolicy, ResilienceConfig, RetryBudget,
                          WORKER_STATES, inject_deadline, parse_deadline,
                          remaining_s)
-from .serving import (MicroBatchServingEngine, ServingServer, drain_engine,
-                      engine_metrics, join_or_leak, prewarm_pipeline,
-                      resolve_admission_schema, respond_batch,
-                      serve_metrics_exposition, serve_timeline_exposition,
+from .serving import (MicroBatchServingEngine, ServingServer,
+                      attribute_batch_cost, choose_batch_size, drain_engine,
+                      engine_metrics, join_or_leak, microbatch_target_s,
+                      prewarm_pipeline, resolve_admission_schema,
+                      respond_batch, serve_metrics_exposition,
+                      serve_slo_exposition, serve_timeline_exposition,
                       serve_traces_exposition, traced_batch)
 
 __all__ = ["ContinuousServingEngine", "DistributedServingEngine",
@@ -94,8 +97,10 @@ class ContinuousServingEngine:
         self.requests_processed = 0
         # push hook: request arrival wakes the dispatcher immediately
         server._on_enqueue = self._work.set
+        self._batch_target_s = microbatch_target_s()
         self._m_reg = get_registry()
-        self._m_batches, self._m_batch_size, self._m_pipeline_errors = \
+        (self._m_batches, self._m_batch_size, self._m_pipeline_errors,
+         self._m_req_flops, self._m_req_bytes, self._m_chosen) = \
             engine_metrics(self._m_reg, server.server_label, "continuous")
         self._m_reg.register_collector(self._collect_metrics)
         self._thread = threading.Thread(target=self._run,
@@ -123,12 +128,19 @@ class ContinuousServingEngine:
                 return
             self._work.clear()
             while True:  # drain everything that arrived while transforming
-                batch = self.server.get_requests(self.max_batch)
+                # adaptive batch bound from the live queue-depth /
+                # service-EWMA signals (bounded by max_batch)
+                limit = choose_batch_size(self.server, self.max_batch,
+                                          self._batch_target_s)
+                batch = self.server.get_requests(limit)
                 if not batch:
                     break
+                self._m_chosen.set(limit)
                 self._process(batch)
 
     def _process(self, batch):
+        from ..observability.profiling import cost_snapshot
+
         ids = [rid for rid, _ in batch]
         reqs = np.empty(len(batch), dtype=object)
         reqs[:] = [r for _, r in batch]
@@ -136,6 +148,7 @@ class ContinuousServingEngine:
         # one slot read per batch: the atomic hot-swap flip point
         pipeline, _generation = self.lifecycle.current()
         t0 = time.perf_counter()
+        c0 = cost_snapshot()
         try:
             with traced_batch(self.server, ids, "continuous"):
                 out = pipeline.transform(table)
@@ -143,6 +156,10 @@ class ContinuousServingEngine:
                 # inside the batch trace: the bucket gets the leader
                 # request's exemplar
                 self._m_batch_size.observe(len(batch))
+                # per-request device-cost attribution (inside the trace:
+                # the batch totals land on the pipeline span)
+                attribute_batch_cost(self.server, ids, reqs, c0,
+                                     self._m_req_flops, self._m_req_bytes)
         except Exception as e:
             _logger.exception("continuous serving pipeline failed")
             for rid in ids:
@@ -185,7 +202,8 @@ class ContinuousServingEngine:
         self.server.close()
         self._m_reg.unregister_collector(self._collect_metrics)
         for series in (self._m_batches, self._m_batch_size,
-                       self._m_pipeline_errors):
+                       self._m_pipeline_errors, self._m_req_flops,
+                       self._m_req_bytes, self._m_chosen):
             series.remove()
 
 
@@ -263,6 +281,7 @@ class RoutingServer:
         self.retries_denied = 0
         self.hedges_sent = 0
         self.hedge_wins = 0
+        self.hedges_suppressed = 0
         self.deadline_rejected = 0
         self._lock = threading.Lock()
         self._rr = count()
@@ -299,6 +318,11 @@ class RoutingServer:
                     # spans carry their recording process's pid, so the
                     # router and every worker render as separate tracks
                     serve_timeline_exposition(self, outer.fleet_traces())
+                    return
+                if method == "GET" and op_path == "/slo":
+                    # the FLEET burn-rate/budget view: sampled from the
+                    # merged worker snapshots, exactly like /metrics
+                    outer._serve_slo(self)
                     return
                 if outer._closing:
                     # drain-then-stop: the listener stays up while
@@ -461,6 +485,17 @@ class RoutingServer:
             "smt_routing_hedge_wins_total",
             "hedged requests won by the hedge attempt",
             ("server",)).labels(label)
+        self._m_hedges_suppressed = reg.counter(
+            "smt_routing_hedges_suppressed_total",
+            "hedges withheld by the defensive SLO posture "
+            "(hedging amplifies offered load exactly when the error "
+            "budget is burning)",
+            ("server",)).labels(label)
+        self._m_slo_posture = reg.gauge(
+            "smt_slo_defensive_posture",
+            "1 while the fleet SLO monitor is in the defensive posture "
+            "(budget near exhaustion or fast-window burn active)",
+            ("server",), merge="max").labels(label)
         self._m_deadline_rejected = reg.counter(
             "smt_routing_deadline_rejected_total",
             "requests 504'd at the door for an already-expired deadline",
@@ -486,6 +521,16 @@ class RoutingServer:
             "smt_routing_worker_state",
             "per-worker health state (1 = the worker's current state)",
             ("server", "target", "state"), merge="max")
+        # the FLEET SLO monitor (observability/slo.py): fed from the
+        # merged fleet snapshot on every GET /slo and by the autoscaler's
+        # adapter; its posture gates hedging — near budget exhaustion a
+        # hedge is pure load amplification
+        self.slo = SLOMonitor(SLOConfig.from_env(), name=f"fleet:{label}")
+        # synthetic zero baseline (NOT a worker scrape: a router must not
+        # generate fleet traffic at construction — deterministic fault
+        # plans would see it): the first real /slo sample diffs against
+        # this, so the ledger spans the router's lifetime
+        self.slo.observe({"families": {}}, force=True)
         # control-plane policy objects (io/resilience.py), created before
         # the accept thread starts so handlers never race them
         self._health = FleetHealth(cfg)
@@ -566,9 +611,19 @@ class RoutingServer:
             alternates = order[i + 1:]
             if (attempted == 0 and idempotent and cfg.hedge_enabled
                     and alternates):
-                kind, reply = self._hedged_attempt(
-                    target, alternates, method, path, body, headers,
-                    deadline, route_span, tried_as_hedge)
+                if self.slo.defensive():
+                    # posture escalation: the budget is burning — a hedge
+                    # would amplify offered load exactly when the fleet
+                    # can least afford it. Plain single attempt instead.
+                    with self._lock:
+                        self.hedges_suppressed += 1
+                    kind, reply = self._attempt(target, method, path, body,
+                                                headers, deadline,
+                                                route_span, attempted)
+                else:
+                    kind, reply = self._hedged_attempt(
+                        target, alternates, method, path, body, headers,
+                        deadline, route_span, tried_as_hedge)
             else:
                 kind, reply = self._attempt(target, method, path, body,
                                             headers, deadline, route_span,
@@ -766,6 +821,20 @@ class RoutingServer:
                 return (kind, reply)
         return last
 
+    def _serve_slo(self, handler) -> None:
+        """``GET /slo``: sample the MERGED fleet snapshot (the same
+        worker-scrape path ``/metrics`` rides) into the fleet monitor and
+        serve its status — fleet burn rates from combined bucket deltas,
+        exactly like fleet quantiles."""
+        try:
+            self.slo.observe(self.fleet_snapshot(), force=True)
+        except Exception:
+            _logger.debug("fleet SLO sample failed", exc_info=True)
+        status = self.slo.status()
+        status["fleet"] = True
+        status["workers"] = len(self.registry.lookup(self.service))
+        serve_slo_exposition(handler, status)
+
     def _collect_metrics(self) -> None:
         self._m_routed.sync_total(self.requests_routed)
         self._m_evicted.sync_total(self.workers_evicted)
@@ -773,7 +842,12 @@ class RoutingServer:
         self._m_budget_denied.sync_total(self.retries_denied)
         self._m_hedges.sync_total(self.hedges_sent)
         self._m_hedge_wins.sync_total(self.hedge_wins)
+        self._m_hedges_suppressed.sync_total(self.hedges_suppressed)
         self._m_deadline_rejected.sync_total(self.deadline_rejected)
+        # posture is a pure function of the monitor's retained samples —
+        # no snapshot is taken here (a snapshot-time collector taking a
+        # snapshot would recurse)
+        self._m_slo_posture.set(1.0 if self.slo.defensive() else 0.0)
         # one-hot worker-state gauges: the scrape-time view of the state
         # machine (registered-but-never-failed workers show as healthy)
         states = self._health.states()
@@ -861,8 +935,9 @@ class RoutingServer:
         self._m_reg.unregister_collector(self._collect_metrics)
         for series in (self._m_routed, self._m_evicted, self._m_readmitted,
                        self._m_budget_denied, self._m_hedges,
-                       self._m_hedge_wins, self._m_deadline_rejected,
-                       self._m_attempt_lat):
+                       self._m_hedge_wins, self._m_hedges_suppressed,
+                       self._m_deadline_rejected, self._m_attempt_lat,
+                       self._m_slo_posture):
             series.remove()
         for state in ("closed", "open", "half_open"):
             self._m_breaker_trans.remove(self.server_label, state)
@@ -1367,8 +1442,12 @@ class ProcessServingFleet:
         from .lifecycle import Autoscaler, ProcessFleetAdapter
 
         cfg = cfg or self.lifecycle_cfg
+        # share the ROUTER's fleet monitor: the adapter samples it with
+        # the merged snapshot every tick, so the hedge gate and the
+        # posture gauge react to a burn even when nobody polls /slo
         self._autoscaler = Autoscaler(
-            ProcessFleetAdapter(self, cfg), cfg).start()
+            ProcessFleetAdapter(self, cfg, slo_monitor=self.router.slo),
+            cfg).start()
         return self._autoscaler
 
     def stop(self) -> None:

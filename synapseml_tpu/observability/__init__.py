@@ -29,12 +29,13 @@ Stdlib-only; never imports jax (the no-jax-at-import gate covers this
 package — ``tests/test_import_hygiene.py``).
 """
 
-from . import tracing
+from . import slo, tracing
 from .exposition import (CONTENT_TYPE, OPENMETRICS_CONTENT_TYPE,
                          render_openmetrics, render_prometheus)
 from .merge import histogram_quantile, merge_snapshots, merge_traces
 from .metrics import (DEFAULT_BUCKETS, MetricFamily, MetricsRegistry,
                       get_registry, set_registry)
+from .slo import SLOConfig, SLOMonitor
 from .spans import Span, disable, enable, is_enabled, span, stage_span
 from .tracing import (SpanContext, Tracer, TraceSpan, current_span,
                       current_trace_id, extract_context, format_traceparent,
@@ -52,6 +53,8 @@ __all__ = [
     "MetricFamily",
     "MetricsRegistry",
     "OPENMETRICS_CONTENT_TYPE",
+    "SLOConfig",
+    "SLOMonitor",
     "Span",
     "SpanContext",
     "TraceSpan",
@@ -77,6 +80,7 @@ __all__ = [
     "render_prometheus",
     "set_registry",
     "set_tracer",
+    "slo",
     "span",
     "stage_span",
     "start_span",

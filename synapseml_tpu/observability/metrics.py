@@ -115,17 +115,22 @@ class _Series:
                 self.value = v
 
     # histogram -----------------------------------------------------------
-    def observe(self, v: float, exemplar: Optional[str] = None) -> None:
+    def observe(self, v: float, exemplar: Optional[str] = None,
+                ambient: bool = True) -> None:
         """Record one sample. ``exemplar`` optionally names the trace id
         to tag the bucket with; when omitted, the active trace (if any —
         the ``_exemplar_source`` hook) is used. Callers that finish a
         request OUTSIDE its trace context (serving ``respond`` runs after
-        the pipeline span closed) pass the id explicitly."""
+        the pipeline span closed) pass the id explicitly.
+        ``ambient=False`` suppresses the active-trace fallback: a
+        per-request sample whose own request had no trace must carry NO
+        exemplar, not the enclosing batch span's (which would point the
+        operator at the wrong request's trace)."""
         fam = self._family
         if fam.type != "histogram":
             raise ValueError("observe() is histogram-only")
         i = bisect_left(fam.buckets, v)  # first bucket with upper >= v
-        if exemplar is None and _exemplar_source is not None:
+        if exemplar is None and ambient and _exemplar_source is not None:
             exemplar = _exemplar_source()
         with fam._lock:
             self.counts[i] += 1

@@ -63,6 +63,7 @@ __all__ = [
     "ProfiledJit",
     "aot_cache_dir",
     "chrome_trace_events",
+    "cost_snapshot",
     "disable",
     "enable",
     "install_memory_collector",
@@ -138,6 +139,17 @@ class _Accum(threading.local):
 
 
 _ACC = _Accum()
+
+
+def cost_snapshot() -> Tuple[float, float]:
+    """This thread's monotone ``(flops, bytes)`` accumulators. Engines
+    snapshot around a pipeline batch and difference the two reads — the
+    delta is the device cost of everything profiled that ran in between,
+    which the serving layer attributes to the batch's REQUESTS
+    (``smt_request_flops`` / ``smt_request_hbm_bytes``) and feeds into
+    the cost-aware shedder (``io/serving.py``)."""
+    acc = _ACC
+    return (acc.flops, acc.bytes)
 
 
 def _series_cache(reg: MetricsRegistry) -> Dict[Any, Any]:
@@ -295,12 +307,16 @@ class _SpanProfiler:
         return (acc.flops, acc.bytes)
 
     def exit(self, t0, name, elapsed_s, registry=None):
+        """Attribute the profiled cost that ran inside the span; returns
+        ``(dflops, dbytes)`` so the span can carry the figures into its
+        trace record (per-stage cost visible in ``/traces``), or None
+        when nothing profiled ran."""
         acc = _ACC
         dflops = acc.flops - t0[0]
         dbytes = acc.bytes - t0[1]
         st = _DEV.probe()
         if dflops <= 0.0 and not st.has_memory_stats:
-            return
+            return None
         reg = registry or get_registry()
         cache = _series_cache(reg)
         if dflops > 0.0:
@@ -353,6 +369,9 @@ class _SpanProfiler:
                 pk = sum(ms.get("peak_bytes_in_use", 0) for _, ms in stats)
                 live_s.set(float(live))
                 peak_s.set_max(float(pk))  # atomic monotone watermark
+        if dflops > 0.0:
+            return (dflops, dbytes)
+        return None
 
 
 _PROFILER = _SpanProfiler()

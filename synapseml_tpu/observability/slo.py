@@ -1,0 +1,481 @@
+"""SLO burn-rate monitoring: the consumption layer over the metric stack.
+
+PRs 2-5 built the instruments (fleet-merged latency histograms, shed and
+error counters, trace exemplars); almost nothing consumed them. This
+module closes the loop: it turns the EXISTING series —
+``smt_serving_latency_seconds`` / ``smt_serving_shed_total`` /
+``smt_serving_pipeline_errors_total`` — into an availability SLI, computes
+multi-window burn rates over bucket *deltas* (the Google-SRE
+fast-5m/1h + slow-6h/3d alerting shape), keeps an error-budget ledger,
+and drives three consumers:
+
+- ``GET /slo`` on every :class:`~synapseml_tpu.io.serving.ServingServer`
+  and on the routing front door (the router computes over its MERGED
+  fleet snapshot, exactly like ``/metrics``);
+- the :class:`~synapseml_tpu.io.lifecycle.Autoscaler`, which treats an
+  active fast-window burn as an additional breach signal;
+- the shedding/hedging posture: near budget exhaustion the router stops
+  hedging (hedges amplify offered load) and workers shed earlier
+  (:meth:`SLOMonitor.shed_margin` tightens the deadline-admission check).
+
+Every alert transition lands in the telemetry ring as an ``slo_breach``
+event carrying the freshest over-SLO trace-id exemplar from the latency
+histogram, so a page links straight to a concrete request in ``/traces``.
+
+Design constraints shared with the rest of the package: stdlib-only,
+import-pure (covered by the no-jax-at-import gate), and fake-clock
+testable — the monitor takes an injectable ``clock`` and every window
+length scales through ``SLOConfig.window_scale``, so the burn-rate math
+has deterministic goldens (``tests/test_slo.py``) instead of sleeps.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import threading
+import time
+from bisect import bisect_left
+from collections import deque
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+__all__ = [
+    "SLOConfig",
+    "SLOMonitor",
+    "extract_sli",
+]
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, default))
+    except (TypeError, ValueError):
+        return default
+
+
+# (name, long_window_s, short_window_s, burn_factor): an alert fires when
+# the burn rate exceeds the factor on BOTH windows of a pair — the long
+# window gives significance, the short one gives reset speed (the
+# multiwindow rule from the Google SRE workbook, ch. 5). Factors follow
+# the canonical budget math: 14.4 = 2% of a 30-day budget in 1h.
+DEFAULT_WINDOWS: Tuple[Tuple[str, float, float, float], ...] = (
+    ("fast", 3600.0, 300.0, 14.4),     # page: 2% of budget in 1h
+    ("slow", 21600.0, 1800.0, 6.0),    # page: 5% of budget in 6h
+    ("ticket", 259200.0, 21600.0, 1.0),  # ticket: 10% of budget in 3d
+)
+
+
+@dataclasses.dataclass
+class SLOConfig:
+    """Every SLO knob in one bag (env spellings in :meth:`from_env`;
+    tests pin aggressive values and a tiny ``window_scale`` without
+    touching the environment). Knob table: ``docs/serving.md``."""
+
+    target: float = 0.999            # availability objective (good/total)
+    latency_slo_ms: float = 250.0    # a reply slower than this is SLI-bad
+    window_scale: float = 1.0        # scales every window (fake-clock tests)
+    windows: Tuple[Tuple[str, float, float, float], ...] = DEFAULT_WINDOWS
+    budget_window_s: float = 30 * 86400.0  # the ledger's horizon
+    sample_min_gap_s: float = 1.0    # rate limit on passive sampling
+    min_events: float = 10.0         # long-window traffic floor to alert
+    posture_remaining: float = 0.10  # remaining budget below this = defensive
+    posture_margin: float = 0.5      # deadline-admission margin when defensive
+    max_samples: int = 4096          # bounded sample ring
+    max_breaches: int = 64           # bounded breach history
+
+    @classmethod
+    def from_env(cls) -> "SLOConfig":
+        c = cls()
+        c.target = _env_float("SMT_SLO_TARGET", c.target)
+        c.latency_slo_ms = _env_float("SMT_SLO_LATENCY_MS", c.latency_slo_ms)
+        c.window_scale = _env_float("SMT_SLO_WINDOW_SCALE", c.window_scale)
+        c.posture_remaining = _env_float("SMT_SLO_POSTURE_REMAINING",
+                                         c.posture_remaining)
+        c.posture_margin = _env_float("SMT_SLO_POSTURE_MARGIN",
+                                      c.posture_margin)
+        c.sample_min_gap_s = _env_float("SMT_SLO_SAMPLE_GAP_S",
+                                        c.sample_min_gap_s)
+        c.min_events = _env_float("SMT_SLO_MIN_EVENTS", c.min_events)
+        return c
+
+    @property
+    def budget_fraction(self) -> float:
+        """The error budget: the fraction of requests ALLOWED to be bad."""
+        return max(1.0 - self.target, 1e-9)
+
+
+def _series_passes(labelnames: List[str], labels: List[str],
+                   label_filter: Optional[Dict[str, Any]]) -> bool:
+    if not label_filter:
+        return True
+    lv = dict(zip(labelnames, labels))
+    for ln, vals in label_filter.items():
+        if ln in lv and lv[ln] not in vals:
+            return False
+    return True
+
+
+def extract_sli(snapshot: Dict[str, Any], latency_slo_s: float,
+                label_filter: Optional[Dict[str, Iterable[str]]] = None,
+                ) -> Dict[str, Any]:
+    """Availability SLI out of a registry snapshot (one worker's, or the
+    front door's :func:`~synapseml_tpu.observability.merge.merge_snapshots`
+    aggregate — the families are identical either way).
+
+    - **total** = latency-histogram observation count (every answered
+      request lands there) + shed requests. Sheds NEVER reach the
+      histogram — door sheds return before enqueue, and queue-expiry /
+      cost-displacement sheds are finalized without a latency sample
+      (``ServingServer._finish(shed=True)`` upholds the invariant), so a
+      shed counts exactly once in ``total``.
+    - **bad** = latency observations in buckets above ``latency_slo_s``
+      + sheds (every reason: a 429/504/503-shed is user-visible
+      unavailability) + pipeline-error batches
+      (``smt_serving_pipeline_errors_total`` counts batches, a deliberate
+      under-approximation of the 500-replied requests — the replies
+      themselves are already in ``total`` via the histogram).
+    - **exemplar** = the freshest over-SLO bucket exemplar
+      ``(trace_id, wall_ts)`` — the concrete request a breach event links
+      to; None when no traced request has landed over-SLO yet.
+
+    ``label_filter`` restricts to matching series (a worker passes its own
+    ``server`` label; the router passes nothing and sees the fleet).
+    Values are CUMULATIVE counter reads; the monitor differences
+    consecutive extractions, so burn rates come from bucket *deltas*.
+    """
+    fams = (snapshot.get("families") or {}) if isinstance(snapshot, dict) \
+        else {}
+    total = 0.0
+    bad = 0.0
+    exemplar: Optional[Tuple[str, float]] = None
+
+    lat = fams.get("smt_serving_latency_seconds")
+    if isinstance(lat, dict) and lat.get("type") == "histogram":
+        buckets = lat.get("buckets") or []
+        labelnames = list(lat.get("labelnames") or [])
+        # first bucket whose upper bound exceeds the SLO: everything from
+        # there up (incl. +Inf) is over-SLO. bisect_left on the upper
+        # bounds means a bucket whose upper == slo still counts as good.
+        k = bisect_left(buckets, latency_slo_s)
+        if k < len(buckets) and buckets[k] <= latency_slo_s:
+            k += 1
+        for s in lat.get("series", []):
+            if not _series_passes(labelnames, s.get("labels", []),
+                                  label_filter):
+                continue
+            total += float(s.get("count", 0))
+            bad += float(sum(s.get("counts", [])[k:]))
+            for idx, ex in (s.get("exemplars") or {}).items():
+                try:
+                    i = int(idx)
+                except (TypeError, ValueError):
+                    continue
+                if i >= k and len(ex) >= 3:
+                    ts = float(ex[2])
+                    if exemplar is None or ts >= exemplar[1]:
+                        exemplar = (str(ex[0]), ts)
+
+    for name in ("smt_serving_shed_total",
+                 "smt_serving_pipeline_errors_total"):
+        fam = fams.get(name)
+        if not isinstance(fam, dict):
+            continue
+        labelnames = list(fam.get("labelnames") or [])
+        for s in fam.get("series", []):
+            if not _series_passes(labelnames, s.get("labels", []),
+                                  label_filter):
+                continue
+            v = float(s.get("value", 0.0))
+            bad += v
+            if name == "smt_serving_shed_total":
+                total += v  # sheds never reach the latency histogram
+
+    return {"total": total, "bad": min(bad, total) if total else bad,
+            "exemplar": exemplar}
+
+
+class SLOMonitor:
+    """Multi-window burn-rate monitor + error-budget ledger over an SLI
+    sampled from registry snapshots.
+
+    Feed it snapshots via :meth:`observe` (rate-limited unless
+    ``force=True``); it keeps a bounded ring of cumulative
+    ``(t, total, bad)`` samples and computes, per configured window pair,
+    ``burn = (bad_rate / total_rate) / budget_fraction`` from the deltas.
+    An alert is ACTIVE while burn exceeds the pair's factor on both the
+    long and the short window; the inactive→active transition appends a
+    breach record (bounded) and emits an ``slo_breach`` telemetry event
+    carrying the freshest over-SLO trace exemplar.
+
+    ``clock`` is injectable (monotonic by default) and window lengths
+    scale through ``cfg.window_scale``, so the whole decision surface is
+    fake-clock testable without sleeps.
+    """
+
+    def __init__(self, cfg: Optional[SLOConfig] = None,
+                 clock=time.monotonic,
+                 label_filter: Optional[Dict[str, Iterable[str]]] = None,
+                 name: str = "slo"):
+        self.cfg = cfg or SLOConfig.from_env()
+        self.clock = clock
+        self.label_filter = label_filter
+        self.name = name
+        self._lock = threading.Lock()
+        # cumulative samples (t, total, bad), oldest first
+        self._samples: deque = deque(maxlen=max(2, self.cfg.max_samples))
+        # coarse ring behind the LONG horizons: at >= sample_min_gap_s
+        # resolution the fine ring spans ~max_samples seconds (~68 min
+        # for the defaults) — nowhere near the 30-day ledger or the
+        # 3-day ticket window. One downsampled entry per
+        # budget_window/max_samples (~10 min default) keeps the whole
+        # budget horizon addressable; _delta consults it for any base
+        # older than the fine ring.
+        self._coarse: deque = deque(maxlen=max(2, self.cfg.max_samples))
+        self._alerts: Dict[str, bool] = {}
+        self._last_burns: Dict[str, Tuple[float, float]] = {}
+        self.breaches: deque = deque(maxlen=max(1, self.cfg.max_breaches))
+        self._exemplar: Optional[Tuple[str, float]] = None
+        # posture cache, refreshed by _evaluate on every accepted sample:
+        # the per-request consumers (deadline admission, the router's
+        # hedge gate) read two plain attributes instead of copying and
+        # scanning the sample ring under the monitor lock per request
+        self._posture_defensive = False
+        self._posture_margin = 1.0
+
+    # -- sampling ----------------------------------------------------------
+    def observe(self, snapshot: Dict[str, Any],
+                now: Optional[float] = None,
+                force: bool = False) -> Optional[List[Dict[str, Any]]]:
+        """Sample the SLI from ``snapshot`` and re-evaluate the alerts.
+        Passive call sites (per-batch hooks) are rate-limited to
+        ``sample_min_gap_s``; returns the NEWLY fired breaches (empty list
+        = sampled, nothing new), or None when rate-limited."""
+        if now is None:
+            now = self.clock()
+        with self._lock:
+            if (not force and self._samples
+                    and now - self._samples[-1][0]
+                    < self.cfg.sample_min_gap_s * self.cfg.window_scale):
+                return None
+        sli = extract_sli(snapshot, self.cfg.latency_slo_ms / 1e3,
+                          self.label_filter)
+        with self._lock:
+            self._samples.append((now, sli["total"], sli["bad"]))
+            gap = (self.cfg.budget_window_s * self.cfg.window_scale
+                   / max(2, self.cfg.max_samples))
+            if not self._coarse or now - self._coarse[-1][0] >= gap:
+                self._coarse.append((now, sli["total"], sli["bad"]))
+            ex = sli.get("exemplar")
+            if ex is not None and (self._exemplar is None
+                                   or ex[1] >= self._exemplar[1]):
+                self._exemplar = ex
+        return self._evaluate(now)
+
+    def maybe_observe(self, snapshot_fn, now: Optional[float] = None
+                      ) -> Optional[List[Dict[str, Any]]]:
+        """Rate-limited :meth:`observe` that defers the (not-free)
+        snapshot construction until the rate limit has actually passed —
+        the form per-batch hooks call, so a busy engine pays one registry
+        snapshot per gap, not per batch."""
+        if now is None:
+            now = self.clock()
+        with self._lock:
+            if (self._samples
+                    and now - self._samples[-1][0]
+                    < self.cfg.sample_min_gap_s * self.cfg.window_scale):
+                return None
+        return self.observe(snapshot_fn(), now=now, force=True)
+
+    def _delta(self, now: float, window_s: float
+               ) -> Tuple[float, float, float]:
+        """(d_total, d_bad, actual_window_s) over the newest sample at or
+        before ``now - window_s`` (the oldest sample when history is
+        shorter — a partial window, never a refusal). Bases older than
+        the fine ring come from the coarse ring, so the budget ledger
+        and the ticket window see their full horizons. Caller holds no
+        lock; sampling under it."""
+        with self._lock:
+            samples = list(self._samples)
+            coarse = list(self._coarse)
+        if samples:
+            oldest = samples[0][0]
+            samples = [s for s in coarse if s[0] < oldest] + samples
+        else:
+            samples = coarse
+        if len(samples) < 2:
+            return (0.0, 0.0, 0.0)
+        horizon = now - window_s
+        base = samples[0]
+        for s in samples:
+            if s[0] <= horizon:
+                base = s
+            else:
+                break
+        last = samples[-1]
+        dt = last[0] - base[0]
+        if dt <= 0:
+            return (0.0, 0.0, 0.0)
+        return (max(0.0, last[1] - base[1]), max(0.0, last[2] - base[2]), dt)
+
+    def burn_rate(self, window_s: float, now: Optional[float] = None
+                  ) -> float:
+        """Observed error fraction over the window, as a multiple of the
+        error budget: 1.0 = burning exactly the sustainable rate; 0.0 when
+        the window saw no traffic."""
+        if now is None:
+            now = self.clock()
+        d_total, d_bad, _ = self._delta(now, window_s)
+        if d_total <= 0:
+            return 0.0
+        return (d_bad / d_total) / self.cfg.budget_fraction
+
+    # -- alerting ----------------------------------------------------------
+    def _evaluate(self, now: float) -> List[Dict[str, Any]]:
+        scale = self.cfg.window_scale
+        fired: List[Dict[str, Any]] = []
+        for wname, long_s, short_s, factor in self.cfg.windows:
+            b_long = self.burn_rate(long_s * scale, now)
+            b_short = self.burn_rate(short_s * scale, now)
+            # significance floor: burn is a RATIO — two early requests
+            # with one cold-compile straggler would read as burn 500 and
+            # page a fresh worker. A pair is only eligible once its long
+            # window carries min_events of traffic.
+            d_total, _, _ = self._delta(now, long_s * scale)
+            active = (d_total >= self.cfg.min_events
+                      and b_long >= factor and b_short >= factor)
+            with self._lock:
+                was = self._alerts.get(wname, False)
+                self._alerts[wname] = active
+                self._last_burns[wname] = (b_long, b_short)
+                exemplar = self._exemplar
+            if active and not was:
+                breach = {
+                    "window": wname,
+                    "threshold": factor,
+                    "burn_long": round(b_long, 3),
+                    "burn_short": round(b_short, 3),
+                    "ts": time.time(),  # wall clock: cross-host correlation
+                }
+                if exemplar is not None:
+                    breach["trace_id"] = exemplar[0]
+                with self._lock:
+                    self.breaches.append(breach)
+                fired.append(breach)
+                # the telemetry ring is the cross-subsystem event bus; the
+                # lazy import keeps this module dependency-free on its own
+                from ..core.telemetry import log_event
+
+                log_event("slo_breach", className="slo", uid=self.name,
+                          **breach)
+        # refresh the posture cache AFTER the alert states settle (the
+        # fast-burn component reads them); posture only changes when a
+        # sample lands, so the per-request readers can stay lock-free
+        defensive = self._compute_defensive(now)
+        self._posture_defensive = defensive
+        self._posture_margin = (self.cfg.posture_margin if defensive
+                                else 1.0)
+        return fired
+
+    def alert_active(self, window: str = "fast") -> bool:
+        with self._lock:
+            return self._alerts.get(window, False)
+
+    def fast_burn_active(self) -> bool:
+        """The autoscaler's breach signal: the first (fastest) configured
+        window pair is burning."""
+        if not self.cfg.windows:
+            return False
+        return self.alert_active(self.cfg.windows[0][0])
+
+    # -- budget ledger -----------------------------------------------------
+    def budget(self, now: Optional[float] = None) -> Dict[str, Any]:
+        """The error-budget ledger over ``budget_window_s`` (bounded by
+        retained history): consumed/remaining fractions of the budget,
+        plus the raw event counts they come from."""
+        if now is None:
+            now = self.clock()
+        d_total, d_bad, dt = self._delta(
+            now, self.cfg.budget_window_s * self.cfg.window_scale)
+        allowed = self.cfg.budget_fraction * d_total
+        consumed = (d_bad / allowed) if allowed > 0 else 0.0
+        return {
+            "target": self.cfg.target,
+            "window_s": round(dt, 3),
+            "total_events": d_total,
+            "bad_events": d_bad,
+            "consumed_fraction": round(consumed, 4),
+            "remaining_fraction": round(max(0.0, 1.0 - consumed), 4),
+        }
+
+    # -- posture -----------------------------------------------------------
+    def _compute_defensive(self, now: float) -> bool:
+        if self.fast_burn_active():
+            return True
+        b = self.budget(now)
+        # same significance floor as the alerts: two startup requests
+        # must not flip the whole posture defensive
+        if b["total_events"] < self.cfg.min_events:
+            return False
+        return b["remaining_fraction"] < self.cfg.posture_remaining
+
+    def defensive(self, now: Optional[float] = None) -> bool:
+        """True when the budget is near exhaustion (remaining below
+        ``posture_remaining``) or the fast window pair is actively
+        burning — the signal the router uses to stop hedging and workers
+        use to shed earlier. Without ``now`` this reads the value cached
+        at the last sample (the per-request form: no lock, no ring
+        scan); pass ``now`` to recompute against the retained samples."""
+        if now is None:
+            return self._posture_defensive
+        return self._compute_defensive(now)
+
+    def shed_margin(self, now: Optional[float] = None) -> float:
+        """Deadline-admission margin for the worker shedder: 1.0 in the
+        normal posture; ``posture_margin`` (< 1) when defensive, so a
+        request is 429'd already when the queue estimate exceeds
+        ``margin × remaining_deadline`` — shedding begins before the
+        budget is fully gone, not after. Same caching rule as
+        :meth:`defensive`: argument-less reads are lock-free."""
+        if now is None:
+            return self._posture_margin
+        return self.cfg.posture_margin if self.defensive(now) else 1.0
+
+    # -- exposition --------------------------------------------------------
+    def status(self, now: Optional[float] = None) -> Dict[str, Any]:
+        """JSON-able state for ``GET /slo`` (rendered by
+        ``tools/slo_report.py``)."""
+        if now is None:
+            now = self.clock()
+        scale = self.cfg.window_scale
+        with self._lock:
+            burns = dict(self._last_burns)
+            alerts = dict(self._alerts)
+            breaches = list(self.breaches)
+            n_samples = len(self._samples)
+            exemplar = self._exemplar
+        windows = []
+        for wname, long_s, short_s, factor in self.cfg.windows:
+            b = burns.get(wname)
+            windows.append({
+                "window": wname,
+                "long_s": long_s * scale,
+                "short_s": short_s * scale,
+                "threshold": factor,
+                "burn_long": round(b[0], 3) if b else None,
+                "burn_short": round(b[1], 3) if b else None,
+                "active": alerts.get(wname, False),
+            })
+        out = {
+            "name": self.name,
+            "target": self.cfg.target,
+            "latency_slo_ms": self.cfg.latency_slo_ms,
+            "budget": self.budget(now),
+            "windows": windows,
+            "defensive": self.defensive(now),
+            "shed_margin": self.shed_margin(now),
+            "breaches": breaches,
+            "samples": n_samples,
+        }
+        if exemplar is not None:
+            out["exemplar_trace_id"] = exemplar[0]
+        return out

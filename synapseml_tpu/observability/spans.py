@@ -139,9 +139,10 @@ class Span:
     def __exit__(self, exc_type, exc, tb) -> bool:
         elapsed_s = (_now_ns() - self._t0) * 1e-9
         self._dur.observe(elapsed_s)
+        cost = None
         if self._prof0 is not None and _profiler is not None:
             try:
-                _profiler.exit(self._prof0, self._name, elapsed_s)
+                cost = _profiler.exit(self._prof0, self._name, elapsed_s)
             except Exception:
                 pass  # accounting must never break the instrumented call
         tp = self._trace_parent
@@ -149,6 +150,12 @@ class Span:
             attrs = {"stage": self._name[0], "method": self._name[1]}
             if self.rows is not None:
                 attrs["rows"] = self.rows
+            if cost is not None:
+                # the profiled device cost that ran inside this stage —
+                # per-stage FLOPs/bytes readable straight off /traces
+                attrs["flops"] = cost[0]
+                if cost[1] > 0:
+                    attrs["hbm_bytes"] = cost[1]
             tp.tracer.record(f"{self._name[0]}.{self._name[1]}", parent=tp,
                              duration_s=elapsed_s, attributes=attrs,
                              error=exc if exc_type is not None else None)

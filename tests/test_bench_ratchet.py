@@ -181,6 +181,104 @@ def test_committed_waiver_file_parses():
     here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     waivers = bench.load_waivers(os.path.join(here, "BENCH_ACKS.md"))
     assert (5, "flash_attention_32k") in waivers
+    # prefixed gate waivers (mfu:<lane> / flat:<lane>) parse too
+    assert (5, "flat:vit_to_gbdt_pipeline") in waivers
+
+
+# ---------------------------------------------------------------------------
+# MFU ratchet: per-lane floors + the flat-lane stagnation detector
+# (ROADMAP item 6: "ViT flat for three rounds" is a failing test now)
+# ---------------------------------------------------------------------------
+
+def _write_round(tmp_path, rnd, lanes):
+    (tmp_path / f"BENCH_r{rnd:02d}.json").write_text(json.dumps(
+        {"n": rnd, "rc": 0, "tail": "",
+         "parsed": {"value": 1.0, "extra": lanes}}))
+
+
+def test_mfu_floor_fails_below_and_passes_above(tmp_path):
+    _write_round(tmp_path, 7, {
+        "vit_to_gbdt_pipeline": {"images_per_sec_end_to_end": 2000.0,
+                                 "mfu_vit_only": 0.21},
+        "resnet50_onnx": {"images_per_sec_per_chip": 12000.0, "mfu": 0.47},
+    })
+    offenders = bench.mfu_violations(here=str(tmp_path), waivers=set())
+    assert offenders == [(7, "mfu:vit_to_gbdt_pipeline", 0.21)]
+    # a reasoned waiver row clears it
+    assert bench.mfu_violations(
+        here=str(tmp_path),
+        waivers={(7, "mfu:vit_to_gbdt_pipeline")}) == []
+
+
+def test_mfu_floor_skips_null_mfu_and_old_rounds(tmp_path):
+    # a CPU-fallback round reports mfu: null (unknown device peak) — the
+    # floor skips it rather than guessing; rounds before the floor's
+    # introduction (MFU_FLOOR_FROM_ROUND) are history, not regressions
+    _write_round(tmp_path, 7, {
+        "vit_to_gbdt_pipeline": {"images_per_sec_end_to_end": 9.0,
+                                 "mfu_vit_only": None}})
+    _write_round(tmp_path, 2, {
+        "resnet50_onnx": {"images_per_sec_per_chip": 4101.0, "mfu": 0.17}})
+    assert bench.mfu_violations(here=str(tmp_path), waivers=set()) == []
+
+
+def test_stagnation_detector_on_synthetic_flat_series(tmp_path):
+    # three consecutive rounds flat within 2% while MFU sits at 0.35:
+    # stagnating WITH headroom -> violation at the window's last round
+    for rnd, v in ((7, 1983.9), (8, 1984.0), (9, 1983.9)):
+        _write_round(tmp_path, rnd, {
+            "vit_to_gbdt_pipeline": {"images_per_sec_end_to_end": v,
+                                     "mfu_vit_only": 0.354}})
+    offenders = bench.stagnation_violations(here=str(tmp_path),
+                                            waivers=set())
+    assert offenders == [(9, "flat:vit_to_gbdt_pipeline", 1983.9)]
+    # folded into the one CI gate, honoring waivers
+    assert (9, "flat:vit_to_gbdt_pipeline", 1983.9) in \
+        bench.unwaived_regressions(here=str(tmp_path), waivers=set())
+    assert bench.stagnation_violations(
+        here=str(tmp_path),
+        waivers={(9, "flat:vit_to_gbdt_pipeline")}) == []
+
+
+def test_stagnation_exempts_high_mfu_and_moving_lanes(tmp_path):
+    for rnd, (vit, bert) in ((7, (1900.0, 4314.0)), (8, (2100.0, 4319.0)),
+                             (9, (2350.0, 4353.0))):
+        _write_round(tmp_path, rnd, {
+            # vit MOVES >2% each round: not flat
+            "vit_to_gbdt_pipeline": {"images_per_sec_end_to_end": vit,
+                                     "mfu_vit_only": 0.36},
+            # bert IS flat but at 0.49 MFU — near the practical ceiling,
+            # above STAGNATION_MFU_BAR: exempt
+            "bert_base_onnx": {"sequences_per_sec_per_chip": bert,
+                               "mfu": 0.494}})
+    assert bench.stagnation_violations(here=str(tmp_path),
+                                       waivers=set()) == []
+
+
+def test_stagnation_counts_error_rounds_as_no_progress(tmp_path):
+    # the real ViT shape: r+1 errored (no value), r and r+2 unchanged —
+    # an error round is not progress, the lane is still flat
+    _write_round(tmp_path, 7, {
+        "vit_to_gbdt_pipeline": {"images_per_sec_end_to_end": 1983.89,
+                                 "mfu_vit_only": 0.354}})
+    _write_round(tmp_path, 8, {
+        "vit_to_gbdt_pipeline": {"error": "TracerArrayConversionError"}})
+    _write_round(tmp_path, 9, {
+        "vit_to_gbdt_pipeline": {"images_per_sec_end_to_end": 1983.91,
+                                 "mfu_vit_only": 0.354}})
+    offenders = bench.stagnation_violations(here=str(tmp_path),
+                                            waivers=set())
+    assert offenders == [(9, "flat:vit_to_gbdt_pipeline", 1983.91)]
+
+
+def test_committed_series_vit_stagnation_is_caught_and_waived():
+    """The motivating case: ViT flat r03->r05 at 0.354 MFU is DETECTED on
+    the committed artifacts (not grandfathered in silently) and passes CI
+    only through its reasoned BENCH_ACKS.md row."""
+    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    raw = bench.stagnation_violations(here=here, waivers=set())
+    assert (5, "flat:vit_to_gbdt_pipeline", 1983.91) in raw
+    assert bench.stagnation_violations(here=here) == []  # waived, reasoned
 
 
 def test_error_strings_capped():

@@ -33,6 +33,7 @@ _GATED_MODULES = [
     "synapseml_tpu.observability.merge",
     "synapseml_tpu.observability.metrics",
     "synapseml_tpu.observability.profiling",
+    "synapseml_tpu.observability.slo",
     "synapseml_tpu.observability.spans",
     "synapseml_tpu.observability.tracing",
     "synapseml_tpu.io.faultinject",
@@ -69,7 +70,8 @@ _TOOLS_DIR = os.path.join(
 # standalone CLI tools a human points at PRODUCTION endpoints or saved
 # artifacts; they must stay jax-free (tools/ is not a package — imported
 # via a path entry)
-_GATED_TOOLS = ["trace_dump", "lint", "perf_diff", "perf_timeline"]
+_GATED_TOOLS = ["trace_dump", "lint", "perf_diff", "perf_timeline",
+                "slo_report"]
 
 
 def test_no_jax_at_import():

@@ -705,6 +705,59 @@ def test_smt013_exempts_the_layout_and_topology_modules(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# SMT014 — metric-name discipline
+# ---------------------------------------------------------------------------
+
+def test_smt014_true_positive_names(tmp_path):
+    findings = run_rule(tmp_path, "SMT014", """\
+        def make(reg, label):
+            c = reg.counter("smt_things_count", "no _total suffix")
+            g = reg.gauge("smt_live_total", "gauge wearing the counter suffix")
+            h = reg.histogram("smt_reply_latency_ms", "non-base unit")
+            h2 = reg.histogram("smt_payload_kb", "non-base unit")
+            return c, g, h, h2
+        """)
+    assert [f.line for f in findings] == [2, 3, 4, 5]
+    assert all(f.code == "SMT014" for f in findings)
+    assert "_total" in findings[0].message
+    assert "_seconds" in findings[2].message
+    assert "_bytes" in findings[3].message
+
+
+def test_smt014_true_positive_unbounded_labels(tmp_path):
+    findings = run_rule(tmp_path, "SMT014", """\
+        import uuid
+
+        def record(fam, rid, ctx):
+            fam.labels(rid).inc()
+            fam.labels(ctx.trace_id).inc()
+            fam.labels(uuid.uuid4().hex).inc()
+            fam.labels(f"req-{rid}").inc()
+        """)
+    assert [f.line for f in findings] == [4, 5, 6, 7]
+    assert "unbounded" in findings[0].message
+
+
+def test_smt014_true_negative(tmp_path):
+    findings = run_rule(tmp_path, "SMT014", """\
+        def make(reg, server_label, engine):
+            # base units, _total on counters, unitless gauge/histograms
+            c = reg.counter("smt_requests_total", "ok", ("server",))
+            g = reg.gauge("smt_chosen_batch_size", "unitless gauge")
+            h = reg.histogram("smt_latency_seconds", "base unit")
+            h2 = reg.histogram("smt_payload_bytes", "base unit")
+            h3 = reg.histogram("smt_stage_mfu", "unitless ratio")
+            # bounded composite labels (server_label = host:port, retired
+            # on close) and constant label values pass
+            c.labels(server_label).inc()
+            h.labels(server_label, engine)
+            g.labels("failed")
+            return c
+        """)
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
 # SARIF output
 # ---------------------------------------------------------------------------
 

@@ -1,0 +1,569 @@
+"""SLO burn-rate monitor, cost attribution, and the consuming loops.
+
+The acceptance contract of ISSUE 15: the monitor's burn-rate math has
+deterministic goldens under a fake clock; a fault-injected overload run
+trips the fast-window alert and BOTH consumers react (the autoscaler
+scales up on the burn signal, the shedder tightens its admission margin)
+with the whole sequence visible in ``/slo``, the telemetry ring, and a
+trace exemplar; per-request cost attribution flows end-to-end through a
+real ``ProcessServingFleet``; and under 429-pressure the most expensive
+queued requests shed first.
+"""
+
+import json
+import os
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from synapseml_tpu.core import Transformer
+from synapseml_tpu.core.telemetry import clear_events, recent_events
+from synapseml_tpu.io import faultinject
+from synapseml_tpu.io.lifecycle import (Autoscaler, FleetObservation,
+                                        LifecycleConfig)
+from synapseml_tpu.io.resilience import DEADLINE_HEADER
+from synapseml_tpu.io.serving import (MicroBatchServingEngine, ServingServer,
+                                      choose_batch_size, string_to_response)
+from synapseml_tpu.io.serving_v2 import (ContinuousServingEngine,
+                                         DistributedServingEngine)
+from synapseml_tpu.observability import get_registry, tracing
+from synapseml_tpu.observability.metrics import MetricsRegistry
+from synapseml_tpu.observability.slo import (SLOConfig, SLOMonitor,
+                                             extract_sli)
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class _Echo(Transformer):
+    def _transform(self, table):
+        reqs = table["request"]
+        out = np.empty(len(reqs), dtype=object)
+        for i, r in enumerate(reqs):
+            out[i] = string_to_response((r.entity or b"").decode())
+        return table.with_column("reply", out)
+
+
+# ---------------------------------------------------------------------------
+# the SLI extraction and burn-rate math: fake-clock goldens
+# ---------------------------------------------------------------------------
+
+def _serving_registry():
+    reg = MetricsRegistry()
+    lat = reg.histogram("smt_serving_latency_seconds", "", ("server",))
+    shed = reg.counter("smt_serving_shed_total", "", ("server", "reason"))
+    errs = reg.counter("smt_serving_pipeline_errors_total", "",
+                       ("server", "engine"))
+    return reg, lat, shed, errs
+
+
+def test_extract_sli_goldens_and_label_filter():
+    reg, lat, shed, errs = _serving_registry()
+    for _ in range(90):
+        lat.labels("a:1").observe(0.01)           # good
+    for _ in range(10):
+        lat.labels("a:1").observe(1.0, exemplar="feedbeef")  # over-SLO
+    shed.labels("a:1", "overload").inc(5)          # bad AND total
+    errs.labels("a:1", "microbatch").inc(2)        # bad only
+    lat.labels("b:2").observe(3.0)                 # another server
+    snap = reg.snapshot()
+
+    sli = extract_sli(snap, 0.25, label_filter={"server": {"a:1"}})
+    assert sli["total"] == 105.0                   # 100 observed + 5 shed
+    assert sli["bad"] == 17.0                      # 10 slow + 5 shed + 2 err
+    assert sli["exemplar"][0] == "feedbeef"
+
+    fleet = extract_sli(snap, 0.25)                # no filter: both servers
+    assert fleet["total"] == 106.0
+    assert fleet["bad"] == 18.0
+
+
+def test_burn_rate_goldens_under_fake_clock():
+    """The multi-window rule, hand-computed: the alert needs BOTH the
+    long and the short window over the factor, and recovers when the
+    short window drains."""
+    clock = {"t": 0.0}
+    cfg = SLOConfig(target=0.9, windows=(("fast", 100.0, 10.0, 5.0),),
+                    sample_min_gap_s=0.0, budget_window_s=1000.0)
+    reg, lat, shed, _ = _serving_registry()
+    mon = SLOMonitor(cfg, clock=lambda: clock["t"], name="golden")
+    clear_events()
+
+    def tick(t, good=0, bad=0):
+        clock["t"] = t
+        for _ in range(good):
+            lat.labels("s").observe(0.01)
+        for _ in range(bad):
+            lat.labels("s").observe(1.0, exemplar="abad1dea")
+        return mon.observe(reg.snapshot(), force=True)
+
+    tick(0, good=100)
+    assert mon.burn_rate(10.0) == 0.0              # one sample: no delta
+
+    tick(10, good=90, bad=10)
+    # short window delta: 100 events, 10 bad -> 0.1 error rate = 1.0 burn
+    assert mon.burn_rate(10.0) == pytest.approx(1.0)
+    assert not mon.alert_active("fast")
+
+    fired = tick(20, bad=50)
+    # short: 50/50 bad -> burn 10 >= 5; long (partial, base = the t=0
+    # sample, so events after it): 60/150 -> burn 4.0 < 5: the long
+    # window vetoes the alert
+    assert mon.burn_rate(10.0) == pytest.approx(10.0)
+    assert mon.burn_rate(100.0) == pytest.approx((60 / 150) / 0.1)
+    assert fired == [] and not mon.alert_active("fast")
+
+    fired = tick(30, bad=50)
+    # long: 110/200 -> burn 5.5 >= 5; short: 50/50 -> burn 10 -> FIRES
+    assert mon.burn_rate(100.0) == pytest.approx((110 / 200) / 0.1)
+    assert mon.alert_active("fast") and len(fired) == 1
+    assert fired[0]["trace_id"] == "abad1dea"      # the over-SLO exemplar
+    breaches = [e for e in recent_events() if e["method"] == "slo_breach"]
+    assert breaches and breaches[-1]["window"] == "fast"
+    assert breaches[-1]["trace_id"] == "abad1dea"
+
+    tick(40, good=1000)                            # short window drains
+    assert not mon.alert_active("fast")            # alert recovers
+
+
+def test_min_events_floor_gates_low_traffic_alerts():
+    """Burn is a ratio: a fresh worker's first cold-compile straggler
+    (1 bad of 2) reads as burn 500 — without a traffic floor it would
+    page, flip the posture defensive and feed the autoscaler a breach.
+    The pair only becomes eligible at ``min_events`` of long-window
+    traffic."""
+    clock = {"t": 0.0}
+    cfg = SLOConfig(target=0.999, windows=(("fast", 100.0, 10.0, 14.4),),
+                    sample_min_gap_s=0.0, min_events=10.0)
+    reg, lat, _, _ = _serving_registry()
+    mon = SLOMonitor(cfg, clock=lambda: clock["t"], name="floor")
+    mon.observe(reg.snapshot(), force=True)        # zero baseline
+
+    clock["t"] = 1.0
+    lat.labels("s").observe(1.0)                   # the cold straggler
+    lat.labels("s").observe(0.01)
+    mon.observe(reg.snapshot(), force=True)
+    assert mon.burn_rate(10.0) > 14.4              # burn IS over the factor
+    assert not mon.alert_active("fast")            # ... but 2 events < 10
+    assert not mon.defensive()
+
+    clock["t"] = 2.0                               # real traffic, real burn
+    for _ in range(12):
+        lat.labels("s").observe(1.0)
+    mon.observe(reg.snapshot(), force=True)
+    assert mon.alert_active("fast")                # floor met: it fires
+
+
+def test_budget_ledger_and_defensive_posture():
+    clock = {"t": 0.0}
+    cfg = SLOConfig(target=0.9, windows=(("fast", 100.0, 10.0, 1e9),),
+                    sample_min_gap_s=0.0, budget_window_s=1000.0,
+                    posture_remaining=0.25, posture_margin=0.5)
+    reg, lat, _, _ = _serving_registry()
+    mon = SLOMonitor(cfg, clock=lambda: clock["t"], name="ledger")
+
+    def tick(t, good=0, bad=0):
+        clock["t"] = t
+        for _ in range(good):
+            lat.labels("s").observe(0.01)
+        for _ in range(bad):
+            lat.labels("s").observe(1.0)
+        mon.observe(reg.snapshot(), force=True)
+
+    tick(0, good=100)
+    tick(10, good=95, bad=5)
+    b = mon.budget()
+    # 5 bad of 100 new events against a 10% budget: half the budget gone
+    assert b["consumed_fraction"] == pytest.approx(0.5)
+    assert b["remaining_fraction"] == pytest.approx(0.5)
+    assert not mon.defensive() and mon.shed_margin() == 1.0
+
+    tick(20, good=92, bad=8)
+    # 13 bad / 200 events = 65% of budget consumed -> remaining 0.35
+    assert mon.budget()["remaining_fraction"] == pytest.approx(0.35)
+    tick(30, bad=12)
+    # 25 bad / 212 -> ~118% consumed: exhausted, posture flips
+    assert mon.budget()["remaining_fraction"] == 0.0
+    assert mon.defensive() and mon.shed_margin() == 0.5
+
+
+def test_budget_base_outlives_the_fine_sample_ring():
+    """The coarse ring keeps the LONG horizons honest: with the fine
+    ring rolled over by steady sampling, the budget ledger still
+    differences against a base old enough to cover its window — an
+    outage early in the budget window cannot age out of the ledger in
+    ~max_samples seconds."""
+    clock = {"t": 0.0}
+    cfg = SLOConfig(target=0.9, windows=(("fast", 10.0, 1.0, 1e9),),
+                    sample_min_gap_s=0.0, budget_window_s=10000.0,
+                    max_samples=16)
+    reg, lat, _, _ = _serving_registry()
+    mon = SLOMonitor(cfg, clock=lambda: clock["t"], name="coarse")
+    mon.observe(reg.snapshot(), now=0.0, force=True)  # zero baseline
+    for _ in range(10):  # the early outage: 10 bad events at t=1
+        lat.labels("s").observe(1.0)
+    mon.observe(reg.snapshot(), now=1.0, force=True)
+    # 300 good-traffic samples: the 16-slot fine ring rolls over ~19x
+    for k in range(300):
+        lat.labels("s").observe(0.01)
+        mon.observe(reg.snapshot(), now=2.0 + k, force=True)
+    b = mon.budget()
+    # the fine ring's oldest sample already contains the 10 bad events;
+    # only the coarse ring's t=0 baseline can expose them as a delta
+    assert b["bad_events"] == 10, b
+    assert b["total_events"] == 310, b
+
+
+# ---------------------------------------------------------------------------
+# /slo endpoints: worker and fleet-merged front door
+# ---------------------------------------------------------------------------
+
+def _get_json(url):
+    with urllib.request.urlopen(url, timeout=10) as r:
+        assert r.status == 200
+        return json.loads(r.read().decode())
+
+
+def test_slo_endpoint_on_serving_server():
+    srv = ServingServer(port=0)
+    eng = MicroBatchServingEngine(srv, _Echo(), interval=0.005).start()
+    try:
+        req = urllib.request.Request(srv.address, data=b"hi", method="POST")
+        with urllib.request.urlopen(req, timeout=10) as r:
+            assert r.status == 200
+        status = _get_json(srv.address + "/slo")
+        assert status["target"] == pytest.approx(SLOConfig().target)
+        assert status["budget"]["total_events"] >= 1
+        assert [w["window"] for w in status["windows"]] == \
+            ["fast", "slow", "ticket"]
+        assert status["shed_margin"] == 1.0
+    finally:
+        eng.stop()
+
+
+def test_slo_fleet_merge_on_router():
+    eng = DistributedServingEngine(_Echo(), n_workers=2)
+    try:
+        for i in range(6):
+            req = urllib.request.Request(eng.address + "/",
+                                         data=b"x%d" % i, method="POST")
+            with urllib.request.urlopen(req, timeout=10) as r:
+                assert r.status == 200
+        status = _get_json(eng.address + "/slo")
+        assert status["fleet"] is True and status["workers"] == 2
+        # the fleet sample sees every worker's histogram (merged like
+        # /metrics): all 6 replies are in the ledger
+        assert status["budget"]["total_events"] >= 6
+    finally:
+        eng.stop()
+
+
+# ---------------------------------------------------------------------------
+# the closed loop: fault-injected overload -> burn alert -> autoscaler +
+# shedder react, visible in /slo, the telemetry ring, and a trace exemplar
+# ---------------------------------------------------------------------------
+
+class _Slow(Transformer):
+    """~80 ms per batch: served requests land over a 50 ms latency SLO."""
+
+    def _transform(self, table):
+        time.sleep(0.08)
+        reqs = table["request"]
+        out = np.empty(len(reqs), dtype=object)
+        for i in range(len(reqs)):
+            out[i] = string_to_response("ok")
+        return table.with_column("reply", out)
+
+
+def test_overload_burn_alert_drives_autoscaler_and_shedder():
+    faultinject.clear_plan()
+    # chaos seam (io/faultinject.py): every POST is held 150 ms at the
+    # door — deadline-carrying requests arrive already expired and are
+    # SHED (504, SLI-bad), while deadline-free ones ride the slow
+    # pipeline to an over-SLO served reply (SLI-bad WITH an exemplar)
+    faultinject.install_plan([{"site": "server.handle", "kind": "latency",
+                               "match": "POST", "delay_ms": 150.0}])
+    srv = ServingServer(port=0)
+    # aggressive monitor: one window pair, fires on the first bad batch
+    srv.slo = SLOMonitor(
+        SLOConfig(target=0.99, latency_slo_ms=50.0,
+                  windows=(("fast", 60.0, 5.0, 2.0),),
+                  sample_min_gap_s=0.0, min_events=4.0,
+                  posture_margin=0.5),
+        label_filter={"server": {srv.server_label}}, name=srv.server_label)
+    srv.slo.observe(get_registry().snapshot(), force=True)  # baseline
+    eng = ContinuousServingEngine(srv, _Slow()).start()
+    clear_events()
+    try:
+        for i in range(3):  # served over-SLO (slow pipeline)
+            req = urllib.request.Request(srv.address, data=b"x%d" % i,
+                                         method="POST")
+            with urllib.request.urlopen(req, timeout=10) as r:
+                assert r.status == 200
+        shed_504 = 0
+        for i in range(3):  # fault-expired at the door: shed
+            headers = {DEADLINE_HEADER:
+                       str(int((time.time() + 0.05) * 1e3))}
+            req = urllib.request.Request(srv.address, data=b"d%d" % i,
+                                         method="POST", headers=headers)
+            try:
+                with urllib.request.urlopen(req, timeout=10) as r:
+                    assert r.status == 200
+            except urllib.error.HTTPError as e:
+                assert e.code == 504
+                shed_504 += 1
+        assert shed_504 == 3  # the fault plan did its job
+        # the whole sequence is visible at /slo (the GET also samples)...
+        _get_json(srv.address + "/slo")
+        status = _get_json(srv.address + "/slo")
+        assert status["windows"][0]["active"] is True, status
+        assert status["defensive"] is True
+        assert status["shed_margin"] == 0.5        # the SHEDDER escalated
+        # ... in the telemetry ring, with a trace exemplar pointing at a
+        # concrete slow request in /traces
+        breaches = [e for e in recent_events()
+                    if e["method"] == "slo_breach"]
+        assert breaches, "breach event missing from the telemetry ring"
+        tid = breaches[-1].get("trace_id")
+        assert tid, breaches[-1]
+        kept = {t["trace_id"]
+                for t in tracing.get_tracer().snapshot()["traces"]}
+        assert tid in kept                          # exemplar resolves
+        # ... and the AUTOSCALER treats the burn as a breach signal even
+        # though the served-latency p99 looks fine
+        class _Adapter:
+            ups = 0
+
+            def observe(self):
+                return FleetObservation(
+                    p99_s=0.001, queue_wait_s=0.0, n_workers=1,
+                    burn=srv.slo.fast_burn_active())
+
+            def scale_up(self):
+                self.ups += 1
+                return True
+
+            def scale_down(self):
+                return False
+
+        adapter = _Adapter()
+        auto = Autoscaler(adapter, LifecycleConfig(
+            breach_ticks=2, cooldown_up_s=0.0, max_workers=4))
+        assert auto.tick(now=1.0) is None           # hysteresis tick 1
+        assert auto.tick(now=2.0) == "up"           # burn-driven scale-up
+        assert adapter.ups == 1
+        assert auto.decisions[-1]["burn"] is True
+    finally:
+        faultinject.clear_plan()
+        eng.stop()
+
+
+# ---------------------------------------------------------------------------
+# cost-aware shedding: under 429-pressure the expensive work sheds first
+# ---------------------------------------------------------------------------
+
+def test_expensive_first_shed_with_seeded_mix():
+    srv = ServingServer(port=0, reply_timeout=2.0)
+    srv.note_batch(1, 0.05)               # service EWMA: 50 ms / request
+    srv.note_batch_cost(1e9, 1, 1000)     # cost model: 1e6 FLOPs / byte
+    assert srv.estimated_request_cost(10_000) > srv.estimated_request_cost(1)
+    statuses = {}
+    lock = threading.Lock()
+
+    def post(name, body, rem_s):
+        headers = {DEADLINE_HEADER:
+                   str(int((time.time() + rem_s) * 1e3))}
+        req = urllib.request.Request(srv.address, data=body, method="POST",
+                                     headers=headers)
+        try:
+            with urllib.request.urlopen(req, timeout=10) as r:
+                code = r.status
+        except urllib.error.HTTPError as e:
+            code = e.code
+        except Exception:
+            code = 0
+        with lock:
+            statuses[name] = code
+
+    # seeded mix: four EXPENSIVE requests (10 KB bodies) queue up with
+    # generous deadlines (no engine drains them)
+    big = [threading.Thread(target=post,
+                            args=(f"big{i}", b"B" * 10_000, 1.5))
+           for i in range(4)]
+    for t in big:
+        t.start()
+    for _ in range(100):                   # wait until all four queued
+        if len(srv._queue) >= 4:
+            break
+        time.sleep(0.01)
+    assert len(srv._queue) >= 4
+    # a CHEAP request arrives with 120 ms left: the queue estimate ahead
+    # of it (4 x 50 ms = 200 ms) exceeds its deadline, so admission must
+    # displace expensive queued work instead of shedding the newcomer
+    cheap = threading.Thread(target=post, args=("cheap", b"c", 0.12))
+    cheap.start()
+    cheap.join(timeout=5)
+    for t in big:
+        t.join(timeout=5)
+    # snapshot BEFORE close(): close retires this server's shed series
+    snap = get_registry().snapshot()
+    srv.close()
+    # the two most expensive victims got honest 429s (reason="cost"),
+    # the cheap request was ADMITTED (it then 504s at its deadline with
+    # no engine running — but it was never cost-shed)
+    assert sorted(statuses[f"big{i}"] for i in range(4)).count(429) == 2, \
+        statuses
+    assert statuses["cheap"] == 504, statuses
+    shed = snap["families"]["smt_serving_shed_total"]
+    by_label = {tuple(s["labels"]): s["value"] for s in shed["series"]}
+    assert by_label.get((srv.server_label, "cost"), 0) == 2
+
+
+# ---------------------------------------------------------------------------
+# adaptive micro-batch sizing from live signals
+# ---------------------------------------------------------------------------
+
+def test_choose_batch_size_law():
+    srv = ServingServer(port=0)
+    try:
+        assert choose_batch_size(srv, 64, 0.1) == 64   # cold EWMA: as before
+        srv.note_batch(1, 0.01)                        # svc = 10 ms
+        assert choose_batch_size(srv, 64, 0.1) == 10   # latency mode
+        assert choose_batch_size(srv, 4, 0.1) == 4     # bounded by max
+        assert choose_batch_size(srv, 64, 0.0) == 64   # disabled target
+        srv.note_batch(1, 10.0)                        # very slow pipeline
+        assert choose_batch_size(srv, 64, 0.1) == 1    # floor at 1
+        # backlog mode: the queue alone blows 2x the target -> throughput
+        srv._svc_ewma_s = 0.01
+        srv._queue.extend(f"r{i}" for i in range(100))
+        assert choose_batch_size(srv, 64, 0.1) == 64
+    finally:
+        srv._queue.clear()
+        srv.close()
+
+
+def test_chosen_batch_size_gauge_recorded():
+    srv = ServingServer(port=0)
+    eng = MicroBatchServingEngine(srv, _Echo(), interval=0.005).start()
+    try:
+        req = urllib.request.Request(srv.address, data=b"g", method="POST")
+        with urllib.request.urlopen(req, timeout=10) as r:
+            assert r.status == 200
+        label = srv.server_label
+    finally:
+        eng.stop()
+    # the gauge existed while serving (series retired on stop, so assert
+    # against the family created in the shared registry)
+    fam = get_registry().snapshot()["families"].get(
+        "smt_serving_chosen_batch_size")
+    assert fam is not None and fam["type"] == "gauge"
+    assert fam["labelnames"] == ["server", "engine"]
+    assert all(s["labels"][0] != label for s in fam["series"])  # retired
+
+
+# ---------------------------------------------------------------------------
+# per-request cost attribution (in-process fast path)
+# ---------------------------------------------------------------------------
+
+class _JitCost(Transformer):
+    """Runs a profiled jit per batch so the cost accumulator moves."""
+
+    def __init__(self):
+        super().__init__()
+        from synapseml_tpu.observability.profiling import profiled_jit
+
+        self._fn = profiled_jit(lambda x: x @ x, name="test.slo_cost")
+
+    def _transform(self, table):
+        x = np.ones((16, 16), np.float32)
+        self._fn(x)
+        reqs = table["request"]
+        out = np.empty(len(reqs), dtype=object)
+        for i, r in enumerate(reqs):
+            out[i] = string_to_response("ok")
+        return table.with_column("reply", out)
+
+
+def test_cost_attribution_in_process():
+    srv = ServingServer(port=0)
+    eng = ContinuousServingEngine(srv, _JitCost()).start()
+    try:
+        for _ in range(2):
+            req = urllib.request.Request(srv.address, data=b"x" * 100,
+                                         method="POST")
+            with urllib.request.urlopen(req, timeout=30) as r:
+                assert r.status == 200
+        snap = get_registry().snapshot()
+        fam = snap["families"].get("smt_request_flops")
+        assert fam is not None
+        mine = [s for s in fam["series"]
+                if s["labels"][0] == srv.server_label]
+        assert mine and mine[0]["count"] >= 1
+        assert mine[0]["sum"] > 0                   # real FLOPs attributed
+        # the cost model behind expensive-first shedding warmed up too
+        assert srv.estimated_request_cost(100) > 0
+        # and the REQUEST span carries its FLOPs share in /traces
+        traces = tracing.get_tracer().snapshot()["traces"]
+        spans = [s for t in traces for s in t["spans"]
+                 if s["name"] == "request"
+                 and s["attributes"].get("server") == srv.server_label]
+        assert any((s["attributes"].get("flops") or 0) > 0 for s in spans)
+    finally:
+        eng.stop()
+
+
+# ---------------------------------------------------------------------------
+# cost attribution e2e across REAL worker processes
+# ---------------------------------------------------------------------------
+
+def test_cost_attribution_through_process_fleet():
+    """The request span recorded in a WORKER PROCESS carries its FLOPs
+    share, the fleet-merged ``smt_request_flops`` histogram carries the
+    samples (with exemplars), and the front door's ``/slo`` sees the
+    fleet's traffic — the whole attribution path across a process
+    boundary."""
+    from synapseml_tpu.io.serving_v2 import ProcessServingFleet
+
+    sys.path.insert(0, _REPO)
+    from tests.serving_fault_stage import JitBurnReply
+
+    fleet = ProcessServingFleet(
+        JitBurnReply(), n_workers=1,
+        import_modules=["tests.serving_fault_stage"],
+        reply_timeout=60.0, startup_timeout=180.0)
+    try:
+        for i in range(2):
+            req = urllib.request.Request(fleet.address + "/",
+                                         data=b"c%d" % i, method="POST")
+            with urllib.request.urlopen(req, timeout=60) as r:
+                assert r.status == 200
+        snap = fleet.metrics_snapshot()
+        fam = snap["families"].get("smt_request_flops")
+        assert fam is not None, sorted(snap["families"])
+        total = sum(s["count"] for s in fam["series"])
+        assert total >= 2
+        assert sum(s["sum"] for s in fam["series"]) > 0
+        assert any(s.get("exemplars") for s in fam["series"])
+        # request spans from the worker process carry the attribution
+        traces = fleet.traces_snapshot()["traces"]
+        req_spans = [s for t in traces for s in t["spans"]
+                     if s["name"] == "request"]
+        assert any((s["attributes"].get("flops") or 0) > 0
+                   for s in req_spans), req_spans
+        # the fleet /slo endpoint accounts the same traffic
+        status = _get_json(fleet.address + "/slo")
+        assert status["fleet"] is True
+        assert status["budget"]["total_events"] >= 2
+        # the autoscaler's adapter feeds the ROUTER's monitor (not a
+        # private one): hedge suppression and the posture gauge react to
+        # a burn even when nobody polls /slo
+        auto = fleet.start_autoscaler()
+        assert auto.adapter.slo is fleet.router.slo
+    finally:
+        fleet.stop()

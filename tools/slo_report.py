@@ -1,0 +1,126 @@
+#!/usr/bin/env python
+"""Budget / burn-rate report for the ``/slo`` endpoint.
+
+Reads a live serving server or routing front door (the front door serves
+the FLEET view, computed from merged worker snapshots like ``/metrics``)
+or a saved JSON payload, and renders the error-budget ledger, the
+multi-window burn rates with their alert state, and the breach history
+(each breach carries the trace-id exemplar that links it to ``/traces``):
+
+    python tools/slo_report.py http://127.0.0.1:8888        # live server
+    python tools/slo_report.py http://127.0.0.1:8888/slo    # same
+    python tools/slo_report.py saved_slo.json               # saved JSON
+    python tools/slo_report.py http://fleet:9000 --check    # exit 2 on burn
+
+Stdlib-only and import-hygiene-gated (``tests/test_import_hygiene.py``):
+pointing it at a production front door must never drag jax into the
+process doing the looking.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Any, Dict
+
+BAR_WIDTH = 40
+
+
+def load_payload(source: str, timeout: float = 10.0) -> Dict[str, Any]:
+    """``/slo`` payload from a URL (``/slo`` appended when the path does
+    not already end there) or a local JSON file."""
+    if source.startswith("http://") or source.startswith("https://"):
+        import urllib.request
+
+        url = source
+        if not url.rstrip("/").endswith("/slo"):
+            url = url.rstrip("/") + "/slo"
+        with urllib.request.urlopen(url, timeout=timeout) as r:
+            return json.loads(r.read().decode())
+    with open(source) as f:
+        return json.load(f)
+
+
+def _budget_bar(remaining: float) -> str:
+    filled = int(round(max(0.0, min(remaining, 1.0)) * BAR_WIDTH))
+    return "[" + "#" * filled + "." * (BAR_WIDTH - filled) + "]"
+
+
+def _fmt_window_s(s: float) -> str:
+    if s >= 86400:
+        return f"{s / 86400:g}d"
+    if s >= 3600:
+        return f"{s / 3600:g}h"
+    if s >= 60:
+        return f"{s / 60:g}m"
+    return f"{s:g}s"
+
+
+def render(payload: Dict[str, Any], out=None) -> None:
+    out = out or sys.stdout
+    name = payload.get("name", "?")
+    scope = "fleet" if payload.get("fleet") else "server"
+    print(f"SLO {name}  ({scope}"
+          + (f", {payload['workers']} workers" if "workers" in payload
+             else "") + ")", file=out)
+    print(f"  objective: {payload.get('target')} success ratio, "
+          f"latency SLO {payload.get('latency_slo_ms')} ms", file=out)
+    b = payload.get("budget") or {}
+    rem = float(b.get("remaining_fraction") or 0.0)
+    print(f"  budget  {_budget_bar(rem)} {rem:6.1%} remaining  "
+          f"({b.get('bad_events', 0):g} bad / {b.get('total_events', 0):g} "
+          f"total over {_fmt_window_s(float(b.get('window_s') or 0.0))})",
+          file=out)
+    posture = "DEFENSIVE" if payload.get("defensive") else "normal"
+    print(f"  posture {posture}  (shed margin "
+          f"{payload.get('shed_margin')})", file=out)
+    print(f"  {'window':<8} {'long':>6} {'short':>6} {'threshold':>9} "
+          f"{'burn(long)':>10} {'burn(short)':>11}  state", file=out)
+    for w in payload.get("windows") or []:
+        state = "FIRING" if w.get("active") else "ok"
+        print(f"  {w.get('window', '?'):<8} "
+              f"{_fmt_window_s(float(w.get('long_s') or 0)):>6} "
+              f"{_fmt_window_s(float(w.get('short_s') or 0)):>6} "
+              f"{w.get('threshold'):>9} "
+              f"{w.get('burn_long') if w.get('burn_long') is not None else '-':>10} "
+              f"{w.get('burn_short') if w.get('burn_short') is not None else '-':>11}  "
+              f"{state}", file=out)
+    breaches = payload.get("breaches") or []
+    if breaches:
+        print(f"  breaches ({len(breaches)}):", file=out)
+        for br in breaches:
+            tid = br.get("trace_id")
+            print(f"    {br.get('window', '?'):<8} burn "
+                  f"{br.get('burn_long')}/{br.get('burn_short')} "
+                  f"(>= {br.get('threshold')})"
+                  + (f"  trace {tid}" if tid else ""), file=out)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="budget/burn report for /slo payloads")
+    ap.add_argument("source", help="endpoint URL (…/slo implied) or a "
+                                   "saved JSON file")
+    ap.add_argument("--json", action="store_true",
+                    help="dump the payload as JSON instead")
+    ap.add_argument("--check", action="store_true",
+                    help="exit 2 when any burn alert is firing (or the "
+                         "defensive posture is active) — CI/cron probe")
+    args = ap.parse_args(argv)
+
+    payload = load_payload(args.source)
+    if args.json:
+        json.dump(payload, sys.stdout, indent=2)
+        print()
+    else:
+        render(payload)
+    if args.check and (payload.get("defensive")
+                       or any(w.get("active")
+                              for w in payload.get("windows") or [])):
+        return 2
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -83,9 +83,11 @@ def _attrs_summary(s: Dict[str, Any]) -> str:
     keep = []
     # hedge/hedged/hedge_winner: the router tags both attempts of a
     # hedged request and which target won the race
+    # flops/hbm_bytes: per-request (request spans) and per-batch (pipeline
+    # spans) device cost attributed by the serving engines
     for k in ("stage", "target", "server", "status", "engine", "batch_size",
-              "hedge", "hedged", "hedge_winner", "attempt",
-              "error", "url", "trace_dir", "bytes"):
+              "hedge", "hedged", "hedge_winner", "attempt", "flops",
+              "hbm_bytes", "error", "url", "trace_dir", "bytes"):
         if k in attrs:
             v = str(attrs[k])
             keep.append(f"{k}={v[:60]}")
