@@ -12,14 +12,19 @@ Output formats:
   (``upload-sarif``), so findings land in the repo's Security tab with
   rule metadata attached.
 
-``--device`` additionally runs the jaxpr-level device pack (SMT1xx,
-``rules_device``) over its canonical entry points — the ONLY mode that
-imports jax; the default run stays jax-free (enforced by
-``tests/test_import_hygiene.py``).
+``--device`` additionally runs the jaxpr-level device pack (SMT10x,
+``rules_device``) over its canonical entry points, and ``--spmd`` the
+sharding-aware SPMD pack (SMT11x, ``rules_spmd``) over its
+layout-parameterized entries — the ONLY modes that import jax; the
+default run stays jax-free (enforced by ``tests/test_import_hygiene.py``).
 
-Exit codes: 0 clean (waived findings allowed), 1 unwaived findings or
-unparseable files, 2 configuration errors (unknown rule, reasonless
-waiver, missing path).
+``--changed-only`` scopes per-file AST rules to ``git diff --name-only``
+files (cross-module rules keep whole-repo scope) — the pre-commit loop.
+
+Exit codes: 0 clean (waived findings allowed), 1 unwaived findings,
+unparseable files, or — on a default full-repo run, where staleness is
+judgeable — stale waiver rows; 2 configuration errors (unknown rule,
+reasonless waiver, missing path).
 """
 
 from __future__ import annotations
@@ -47,9 +52,34 @@ def _default_paths() -> List[str]:
     return [p for p in paths if os.path.exists(p)]
 
 
+def _git_changed_files() -> Optional[List[str]]:
+    """Repo-relative paths of modified + untracked files (``git diff
+    --name-only HEAD`` ∪ ``git ls-files --others``), or None when git is
+    unavailable — the ``--changed-only`` scope."""
+    import subprocess
+
+    root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    out: List[str] = []
+    for cmd in (["git", "-C", root, "diff", "--name-only", "HEAD"],
+                ["git", "-C", root, "ls-files", "--others",
+                 "--exclude-standard"]):
+        try:
+            r = subprocess.run(cmd, capture_output=True, text=True,
+                               timeout=30)
+        except (OSError, subprocess.TimeoutExpired):
+            return None
+        if r.returncode != 0:
+            return None
+        out.extend(line.strip() for line in r.stdout.splitlines()
+                   if line.strip())
+    return sorted(set(out))
+
+
 def _rule_listing() -> str:
     from . import rules as _rules  # noqa: F401 — populate the registry
-    from . import rules_device as _rd  # noqa: F401 — SMT1xx codes
+    from . import rules_device as _rd  # noqa: F401 — SMT10x codes
+    from . import rules_spmd as _rs  # noqa: F401 — SMT11x codes
 
     lines = []
     for code in sorted(RULES):
@@ -173,8 +203,15 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--select", default=None,
                     help="comma-separated rule codes (default: all)")
     ap.add_argument("--device", action="store_true",
-                    help="also run the jaxpr-level device pack (SMT1xx) "
+                    help="also run the jaxpr-level device pack (SMT10x) "
                          "over its canonical entry points; imports jax")
+    ap.add_argument("--spmd", action="store_true",
+                    help="also run the sharding-aware SPMD pack (SMT11x) "
+                         "over representative SpecLayouts; imports jax")
+    ap.add_argument("--changed-only", action="store_true",
+                    help="scope per-file AST rules to `git diff "
+                         "--name-only` files (cross-module rules stay "
+                         "whole-repo); the pre-commit loop")
     ap.add_argument("--acks", default=None,
                     help="waiver file (default: LINT_ACKS.md found walking "
                          "up from the first path)")
@@ -190,11 +227,19 @@ def main(argv: Optional[List[str]] = None) -> int:
     paths = args.paths or _default_paths()
     select = ([c.strip().upper() for c in args.select.split(",") if c.strip()]
               if args.select else None)
+    changed_files = None
+    if args.changed_only:
+        changed_files = _git_changed_files()
+        if changed_files is None:
+            print("error: --changed-only needs a git checkout (git diff "
+                  "--name-only failed)", file=sys.stderr)
+            return 2
     t0 = time.perf_counter()
     try:
         report = analyze_paths(paths, select=select, acks_path=args.acks,
                                use_acks=not args.no_acks,
-                               device=args.device)
+                               device=args.device, spmd=args.spmd,
+                               changed_files=changed_files)
     except (LintConfigError, FileNotFoundError) as e:
         print(f"error: {e}", file=sys.stderr)
         return 2
@@ -202,4 +247,10 @@ def main(argv: Optional[List[str]] = None) -> int:
      "sarif": render_sarif}[args.format](report, sys.stdout)
     if args.format == "text":
         print(f"({time.perf_counter() - t0:.2f}s)", file=sys.stderr)
-    return 1 if (report["findings"] or report["errors"]) else 0
+    # stale waiver rows fail the gate ONLY on a default full-repo run —
+    # the one invocation where every judged rule saw every file, so an
+    # unused row really is stale rather than merely out of scope
+    fail_stale = (not args.paths and not args.changed_only
+                  and not args.no_acks and report["unused_waivers"])
+    return 1 if (report["findings"] or report["errors"]
+                 or fail_stale) else 0

@@ -367,40 +367,66 @@ def analyze_paths(paths: Sequence[str],
                   use_acks: bool = True,
                   root: Optional[str] = None,
                   device: bool = False,
-                  device_entries: Optional[Sequence[object]] = None
+                  device_entries: Optional[Sequence[object]] = None,
+                  spmd: bool = False,
+                  spmd_entries: Optional[Sequence[object]] = None,
+                  changed_files: Optional[Sequence[str]] = None
                   ) -> Dict[str, object]:
     """Run the (selected) rule pack over ``paths``.
 
     ``device=True`` additionally runs the jaxpr-level device pack
-    (``rules_device``, SMT1xx) over its canonical entry points — the only
-    mode that imports jax; the default AST run never does.
+    (``rules_device``, SMT1xx) over its canonical entry points;
+    ``spmd=True`` the sharding-aware SPMD pack (``rules_spmd``, SMT11x)
+    over its layout-parameterized entries — the only modes that import
+    jax; the default AST run never does.
+
+    ``changed_files`` (repo-relative posix paths, e.g. from ``git diff
+    --name-only``) scopes per-file AST rules to those files while
+    cross-module rules (``finalize`` overridden) keep whole-repo scope —
+    their findings only exist relative to the full scanned set. Scoped
+    runs cannot judge waiver staleness, so ``unused_waivers`` is empty.
 
     Returns a report dict: ``findings`` (unwaived), ``waived``,
     ``unused_waivers``, ``errors`` (unparseable files), ``n_files``.
     """
     # rules register on import of the sibling modules; import here so the
     # engine is usable standalone in tests with a hand-built registry.
-    # rules_device registers its SMT1xx codes (for --select/--list-rules)
-    # but stays inert — and jax-free — unless device=True.
+    # rules_device / rules_spmd register their SMT1xx codes (for
+    # --select/--list-rules) but their trace rules stay inert — and
+    # jax-free — unless device=True / spmd=True.
     from . import rules as _rules  # noqa: F401
     from . import rules_device as _rules_device  # noqa: F401
+    from . import rules_spmd as _rules_spmd  # noqa: F401
 
     codes = sorted(RULES) if not select else sorted(select)
     unknown = [c for c in codes if c not in RULES]
     if unknown:
         raise LintConfigError(f"unknown rule code(s): {', '.join(unknown)}; "
                               f"known: {', '.join(sorted(RULES))}")
-    if select and not device:
-        # an explicitly selected device rule can only fire under --device;
-        # running it without the flag would print "0 findings" forever —
-        # a permanently-green gate is worse than a config error
-        dev_selected = [c for c in codes
-                        if c in _rules_device.DEVICE_RULES]
-        if dev_selected and len(dev_selected) == len(codes):
+
+    def _ast_judgeable(code: str) -> bool:
+        """Can this run produce findings for ``code``? Trace-only rules
+        (inert AST hooks) need their pack flag; rules with a live AST
+        half always can."""
+        if getattr(RULES[code], "ast_active", True):
+            return True
+        if code in _rules_device.DEVICE_RULES:
+            return device
+        if code in _rules_spmd.SPMD_RULES:
+            return spmd
+        return True
+
+    if select:
+        # an explicitly selected trace-only rule can only fire under its
+        # pack flag; running it without one would print "0 findings"
+        # forever — a permanently-green gate is worse than a config error
+        dead = [c for c in codes if not _ast_judgeable(c)]
+        if dead and len(dead) == len(codes):
             raise LintConfigError(
-                f"rule(s) {', '.join(dev_selected)} are device rules "
-                f"(jaxpr-level) and require --device to run; without it "
-                f"this selection can never produce a finding")
+                f"rule(s) {', '.join(dead)} are trace rules (jaxpr-level) "
+                f"and require --device (SMT10x) or --spmd (SMT11x) to "
+                f"run; without the flag this selection can never produce "
+                f"a finding")
     if use_acks and acks_path is None:
         acks_path = default_acks_path(list(paths))
     if root is None and use_acks and acks_path is not None:
@@ -412,15 +438,26 @@ def analyze_paths(paths: Sequence[str],
     findings: List[Finding] = []
     errors: List[str] = []
     files = iter_python_files(paths, root=root)
+    changed: Optional[set] = None
+    if changed_files is not None:
+        changed = {str(p).replace(os.sep, "/") for p in changed_files}
+        # cross-module rules stay whole-repo: their findings (duplicate
+        # stage names, ...) only exist relative to the complete set, so
+        # scoping them to the diff would silently blind the gate
+        cross = {c for c in codes
+                 if type(RULES[c]).finalize is not Rule.finalize}
     for code in codes:
         RULES[code].begin()
     for path, rel in files:
+        if changed is not None and rel not in changed and not cross:
+            continue
         try:
             module = Module.parse(path, rel)
         except (SyntaxError, UnicodeDecodeError) as e:
             errors.append(f"{rel}: {e.__class__.__name__}: {e}")
             continue
-        for code in codes:
+        run_codes = codes if changed is None or rel in changed else cross
+        for code in run_codes:
             findings.extend(RULES[code].check(module))
     for code in codes:
         findings.extend(RULES[code].finalize())
@@ -429,11 +466,26 @@ def analyze_paths(paths: Sequence[str],
             entries=device_entries, select=codes, root=root)
         findings.extend(dev_findings)
         errors.extend(dev_errors)
+    if spmd:
+        spmd_findings, spmd_errors = _rules_spmd.run_spmd_pack(
+            entries=spmd_entries, select=codes, root=root)
+        findings.extend(spmd_findings)
+        errors.extend(spmd_errors)
     findings.sort()
     waivers: List[Waiver] = []
     if use_acks and acks_path is not None:
         waivers = load_waivers(acks_path)
     unwaived, waived, unused = apply_waivers(findings, waivers)
+    # a waiver row is only STALE when this run could have produced the
+    # finding it waives: a scoped run sees a slice of the repo, and a
+    # trace-only rule's rows (SMT10x/SMT11x) are invisible to AST-only
+    # runs — reporting those as unused would flag every reasoned spmd
+    # waiver on every default run. Rows naming an unknown rule code are
+    # always stale (the rule was deleted; the row must go too).
+    unused = [w for w in unused
+              if w.rule not in RULES
+              or (changed is None and w.rule in codes
+                  and _ast_judgeable(w.rule))]
     return {"findings": unwaived, "waived": waived,
             "unused_waivers": unused, "errors": errors,
             "n_files": len(files), "acks_path": acks_path,

@@ -141,7 +141,10 @@ def register_device(cls):
 class DeviceRule(Rule):
     """A rule over traced entry points instead of source modules. The AST
     hook is inert — device rules only produce findings when the device
-    pass runs (``--device``)."""
+    pass runs (``--device``). ``ast_active = False`` tells the engine an
+    AST-only run cannot judge these rules' waiver rows stale."""
+
+    ast_active = False
 
     def check(self, module: Module) -> Iterable[Finding]:
         return []

@@ -1388,6 +1388,73 @@ def _cached_step(obj_key, *, cfg, C, lr, boosting, d, cat_idx, ff, bf, bfreq,
                        n_eval=n_eval)
 
 
+def spmd_trace_pair(n: int = 192, d: int = 24, shards: Optional[int] = None,
+                    seed: int = 0):
+    """The sparse training step in BOTH configurations, for differential
+    static analysis — the exact shape ``test_sparse_mesh_matches_single_
+    device`` exercises, reduced to its traceable core.
+
+    ``analysis/rules_spmd.py`` (SMT112/SMT113) and ``tools/spmd_diff.py``
+    trace the two callables with ``jax.make_jaxpr`` and diff the
+    canonicalized jaxprs: the first structurally divergent region is
+    where a mesh-vs-single parity bisection starts. Returns
+    ``(mesh, single)`` dicts — ``{"fn", "args"}`` plus the mesh side's
+    ``"layout"`` — where ``fn`` is the UNWRAPPED step
+    (``ProfiledJit._fn``: the shard_map-wrapped ``sharded_iter`` vs the
+    bare ``one_iter``), so tracing never touches the AOT machinery.
+    Tracing only — nothing here compiles or runs on devices.
+    """
+    import jax
+
+    from ..runtime.layout import SpecLayout
+    from .sparse import CSRMatrix, build_sparse_binned, shard_sparse_binned
+
+    if shards is None:
+        shards = min(4, len(jax.devices()))
+    if n % shards:
+        raise ValueError(f"n={n} must divide evenly over {shards} shards "
+                         f"(wrapped padding would obscure the trace diff)")
+
+    rng = np.random.default_rng(seed)
+    mask = rng.random((n, d)) < 0.3
+    dense = np.where(mask, rng.normal(size=(n, d)), 0.0)
+    indptr = np.zeros(n + 1, np.int64)
+    np.cumsum(mask.sum(axis=1), out=indptr[1:])
+    csr = CSRMatrix(indptr, np.nonzero(mask)[1], dense[mask], (n, d))
+    mapper = BinMapper(max_bin=16).fit_csr(csr)
+
+    cfg = TreeConfig(n_bins=mapper.realized_n_bins, num_leaves=4)
+    pp = dict(_DEFAULTS, objective="binary")
+    _, grad_fn = _resolve_objective(pp)
+    # ff/bf at 1.0: the single-device step touches NO RNG, so every
+    # random-bits eqn in the diff is mesh-side by construction (the
+    # per-shard fold_in) — the known, reasoned divergence
+    common = dict(grad_fn=grad_fn, cfg=cfg, C=1, lr=0.1, boosting="gbdt",
+                  d=d, cat_idx=None, ff=1.0, bf=1.0, bfreq=0,
+                  use_goss=False, top_rate=0.2, other_rate=0.1,
+                  model_axis=None)
+
+    y = (rng.random(n) < 0.5).astype(np.float32)
+    w = np.ones(n, np.float32)
+    raw0 = np.zeros((n, 1), np.float32)
+    key, fkey = jax.random.PRNGKey(0), jax.random.PRNGKey(1)
+
+    layout = SpecLayout.build(data=shards, model_axis=None)
+    sb_host, local = shard_sparse_binned(csr, mapper, shards, (-n) % shards)
+    step_mesh = _build_step(mesh=layout, axis=layout.data_axis,
+                            sparse_meta=(d, cfg.n_bins, local,
+                                         sb_host.max_run), **common)
+    step_single = _build_step(mesh=None, axis="data", sparse_meta=None,
+                              **common)
+    sb_single = build_sparse_binned(csr, mapper)
+    mesh_side = {"fn": step_mesh._fn,
+                 "args": (sb_host, y, w, raw0, key, fkey),
+                 "layout": layout}
+    single_side = {"fn": step_single._fn,
+                   "args": (sb_single, y, w, raw0, key, fkey)}
+    return mesh_side, single_side
+
+
 def train(params: Dict[str, Any], x: np.ndarray, y: Optional[np.ndarray] = None,
           weight: Optional[np.ndarray] = None,
           eval_set: Optional[Sequence[Tuple[np.ndarray, np.ndarray]]] = None,

@@ -139,6 +139,7 @@ class OnnxFunction:
         # re-pins it (with_sharding_constraint), so the intent survives
         # however jit stages the closure constants.
         self.layout = layout
+        self._const_plan: List[Dict[str, Any]] = []
         self._const_specs: Dict[str, Any] = (
             self._plan_const_specs() if layout is not None
             and getattr(layout, "model_size", 1) > 1 else {})
@@ -225,20 +226,60 @@ class OnnxFunction:
         for f in self.functions.values():
             scan(f)
         layout = self.layout
+        m = layout.model_size
         specs: Dict[str, Any] = {}
+
+        def record(name: str, decision: str, reason: str) -> None:
+            # residency ledger for placement_report(): shape/bytes are
+            # captured NOW, while the constant is still a host array
+            # (after __init__ the sharded ones are device-resident)
+            const = self.constants[name]
+            self._const_plan.append({
+                "tensor": name, "shape": tuple(const.shape),
+                "nbytes": int(const.nbytes),
+                "decision": decision, "reason": reason})
+
         for name, rs in roles.items():
             if len(rs) != 1 or None in rs:
-                continue  # conflicting / non-weight use: replicate
+                kinds = sorted(str(r) for r in rs)
+                record(name, "replicated",
+                       f"consumer-role conflict ({', '.join(kinds)}) — no "
+                       f"single shardable role; tied/multi-use weight")
+                continue
             kind, dim = next(iter(rs))
             const = self.constants[name]
             if not np.issubdtype(const.dtype, np.floating):
+                record(name, "replicated",
+                       f"non-float dtype {const.dtype} (shape operand / "
+                       f"index table)")
                 continue
-            if const.shape[dim] % layout.model_size:
-                continue  # indivisible output dim: replicate
+            if const.shape[dim] % m:
+                record(name, "replicated",
+                       f"{kind} dim {dim} size {const.shape[dim]} not "
+                       f"divisible by model={m}")
+                continue
             specs[name] = (layout.conv_weight(rank=const.ndim)
                            if kind == "conv"
                            else layout.col_weight(rank=const.ndim, dim=dim))
+            record(name, "sharded",
+                   f"{kind} weight: dim {dim} over model={m}")
+        for name in self.constants:
+            if name not in roles:
+                record(name, "replicated",
+                       "no weight-role consumer (bias / norm param / "
+                       "unconsumed initializer)")
         return specs
+
+    def placement_report(self) -> List[Dict[str, Any]]:
+        """Per-initializer residency decisions under the tensor-parallel
+        layout, largest tensor first — each row names the tensor, its
+        host-side footprint, and WHY the planner sharded or replicated it.
+        Empty without a populated model axis (nothing to shard across).
+        The SPMD lint pack (``analysis/rules_spmd.py`` SMT110) turns every
+        large replicated row into a finding, so the planner's silent
+        "replicate on conflict" choices surface before they cost HBM."""
+        return sorted((dict(r) for r in self._const_plan),
+                      key=lambda r: (-r["nbytes"], r["tensor"]))
 
     # -- execution ---------------------------------------------------------------
 

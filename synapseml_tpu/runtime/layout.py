@@ -35,7 +35,7 @@ from __future__ import annotations
 import dataclasses
 from typing import Any, Optional, Sequence, Tuple
 
-__all__ = ["SpecLayout", "as_layout"]
+__all__ = ["SpecLayout", "as_layout", "representative_layouts"]
 
 _UNSET = object()
 
@@ -259,6 +259,35 @@ class SpecLayout:
 from ..core.serialization import register_state_class
 
 register_state_class(SpecLayout)
+
+
+def representative_layouts(devices=None) -> dict:
+    """The canonical layout matrix static analysis traces under.
+
+    The SPMD lint pack (``analysis/rules_spmd.py``) and ``tools/
+    spmd_diff.py`` need REPRESENTATIVE layouts, not whatever this host
+    happens to have: ``(1,1)`` (the degenerate single-chip mesh every
+    program must tolerate), ``(1,2)-tp`` (tensor-parallel serving — the
+    model axis populated, SMT110's replication hazard live), and
+    ``(4,2)-fp`` (the 2-D feature-parallel GBDT shape). Each degrades
+    gracefully to the devices actually present (a 1-chip host still
+    traces everything, with axis sizes collapsed to 1) so the pack runs
+    identically on a laptop and an 8-chip pod slice.
+    """
+    if devices is None:
+        import jax
+
+        devices = jax.devices()
+    devices = list(devices)
+    n = len(devices)
+    m = 2 if n >= 2 else 1
+    d = 4 if n >= 4 * m else max(1, n // m)
+    return {
+        "(1,1)": SpecLayout.build(data=1, model=1, devices=devices[:1]),
+        "(1,2)-tp": SpecLayout.build(data=1, model=m, devices=devices[:m]),
+        "(4,2)-fp": SpecLayout.build(data=d, model=m,
+                                     devices=devices[:d * m]),
+    }
 
 
 def as_layout(mesh_or_layout, data_axis: str = "data") -> SpecLayout:

@@ -107,6 +107,43 @@ def is_tpu() -> bool:
     return jax.devices()[0].platform == "tpu"
 
 
+def require_backend(want: Optional[str] = None, *,
+                    allow_cpu: bool = False) -> "ClusterInfo":
+    """Assert the resolved jax backend is an accelerator — LOUDLY.
+
+    jax falls back to CPU silently when the TPU runtime is absent,
+    unclaimed, or shadowed by ``JAX_PLATFORMS`` — and every benchmark,
+    SLO probe, and training job downstream then measures the wrong
+    machine while reporting success. This is the fail-fast gate: call it
+    once at process start (bench refuses CPU rounds through it) and a
+    mis-provisioned environment dies with a diagnostic naming what was
+    found and which knobs select the backend, instead of publishing
+    CPU numbers.
+
+    ``want`` pins a specific platform (``"tpu"``, ``"gpu"``); the default
+    accepts any non-CPU accelerator. ``allow_cpu=True`` turns the check
+    into a pass-through (the explicit opt-in path — tests, laptops).
+    Returns the :class:`ClusterInfo` snapshot so callers can stamp it.
+    """
+    info = cluster_info()
+    if allow_cpu:
+        return info
+    plat = info.platform
+    if plat == "cpu" or (want is not None and plat != want):
+        wanted = want or "an accelerator (tpu/gpu)"
+        raise RuntimeError(
+            f"resolved jax backend is {plat!r} "
+            f"(kinds={list(info.device_kinds)}, "
+            f"devices={info.num_devices}) but {wanted} is required.\n"
+            f"  JAX_PLATFORMS={os.environ.get('JAX_PLATFORMS', '<unset>')}\n"
+            f"  XLA_FLAGS={os.environ.get('XLA_FLAGS', '<unset>')}\n"
+            f"likely causes: TPU runtime not installed / already claimed "
+            f"by another process / JAX_PLATFORMS pinning cpu. Probe with "
+            f"`python tools/check_device.py`; pass allow_cpu=True (bench: "
+            f"--allow-cpu) only to deliberately measure the host.")
+    return info
+
+
 def best_mesh_shape(n_devices: int, n_axes: int) -> Tuple[int, ...]:
     """Factor ``n_devices`` into ``n_axes`` balanced axes, sorted largest-first.
 

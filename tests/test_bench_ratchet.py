@@ -287,3 +287,64 @@ def test_error_strings_capped():
             os.path.abspath(__file__))), "bench.py")) as f:
         src = f.read()
     assert "[:300]" in src
+
+
+# ---------------------------------------------------------------------------
+# stale BENCH_ACKS rows are CI failures; CPU rounds are refused loudly
+# ---------------------------------------------------------------------------
+
+def test_stale_waiver_round_without_artifact(tmp_path):
+    _write_round(tmp_path, 7, {"resnet50_onnx": {}})
+    stale = bench.stale_waivers(here=str(tmp_path),
+                                waivers={(9, "resnet50_onnx")})
+    assert len(stale) == 1 and stale[0][:2] == (9, "resnet50_onnx")
+    assert "no committed BENCH_r" in stale[0][2]
+
+
+def test_stale_waiver_unknown_lane(tmp_path):
+    _write_round(tmp_path, 7, {"resnet50_onnx": {}})
+    stale = bench.stale_waivers(here=str(tmp_path),
+                                waivers={(7, "resnet50_onxx")})
+    assert len(stale) == 1 and "unknown lane" in stale[0][2]
+    # gate-prefixed rows judge the lane AFTER stripping mfu:/flat:
+    assert bench.stale_waivers(here=str(tmp_path),
+                               waivers={(7, "mfu:resnet50_onnx"),
+                                        (7, "flat:serving_latency"),
+                                        (7, "gbdt_adult_scale")}) == []
+
+
+def test_committed_bench_acks_have_no_stale_rows():
+    """The gate: every committed BENCH_ACKS.md row must still waive a
+    committed round and a lane the bench stamps — dead rows silently
+    re-arm as blanket suppressions if the lane name ever comes back."""
+    assert bench.stale_waivers() == []
+
+
+def test_bench_refuses_cpu_round():
+    """`python bench.py` on a CPU-resolved backend must stamp a refusal
+    (exit 2, value null, no lane numbers) instead of publishing host
+    throughput as accelerator history."""
+    import subprocess
+    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("BENCH_ALLOW_CPU", None)
+    r = subprocess.run([sys.executable, os.path.join(here, "bench.py")],
+                       capture_output=True, text=True, timeout=300, env=env)
+    assert r.returncode == 2, r.stdout + r.stderr
+    doc = json.loads(r.stdout.strip().splitlines()[-1])
+    assert doc["value"] is None and doc["vs_baseline"] is None
+    assert "refused" in doc["extra"]
+    assert doc["extra"]["platform"] == "cpu"
+    assert "allow-cpu" in doc["extra"]["refused"]
+
+
+def test_cpu_refusal_artifact_shape():
+    """The refusal keeps the one-JSON-line stdout contract: same headline
+    metric key, null value, and no per-lane numbers the ratchet or MFU
+    gates could mistake for measurements."""
+    from synapseml_tpu.runtime.topology import require_backend
+    doc = bench._cpu_refusal(require_backend(allow_cpu=True))
+    json.dumps(doc)  # serializable
+    assert doc["metric"] == "resnet50_onnx_images_per_sec_per_chip"
+    assert doc["value"] is None
+    assert not any(k in doc["extra"] for k in bench._PRIMARY)

@@ -23,6 +23,9 @@ _GATED_MODULES = [
     # device rules are LAZY: SMT1xx codes register at import for
     # --select/--list-rules, jax is reached only at --device run time
     "synapseml_tpu.analysis.rules_device",
+    # spmd rules likewise: SMT11x codes register at import, jax is
+    # reached only at --spmd run time
+    "synapseml_tpu.analysis.rules_spmd",
     "synapseml_tpu.core.clock",
     "synapseml_tpu.core.lazyimport",
     "synapseml_tpu.core.schema",  # Pipeline.validate must stay plan-time
@@ -71,7 +74,7 @@ _TOOLS_DIR = os.path.join(
 # artifacts; they must stay jax-free (tools/ is not a package — imported
 # via a path entry)
 _GATED_TOOLS = ["trace_dump", "lint", "perf_diff", "perf_timeline",
-                "slo_report"]
+                "slo_report", "spmd_diff", "check_device"]
 
 
 def test_no_jax_at_import():

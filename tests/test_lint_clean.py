@@ -872,10 +872,14 @@ def test_subtree_invocation_matches_waivers():
         f"{f.location}: {f.code}" for f in report["findings"]]
     # the reviewed waiver set: the shard_map compat shim, the two SMT008
     # nodes for observability/__init__'s eager (but import-pure,
-    # hygiene-gated) import of the profiling hook module, and the two
+    # hygiene-gated) import of the profiling hook module, the two
     # SMT007 `p.wait()` sites under ProcessServingFleet's coarse mutator
-    # mutex (blocking under it is the design — see LINT_ACKS.md)
+    # mutex (blocking under it is the design — see LINT_ACKS.md), the two
+    # SMT112 host-binning guards in gbdt/boost.py (ROADMAP item 2 debt),
+    # and the three SMT114 refusal-inventory rows (boost.py, grow.py)
     assert sorted(set(f.path for f in report["waived"])) == [
+        "synapseml_tpu/gbdt/boost.py",
+        "synapseml_tpu/gbdt/grow.py",
         "synapseml_tpu/io/serving_v2.py",
         "synapseml_tpu/observability/__init__.py",
         "synapseml_tpu/runtime/topology.py",
@@ -893,6 +897,58 @@ def test_full_repo_zero_unwaived_findings():
     assert report["unused_waivers"] == [], report["unused_waivers"]
     # acceptance: full repo in seconds (generous bound for a loaded box)
     assert elapsed < 20.0, f"lint took {elapsed:.1f}s"
+
+
+def test_cli_stale_waiver_fails_default_full_run(tmp_path):
+    """A LINT_ACKS row that matches nothing is a blanket suppression in
+    waiting — the default full-repo run (the CI invocation) must fail on
+    it, while scoped runs (explicit paths) tolerate it: their rule set
+    saw only a slice of the repo, so 'unused' there proves nothing."""
+    with open(ACKS) as f:
+        rows = f.read()
+    # the acks file's directory anchors waiver-matched paths, so the
+    # doctored copy must sit at the repo root to keep real rows matching
+    acks = os.path.join(REPO_ROOT, "LINT_ACKS.stale-test.md")
+    with open(acks, "w") as f:
+        f.write(rows + "| SMT001 | synapseml_tpu/gone_module.py | - |"
+                " file was deleted last quarter |\n")
+    try:
+        # default full run: everything judged -> stale row fails the gate
+        assert lint_main(["--acks", acks]) == 1
+        # scoped run, same acks: out-of-scope, not provably stale
+        assert lint_main([os.path.join(REPO_ROOT, "synapseml_tpu"),
+                          "--acks", acks]) == 0
+    finally:
+        os.unlink(acks)
+    # and the committed acks file itself must carry no stale rows
+    assert lint_main([]) == 0
+
+
+def test_cli_changed_only_runs_jax_free():
+    """`--changed-only` scopes AST rules to git-diff files; it must stay
+    jax-free (it is the pre-commit path) and exit clean on a tree whose
+    changed files carry no unwaived findings."""
+    code = ("import sys\n"
+            "from synapseml_tpu.analysis.cli import main\n"
+            "rc = main(['--changed-only'])\n"
+            "bad = [m for m in sys.modules if m == 'jax' "
+            "or m.startswith('jax.')]\n"
+            "assert rc == 0 and not bad, (rc, bad[:3])\n")
+    proc = subprocess.run([sys.executable, "-c", code], cwd=REPO_ROOT,
+                          capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stderr
+
+
+def test_changed_files_scope_skips_unchanged(tmp_path):
+    """Findings in files OUTSIDE the changed set must not surface, while
+    the same finding in a changed file must."""
+    (tmp_path / "touched.py").write_text("import jax\n")
+    (tmp_path / "untouched.py").write_text("import jax\n")
+    report = analyze_paths([str(tmp_path)], select=["SMT001"],
+                           use_acks=False, changed_files=["touched.py"])
+    assert [f.path for f in report["findings"]] == ["touched.py"]
+    # scoped runs cannot judge staleness: no unused-waiver reporting
+    assert report["unused_waivers"] == []
 
 
 def test_cli_runs_jax_free():

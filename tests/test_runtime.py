@@ -254,3 +254,77 @@ def test_graft_entry_dryrun_multichip_in_process():
         g.dryrun_multichip(8)
     finally:
         sys.path.remove("/root/repo")
+
+
+# ---------------------------------------------------------------------------
+# loud device acquisition: require_backend + tools/check_device.py
+# ---------------------------------------------------------------------------
+
+def test_require_backend_allow_cpu_passes_through():
+    from synapseml_tpu.runtime.topology import require_backend
+
+    info = require_backend(allow_cpu=True)  # conftest pins cpu
+    assert info.platform == "cpu" and info.num_devices >= 1
+
+
+def test_require_backend_refuses_cpu_with_diagnostic():
+    from synapseml_tpu.runtime.topology import require_backend
+
+    with pytest.raises(RuntimeError) as ei:
+        require_backend()
+    msg = str(ei.value)
+    # the diagnostic must name what was found and where to go next
+    assert "'cpu'" in msg
+    assert "JAX_PLATFORMS" in msg and "XLA_FLAGS" in msg
+    assert "tools/check_device.py" in msg and "allow_cpu" in msg
+
+
+def test_require_backend_want_pins_platform():
+    from synapseml_tpu.runtime.topology import require_backend
+
+    with pytest.raises(RuntimeError, match="tpu"):
+        require_backend(want="tpu")
+
+
+def _check_device_main(monkeypatch, probe_code, args):
+    import importlib
+    import os
+    import sys
+
+    tools = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools")
+    monkeypatch.syspath_prepend(tools)
+    monkeypatch.setenv("SMT_DEVICE_PROBE_CODE", probe_code)
+    check_device = importlib.import_module("check_device")
+    return check_device.main(list(args))
+
+
+_FAKE_CPU = ('import json; print(json.dumps({"platform": "cpu", '
+             '"device_kinds": ["cpu"], "num_devices": 1, "num_hosts": 1}))')
+_FAKE_TPU = ('import json; print(json.dumps({"platform": "tpu", '
+             '"device_kinds": ["TPU v4"], "num_devices": 8, '
+             '"num_hosts": 1}))')
+
+
+def test_check_device_exit_codes(monkeypatch, capsys):
+    # accelerator present -> 0; cpu -> 1 unless --allow-cpu; wrong
+    # platform under --want -> 1
+    assert _check_device_main(monkeypatch, _FAKE_TPU, []) == 0
+    assert _check_device_main(monkeypatch, _FAKE_CPU, []) == 1
+    assert _check_device_main(monkeypatch, _FAKE_CPU, ["--allow-cpu"]) == 0
+    assert _check_device_main(monkeypatch, _FAKE_TPU,
+                              ["--want", "gpu"]) == 1
+    out = capsys.readouterr()
+    assert '"platform": "tpu"' in out.out  # probe JSON relayed
+
+
+def test_check_device_probe_crash_is_exit_2(monkeypatch, capsys):
+    code = 'import sys; sys.exit("libtpu_discovery failed")'
+    assert _check_device_main(monkeypatch, code, []) == 2
+    assert "libtpu_discovery failed" in capsys.readouterr().err
+
+
+def test_check_device_hang_is_exit_3_not_a_hang(monkeypatch, capsys):
+    code = "import time; time.sleep(300)"
+    assert _check_device_main(monkeypatch, code, ["--timeout", "1"]) == 3
+    assert "hung" in capsys.readouterr().err

@@ -8,9 +8,14 @@ no paths the CLI lints the whole repo (defaults resolve against the
 package location, not the cwd). Same flags, same exit codes (0 clean, 1
 findings, 2 config error). ``--format sarif`` emits the GitHub
 code-scanning upload schema; ``--device`` additionally runs the
-jaxpr-level device pack (SMT1xx) over the canonical ``profiled_jit``
-entry points — the ONE mode that imports jax; the default run stays
-jax-free (enforced by ``tests/test_import_hygiene.py``).
+jaxpr-level device pack (SMT10x) over the canonical ``profiled_jit``
+entry points and ``--spmd`` the sharding-aware SPMD pack (SMT110–113)
+over representative ``SpecLayout`` meshes — the ONLY modes that import
+jax; the default run stays jax-free (enforced by
+``tests/test_import_hygiene.py``). ``--changed-only`` scopes per-file
+AST rules to ``git diff`` files (cross-module rules keep whole-repo
+scope) for fast pre-commit runs; stale ``LINT_ACKS.md`` rows fail only
+the default full-repo invocation, where staleness is actually provable.
 """
 
 import os

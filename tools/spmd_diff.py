@@ -1,0 +1,150 @@
+#!/usr/bin/env python3
+"""Differential jaxpr diff: mesh configuration vs single-device twin.
+
+``python tools/spmd_diff.py --entry 'gbdt.grow[sparse,mesh]'`` traces the
+named entry point BOTH ways (the mesh-configured ``shard_map`` program
+and the same computation on one device), canonicalizes the two jaxprs
+(collectives that must differ are stripped, wrapper primitives are made
+transparent, dimension sizes are alpha-renamed per line), and prints the
+structurally divergent regions — the bisection instrument for
+mesh-vs-single parity failures like
+``test_sparse_mesh_matches_single_device``: instead of staring at two
+~900-eqn traces, start at the first hunk this tool names.
+
+``--list`` prints the entries that carry a single-device twin. ``--json``
+emits the machine-readable report (the committed golden in
+``tests/artifacts/spmd_diff_sparse_golden.json`` pins the sparse entry's
+divergence so it can only change deliberately). Exit 0 when the traces
+are structurally identical, 1 when they diverge, 2 on usage errors.
+
+Import discipline: stdlib-only at import (enforced by
+``tests/test_import_hygiene.py``); jax loads only when an entry is
+actually traced. Tracing is abstract — nothing compiles or touches
+devices, so this runs on a jax-less-looking CPU box in seconds.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List, Optional
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_HUNK_CONTEXT = 2  # shared lines echoed around each hunk in text output
+
+
+def _load_pack():
+    if _REPO_ROOT not in sys.path:
+        sys.path.insert(0, _REPO_ROOT)
+    from synapseml_tpu.analysis import rules_spmd
+
+    # a bare CLI process would otherwise init jax with ONE cpu device and
+    # trace degenerate (1,1) layouts — set the virtual-device flag before
+    # jax first loads so the representative meshes are actually 2-D
+    rules_spmd._ensure_virtual_devices()
+    return rules_spmd
+
+
+def diff_entry(name: str) -> dict:
+    """Trace ``name`` both ways and return the structural diff report:
+    ``{"entry", "mesh_eqns", "single_eqns", "identical", "hunks": [...]}``
+    with each hunk's indices and mesh-only/single-only line runs."""
+    rules_spmd = _load_pack()
+    entries = {e.name: e for e in rules_spmd.default_spmd_entries()}
+    if name not in entries:
+        raise KeyError(
+            f"unknown entry {name!r}; known: {', '.join(sorted(entries))}")
+    traced = rules_spmd.trace_spmd_entry(entries[name])
+    if traced.single is None:
+        raise KeyError(
+            f"entry {name!r} has no single-device twin to diff against; "
+            f"differential entries: "
+            f"{', '.join(rules_spmd.differential_entry_names())}")
+    mesh_lines = rules_spmd.canonical_lines(traced.closed)
+    single_lines = rules_spmd.canonical_lines(traced.single)
+    d = rules_spmd.structural_diff(mesh_lines, single_lines)
+    report = {
+        "entry": name,
+        "mesh_eqns": len(mesh_lines),
+        "single_eqns": len(single_lines),
+        "identical": d is None,
+        "hunks": [] if d is None else d["hunks"],
+    }
+    if d is not None:
+        report["first_divergence"] = d["index"]
+        report["common_suffix"] = d["common_suffix"]
+        report["_mesh_lines"] = mesh_lines  # text renderer context only
+    return report
+
+
+def _render_text(report: dict, out) -> None:
+    name = report["entry"]
+    if report["identical"]:
+        print(f"{name}: mesh and single-device traces are structurally "
+              f"identical ({report['mesh_eqns']} vs "
+              f"{report['single_eqns']} canonical eqns)", file=out)
+        return
+    hunks = report["hunks"]
+    mesh_lines = report.get("_mesh_lines", [])
+    print(f"{name}: {len(hunks)} divergent region"
+          f"{'' if len(hunks) == 1 else 's'} "
+          f"(mesh {report['mesh_eqns']} eqns, single "
+          f"{report['single_eqns']} eqns; first divergence after "
+          f"{report['first_divergence']} shared eqns, "
+          f"{report['common_suffix']} shared after the last)", file=out)
+    for k, h in enumerate(hunks, 1):
+        print(f"\nhunk {k} @ mesh eqn {h['mesh_index']}, single eqn "
+              f"{h['single_index']}:", file=out)
+        lo = max(0, h["mesh_index"] - _HUNK_CONTEXT)
+        for line in mesh_lines[lo:h["mesh_index"]]:
+            print(f"    {line}", file=out)
+        for line in h["mesh_only"]:
+            print(f"  M {line}", file=out)
+        for line in h["single_only"]:
+            print(f"  S {line}", file=out)
+        hi = h["mesh_index"] + len(h["mesh_only"])
+        for line in mesh_lines[hi:hi + _HUNK_CONTEXT]:
+            print(f"    {line}", file=out)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python tools/spmd_diff.py",
+        description="Structural mesh-vs-single-device jaxpr diff (the "
+                    "SMT113 instrument as a CLI).")
+    ap.add_argument("--entry", default=None,
+                    help="entry point to diff (see --list)")
+    ap.add_argument("--list", action="store_true",
+                    help="list entries with a single-device twin")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit the machine-readable report")
+    args = ap.parse_args(argv)
+
+    if args.list:
+        rules_spmd = _load_pack()
+        for name in rules_spmd.differential_entry_names():
+            print(name)
+        return 0
+    if not args.entry:
+        ap.print_usage(sys.stderr)
+        print("error: --entry (or --list) is required", file=sys.stderr)
+        return 2
+    try:
+        report = diff_entry(args.entry)
+    except KeyError as e:
+        print(f"error: {e.args[0]}", file=sys.stderr)
+        return 2
+    if args.as_json:
+        json.dump({k: v for k, v in report.items()
+                   if not k.startswith("_")}, sys.stdout, indent=2)
+        sys.stdout.write("\n")
+    else:
+        _render_text(report, sys.stdout)
+    return 0 if report["identical"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
