@@ -701,6 +701,119 @@ def bench_serving_overload(platform):
     }
 
 
+def bench_multi_tenant_serving(platform):
+    """Multi-tenant isolation cost: per-model throughput of an UNCONTENDED
+    tenant on a shared 3-model :class:`MultiTenantServingEngine` — with a
+    co-resident hog tenant under sustained load — vs the same pipeline
+    served single-tenant. Each tenant runs its own dispatcher and queue
+    slice, so a neighbor's service time must not tax the others;
+    ``uncontended_throughput_ratio`` (shared/single, 1.0 = tenancy is
+    free; the acceptance floor is 0.9) is the primary the ratchet gate
+    watches."""
+    import threading
+    import urllib.request
+
+    from synapseml_tpu.core.stage import Transformer
+    from synapseml_tpu.io.serving import ServingServer, string_to_response
+    from synapseml_tpu.io.serving_v2 import (ContinuousServingEngine,
+                                             MultiTenantServingEngine)
+    from synapseml_tpu.io.tenancy import MODEL_HEADER
+
+    class Echo(Transformer):
+        def _transform(self, table):
+            reqs = table["request"]
+            out = np.empty(len(reqs), dtype=object)
+            for i, r in enumerate(reqs):
+                out[i] = string_to_response((r.entity or b"").decode())
+            return table.with_column("reply", out)
+
+    # the hog is EXPENSIVE per request (not chatty): 20 ms of service
+    # time each, so its queue runs deep while its request RATE — and so
+    # its share of the shared door's interpreter time — stays modest.
+    # That is the placement layer's heavy-tenant profile; a chatty
+    # cheap tenant is the co-location case, not the one to isolate.
+    hog_per_req_s = 0.02
+
+    class Hog(Transformer):
+        def _transform(self, table):
+            time.sleep(hog_per_req_s * table.num_rows)
+            n = table.num_rows
+            out = np.empty(n, dtype=object)
+            out[:] = [string_to_response("busy")] * n
+            return table.with_column("reply", out)
+
+    def _one(addr, model=None, timeout=10):
+        headers = {MODEL_HEADER: model} if model else {}
+        req = urllib.request.Request(addr, data=b"x", method="POST",
+                                     headers=headers)
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            r.read()
+
+    def measure(addr, model=None, n_requests=200, n_threads=4):
+        """Closed-loop throughput (req/s) — identical client either way,
+        so the ratio isolates the tenancy layer's cost."""
+        def hit():
+            for _ in range(n_requests // n_threads):
+                _one(addr, model)
+
+        _one(addr, model)  # warm
+        threads = [threading.Thread(target=hit) for _ in range(n_threads)]
+        t0 = time.perf_counter()
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        return n_requests / (time.perf_counter() - t0)
+
+    def best_of(fn, k=3):
+        # throughput = capacity: the max of k passes sheds transient
+        # host stalls (GC, scheduler) that would otherwise make the
+        # ratio a noise measurement on a busy CI box
+        return max(fn() for _ in range(k))
+
+    # single-tenant baseline: the same Echo pipeline, no tenancy layer
+    srv = ServingServer(port=0)
+    eng = ContinuousServingEngine(srv, Echo()).start()
+    try:
+        single = best_of(lambda: measure(srv.address))
+    finally:
+        eng.stop()
+
+    # the shared fleet: two cheap tenants + one hog under sustained load
+    srv2 = ServingServer(port=0)
+    eng2 = MultiTenantServingEngine(
+        srv2, {"hog": Hog(), "t1": Echo(), "t2": Echo()}).start()
+    stop = threading.Event()
+
+    def hammer_hog():
+        while not stop.is_set():
+            try:
+                _one(srv2.address, "hog")
+            except Exception:
+                pass  # the hog's own fate is not what this lane measures
+
+    hammers = [threading.Thread(target=hammer_hog, daemon=True)
+               for _ in range(2)]
+    try:
+        for h in hammers:
+            h.start()
+        time.sleep(0.1)  # the hog queue is busy before we measure
+        shared = best_of(lambda: measure(srv2.address, model="t1"))
+    finally:
+        stop.set()
+        for h in hammers:
+            h.join(timeout=10)
+        eng2.stop()
+
+    return {
+        "single_tenant_req_per_sec": round(single, 1),
+        "uncontended_req_per_sec": round(shared, 1),
+        "contended_model": "hog",
+        "uncontended_throughput_ratio": round(shared / max(single, 1e-9),
+                                              3),
+    }
+
+
 def bench_swap_under_load(platform):
     """Zero-downtime hot swap: p99 during a rolling ``swap()`` vs steady
     state, at sustained offered load over a 3-worker in-process fleet.
@@ -1380,6 +1493,7 @@ _PRIMARY = {
     "flash_attention_gqa": "tflops_nominal",
     "onnx_tp_sharding": "rows_per_sec",
     "serving_overload": "p99_collapse_ratio",
+    "multi_tenant_serving": "uncontended_throughput_ratio",
     "swap_under_load": "swap_p99_ratio",
     "worker_warm_start": "warm_start_speedup",
 }
@@ -1502,6 +1616,8 @@ def main(argv=None) -> int:
         ("onnx_tp_sharding", lambda: bench_onnx_tp(platform, peak)),
         ("serving_latency", lambda: bench_serving(platform)),
         ("serving_overload", lambda: bench_serving_overload(platform)),
+        ("multi_tenant_serving",
+         lambda: bench_multi_tenant_serving(platform)),
         ("swap_under_load", lambda: bench_swap_under_load(platform)),
         ("worker_warm_start", lambda: bench_worker_warm_start(platform)),
         ("observability_span_overhead", lambda: bench_span_overhead(platform)),

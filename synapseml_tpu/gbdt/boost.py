@@ -1744,10 +1744,12 @@ def train(params: Dict[str, Any], x: np.ndarray, y: Optional[np.ndarray] = None,
         max_cat_threshold=int(p["max_cat_threshold"]),
         parallelism="voting" if parallelism.startswith("voting") else "data",
         top_k=int(p["top_k"]),
-        # multiclass vmaps grow_tree: a vmapped lax.switch runs every buffer
-        # branch (~2n/step), so leaf-local only pays off single-class
+        # multiclass vmaps grow_tree: a vmapped lax.switch would run every
+        # buffer branch (~2n/step), so C > 1 switches to the branch-free
+        # fixed covering buffer instead of giving the fast path up
         # (sparse growth is already leaf-transient by construction)
-        leaf_local=bool(p["leaf_local"]) and C == 1 and not sparse_in,
+        leaf_local=bool(p["leaf_local"]) and not sparse_in,
+        leaf_buf_fixed=C > 1,
     )
     cat_mask_np = None
     if has_cat:

@@ -59,10 +59,15 @@ class TreeConfig(NamedTuple):
     # split leaf): gather the SMALLER child's rows into a static power-of-2
     # buffer picked by lax.switch, histogram the buffer, and derive the other
     # side by parent subtraction — work per split is proportional to the
-    # split leaf, not to n. Keep False under vmap (multiclass): a vmapped
-    # switch executes every branch, costing ~2n per step.
+    # split leaf, not to n.
     leaf_local: bool = False
     leaf_buf_min: int = 1024    # smallest gather buffer (rows)
+    # Under vmap (multiclass) a vmapped lax.switch executes EVERY buffer
+    # branch (~2n per step, worse than the full scan). leaf_buf_fixed
+    # drops the ladder for ONE static buffer covering the largest possible
+    # child (~n/2 rows): still roughly half the full-data scan plus the
+    # parent subtract, and branch-free so it vmaps cleanly.
+    leaf_buf_fixed: bool = False
 
 
 class GrownTree(NamedTuple):
@@ -236,6 +241,11 @@ def grow_tree(binned, grad, hess, row_weight, feature_mask, cfg: TreeConfig,
                                            chunk=cfg.hist_chunk)
                 return br
 
+            if cfg.leaf_buf_fixed:
+                # branch-free single buffer (multiclass/vmap mode): the
+                # covering size always fits, so the switch — which a vmap
+                # would execute in EVERY branch — is simply not built
+                return make_branch(sizes[-1])(None)
             branch = jnp.minimum((cnt > sizes_arr).sum(), len(sizes) - 1)
             return lax.switch(branch, [make_branch(s) for s in sizes], None)
 

@@ -360,6 +360,22 @@ def healthz(address: str, timeout: float = 2.0) -> Optional[Dict[str, Any]]:
         return None
 
 
+def model_generation(payload: Optional[Dict[str, Any]],
+                     model: Optional[str]) -> Optional[int]:
+    """The generation a ``/healthz`` payload reports for ``model`` — the
+    per-model roll's wait condition on a multi-tenant worker (the payload's
+    ``models`` section carries one lifecycle slot per resident model).
+    Falls back to the top-level generation for single-tenant workers or
+    ``model=None``."""
+    if payload is None:
+        return None
+    if model is not None:
+        models = payload.get("models")
+        if isinstance(models, dict) and model in models:
+            return models[model].get("generation")
+    return payload.get("generation")
+
+
 def post_control(address: str, op: str, payload: Optional[dict] = None,
                  timeout: float = 5.0) -> Tuple[int, bytes]:
     """``POST <address>/control/<op>``; returns (status, body). Transport

@@ -58,6 +58,8 @@ __all__ = [
     "FleetHealth",
     "HealthProber",
     "HedgePolicy",
+    "KeyedBreakerBoards",
+    "KeyedRetryBudgets",
     "ResilienceConfig",
     "RetryBudget",
     "WORKER_STATES",
@@ -532,6 +534,81 @@ class RetryBudget:
         with self._lock:
             self._prune(time.monotonic())
             return len(self._retries)
+
+
+# ---------------------------------------------------------------------------
+# per-model keyed boards (multi-tenant routing, io/tenancy.py)
+# ---------------------------------------------------------------------------
+
+class KeyedBreakerBoards:
+    """A :class:`BreakerBoard` per key (per MODEL at the multi-tenant
+    front door): model A browning out on worker W must open only
+    (A, W)'s breaker — B's traffic to the same worker keeps flowing.
+    Keys come from the bounded model catalog (``io/tenancy.py``), so the
+    board count is bounded by deployment configuration. The default key
+    (``""``) serves untagged single-tenant traffic with exactly the old
+    one-board behavior."""
+
+    def __init__(self, cfg: ResilienceConfig,
+                 slow_s: Optional[Callable[[], Optional[float]]] = None,
+                 on_transition: Optional[Callable[[str, str], None]] = None):
+        self._cfg = cfg
+        self._slow_s = slow_s
+        self._on_transition = on_transition
+        self._lock = threading.Lock()
+        self._boards: Dict[str, BreakerBoard] = {}
+
+    def board(self, key: str = "") -> BreakerBoard:
+        with self._lock:
+            b = self._boards.get(key)
+            if b is None:
+                b = self._boards[key] = BreakerBoard(
+                    self._cfg, slow_s=self._slow_s,
+                    on_transition=self._on_transition)
+            return b
+
+    def keys(self) -> List[str]:
+        with self._lock:
+            return sorted(self._boards)
+
+    def reset(self, target: str) -> None:
+        """Clean breakers for a re-admitted worker on EVERY board (the
+        worker restarted; no tenant's stale history applies)."""
+        with self._lock:
+            boards = list(self._boards.values())
+        for b in boards:
+            b.reset(target)
+
+    def states(self, key: str = "") -> Dict[str, str]:
+        return self.board(key).states()
+
+
+class KeyedRetryBudgets:
+    """A :class:`RetryBudget` per key (per MODEL): one tenant's failover
+    storm spends only its own budget — retries for a browning-out model
+    must not starve a healthy tenant's legitimate failover. Same bounded-
+    key contract as :class:`KeyedBreakerBoards`."""
+
+    def __init__(self, cfg: ResilienceConfig):
+        self._cfg = cfg
+        self._lock = threading.Lock()
+        self._budgets: Dict[str, RetryBudget] = {}
+
+    def budget(self, key: str = "") -> RetryBudget:
+        with self._lock:
+            b = self._budgets.get(key)
+            if b is None:
+                b = self._budgets[key] = RetryBudget(self._cfg)
+            return b
+
+    def keys(self) -> List[str]:
+        with self._lock:
+            return sorted(self._budgets)
+
+    def spent(self) -> Dict[str, int]:
+        with self._lock:
+            items = list(self._budgets.items())
+        return {k: b.spent() for k, b in items}
 
 
 # ---------------------------------------------------------------------------

@@ -39,6 +39,7 @@ from ..runtime.shared import shared_singleton
 from . import faultinject
 from .http_schema import HTTPRequestData, HTTPResponseData
 from .resilience import parse_deadline, remaining_s
+from .tenancy import model_from_request
 
 __all__ = ["ServingServer", "MicroBatchServingEngine", "serve",
            "serve_metrics_exposition", "serve_traces_exposition",
@@ -52,10 +53,11 @@ _logger = get_logger("io.serving")
 
 class _Pending:
     __slots__ = ("request", "response", "event", "t_enqueue", "trace",
-                 "deadline")
+                 "deadline", "model")
 
     def __init__(self, request: HTTPRequestData,
-                 deadline: Optional[float] = None):
+                 deadline: Optional[float] = None,
+                 model: Optional[str] = None):
         self.request = request
         self.response: Optional[HTTPResponseData] = None
         self.event = threading.Event()
@@ -63,6 +65,10 @@ class _Pending:
         # absolute deadline (epoch seconds) parsed from X-SMT-Deadline-Ms;
         # None = the request carries no deadline (legacy clients)
         self.deadline = deadline
+        # the tenant this request belongs to (io/tenancy.py): None on a
+        # single-tenant server. Drives same-model-only displacement and the
+        # per-model metric families
+        self.model = model
         # server-side request span (enqueue -> reply); begun in the handler
         # thread, ended in respond() — continues the client's traceparent
         # when one arrived, else roots a fresh trace
@@ -105,18 +111,42 @@ class ServingServer:
         # displace the most EXPENSIVE queued work first under overload.
         self._cost_per_req: Optional[float] = None
         self._cost_per_byte: Optional[float] = None
+        # multi-tenancy (io/tenancy.py): a multi-model engine attaches its
+        # ModelCatalog here; requests then carry a model id (header or
+        # ?model=) validated against it (404 on unknown — a CLIENT error,
+        # so it never burns SLO budget). ``default_model`` keeps untagged
+        # legacy traffic working. Per-model service/cost EWMAs mirror the
+        # flat ones so the shedder estimates each tenant's OWN queue and
+        # displacement stays within one tenant.
+        self.catalog = None
+        self.default_model: Optional[str] = None
+        self._model_svc: Dict[str, float] = {}
+        self._model_cost_per_req: Dict[str, float] = {}
+        self._model_cost_per_byte: Dict[str, float] = {}
         # fleet-lifecycle wiring (io/lifecycle.py): the engine attaches its
         # generation-tagged pipeline slot here so /healthz can report
         # {state, generation, inflight} and /control/{drain,resume,swap}
         # can drive rolling swaps. ``swap_loader(stage_path)`` produces the
         # new pipeline (default: core.serialization.load_stage);
         # ``swap_prewarm(pipeline)`` runs it once off the request path.
+        # Multi-model workers keep one lifecycle slot PER model in
+        # ``lifecycles`` — a swap of model A flips A's slot and never
+        # touches B's (the tenancy generation contract).
         self.lifecycle = None
+        self.lifecycles: Dict[str, object] = {}
         self.swap_loader = None
         self.swap_prewarm = None
+        self.swap_prewarms: Dict[str, Callable] = {}
+        # tenant admission hooks: the multi-tenant engine host installs
+        # these so /control/load and /control/unload can fault a cataloged
+        # model in (or evict it) at runtime
+        self.tenant_admit = None
+        self.tenant_evict = None
         # the most recent real request: the pre-warm replay sample a swap
-        # uses to compile the incoming pipeline before the flip
+        # uses to compile the incoming pipeline before the flip (per model
+        # on a multi-tenant worker — each tenant pre-warms with ITS shape)
         self.last_request: Optional[HTTPRequestData] = None
+        self.last_request_by_model: Dict[str, HTTPRequestData] = {}
         # drain-then-stop: once set, new work is answered 503 + Retry-After
         # (counted in smt_serving_shed_total{reason=shutdown}) while
         # in-flight requests finish — close() never yanks the listener out
@@ -214,6 +244,34 @@ class ServingServer:
                         except OSError:
                             pass  # client went away
                         return
+                # tenant selection (io/tenancy.py): with a catalog
+                # attached, the model id comes from the X-SMT-Model header
+                # (or ?model=), bounded by the catalog — an UNKNOWN model
+                # is a client error (404 + admission_rejections), never an
+                # SLO-burning shed
+                model: Optional[str] = None
+                if outer.catalog is not None:
+                    model = model_from_request(self.headers, self.path) \
+                        or outer.default_model
+                    if model is None or model not in outer.catalog:
+                        payload = json.dumps({
+                            "error": f"unknown model {model!r}",
+                            "models": outer.catalog.models(),
+                        }).encode()
+                        with outer._lock:
+                            outer.requests_received += 1
+                            outer.admission_rejections += 1
+                        try:
+                            self.send_response(404)
+                            self.send_header("Content-Type",
+                                             "application/json")
+                            self.send_header("Content-Length",
+                                             str(len(payload)))
+                            self.end_headers()
+                            self.wfile.write(payload)
+                        except OSError:
+                            pass
+                        return
                 # deadline-aware load shedding AT THE DOOR: work that
                 # cannot possibly answer in time must never occupy a batch
                 # slot. Requests without the deadline header (legacy
@@ -223,13 +281,17 @@ class ServingServer:
                 if deadline is not None:
                     rem = remaining_s(deadline)
                     if rem <= 0:
-                        outer._shed("expired", count_received=True)
+                        outer._shed("expired", count_received=True,
+                                    model=model)
                         try:
                             self.send_error(504, "deadline already expired")
                         except OSError:
                             pass
                         return
-                    est = outer.estimated_queue_wait_s()
+                    # per-tenant estimate: only the arriving model's OWN
+                    # queue counts against its deadline — another tenant's
+                    # backlog must not shed this one's traffic
+                    est = outer.estimated_queue_wait_s(model)
                     # posture escalation (observability/slo.py): with the
                     # error budget near exhaustion the margin drops below
                     # 1.0 and shedding starts BEFORE the queue estimate
@@ -241,10 +303,13 @@ class ServingServer:
                         # the newcomer, try displacing strictly MORE
                         # EXPENSIVE queued work (per-stage cost EWMA) —
                         # under 429-pressure the costly requests shed
-                        # first, not whoever arrived last
+                        # first, not whoever arrived last. Displacement is
+                        # SAME-MODEL only: one tenant's overload displaces
+                        # only its own queue.
                         if not outer._admit_by_displacement(
-                                body, est, allowed):
-                            outer._shed("overload", count_received=True)
+                                body, est, allowed, model=model):
+                            outer._shed("overload", count_received=True,
+                                        model=model)
                             try:
                                 self.send_response(429)
                                 self.send_header(
@@ -261,19 +326,24 @@ class ServingServer:
                 # the swap pre-warm replay sample (a torn read is impossible
                 # — this is a single reference assignment)
                 outer.last_request = req
+                if model is not None:
+                    outer.last_request_by_model[model] = req
                 rid = uuid.uuid4().hex
-                slot = _Pending(req, deadline=deadline)
+                slot = _Pending(req, deadline=deadline, model=model)
                 if tracing.is_enabled():
+                    attrs = {"server": outer.server_label,
+                             "method": method, "path": self.path}
+                    if model is not None:
+                        attrs["model"] = model
                     slot.trace = tracing.get_tracer().begin_span(
                         "request",
                         parent=tracing.extract_context(req.headers),
-                        attributes={"server": outer.server_label,
-                                    "method": method, "path": self.path})
+                        attributes=attrs)
                 with outer._lock:
                     outer._pending[rid] = slot
                     outer._queue.append(rid)
                     outer.requests_received += 1
-                outer._on_enqueue()
+                outer._on_enqueue(model)
                 # never park past the request's own deadline: a client with
                 # 200ms left gets its 504 in 200ms, not reply_timeout later
                 wait_s = outer.reply_timeout
@@ -294,7 +364,7 @@ class ServingServer:
                             # wait was deadline-bounded): count the shed
                             # here — the drain-time path only sees slots
                             # this handler has not already reclaimed
-                            outer._shed("expired")
+                            outer._shed("expired", model=slot.model)
                         if slot.trace is not None:
                             slot.trace.set_attribute("status", 504)
                             slot.trace.end(error="serving engine timed out")
@@ -349,6 +419,9 @@ class ServingServer:
             # reply events for up to reply_timeout) — source of the fatal-exit
             # flake when a test tears down mid-request
             daemon_threads = True
+            # burst headroom: the default backlog (5) TCP-resets overflow
+            # connections instead of letting the shedder answer 429
+            request_queue_size = 128
 
         self._httpd = Server((host, port), Handler)
         self.host, self.port = self._httpd.server_address[:2]
@@ -396,6 +469,25 @@ class ServingServer:
             "smt_serving_shed_total",
             "requests shed by deadline-aware admission",
             ("server", "reason"))
+        # per-MODEL mirrors of the SLI families (io/tenancy.py): the flat
+        # families above keep their fixed (server[,reason]) schemas — every
+        # existing scraper/merge/SLO path is untouched — and a request that
+        # carries a cataloged model id ALSO lands here. Model values are
+        # bounded by the catalog (SMT014-safe); per-model SLO monitors
+        # (label_filter={"model": ...}) read these instead of the flat ones.
+        self._m_model_latency = reg.histogram(
+            "smt_serving_model_latency_seconds",
+            "enqueue->reply latency per tenant model",
+            ("server", "model"))
+        self._m_model_shed = reg.counter(
+            "smt_serving_model_shed_total",
+            "requests shed by deadline-aware admission per tenant model",
+            ("server", "model", "reason"))
+        self._m_model_errors = reg.counter(
+            "smt_serving_model_errors_total",
+            "batches answered 500 per tenant model",
+            ("server", "model"))
+        self._models_seen: set = set()  # label hygiene for close()
         reg.register_collector(self._collect_metrics)
         # device-memory gauges sync at scrape time (graceful no-op until a
         # backend with allocator stats exists): every worker's /metrics
@@ -407,8 +499,11 @@ class ServingServer:
                                         name=f"serving-{self.port}", daemon=True)
         self._thread.start()
 
-    def _on_enqueue(self) -> None:
-        """Hook for push-mode engines (continuous serving overrides)."""
+    def _on_enqueue(self, model: Optional[str] = None) -> None:
+        """Hook for push-mode engines (continuous serving overrides).
+        ``model`` is the arriving request's tenant so a multi-tenant host
+        can wake ONLY that tenant's dispatcher (single-tenant engines
+        ignore it)."""
 
     def _collect_metrics(self) -> None:
         """Snapshot-time sync of the plain-int request counters into the
@@ -421,23 +516,43 @@ class ServingServer:
     def address(self) -> str:
         return f"http://{self.host}:{self.port}"
 
-    def _shed(self, reason: str, count_received: bool = False) -> None:
+    def _shed(self, reason: str, count_received: bool = False,
+              model: Optional[str] = None) -> None:
         """Count one shed request (and, for door-side sheds, the receive —
-        handler threads that return early never hit the normal counters)."""
+        handler threads that return early never hit the normal counters).
+        ``model`` additionally lands the shed in the per-model mirror
+        family — the flat aggregate ALWAYS counts, so single-tenant
+        dashboards and the fleet autoscaler see the same totals."""
         if count_received:
             with self._lock:
                 self.requests_received += 1
         self._m_shed.labels(self.server_label, reason).inc()
+        if model is not None:
+            self._models_seen.add(model)
+            self._m_model_shed.labels(self.server_label, model,
+                                      reason).inc()
 
-    def note_batch(self, n_requests: int, seconds: float) -> None:
+    def note_model_error(self, model: str) -> None:
+        """Per-tenant engines report a 500'd batch here (the per-model
+        mirror of ``smt_serving_pipeline_errors_total``)."""
+        self._models_seen.add(model)
+        self._m_model_errors.labels(self.server_label, model).inc()
+
+    def note_batch(self, n_requests: int, seconds: float,
+                   model: Optional[str] = None) -> None:
         """Engines report each processed batch here; feeds the per-request
         service-time EWMA behind ``estimated_queue_wait_s`` and (rate-
-        limited) the SLO monitor's sample ring."""
+        limited) the SLO monitor's sample ring. ``model`` also updates
+        that tenant's own EWMA — the per-tenant queue-wait estimator."""
         if n_requests <= 0 or seconds < 0:
             return
         per = seconds / n_requests
         cur = self._svc_ewma_s
         self._svc_ewma_s = per if cur is None else 0.8 * cur + 0.2 * per
+        if model is not None:
+            cur = self._model_svc.get(model)
+            self._model_svc[model] = per if cur is None \
+                else 0.8 * cur + 0.2 * per
         try:
             # deferred-snapshot form: a busy engine pays one registry
             # snapshot per sample gap, not one per batch
@@ -446,48 +561,78 @@ class ServingServer:
             _logger.debug("SLO sample failed", exc_info=True)
 
     def note_batch_cost(self, flops: float, n_requests: int,
-                        total_entity_bytes: int) -> None:
+                        total_entity_bytes: int,
+                        model: Optional[str] = None) -> None:
         """Engines report each batch's profiled device cost
         (``observability.profiling.cost_snapshot`` delta). Maintains the
         FLOPs-per-request and FLOPs-per-entity-byte EWMAs behind
-        ``estimated_request_cost`` — the cost-aware shedder's model."""
+        ``estimated_request_cost`` — the cost-aware shedder's model.
+        ``model`` also feeds that tenant's EWMAs AND the attached catalog
+        (``ModelCatalog.note_cost``) — the signal behind cost-driven
+        placement."""
         if flops <= 0 or n_requests <= 0:
             return
         per = flops / n_requests
         cur = self._cost_per_req
         self._cost_per_req = per if cur is None else 0.8 * cur + 0.2 * per
-        if total_entity_bytes > 0:
-            pb = flops / total_entity_bytes
+        pb = flops / total_entity_bytes if total_entity_bytes > 0 else None
+        if pb is not None:
             cur = self._cost_per_byte
             self._cost_per_byte = pb if cur is None \
                 else 0.8 * cur + 0.2 * pb
+        if model is not None:
+            cur = self._model_cost_per_req.get(model)
+            self._model_cost_per_req[model] = per if cur is None \
+                else 0.8 * cur + 0.2 * per
+            if pb is not None:
+                cur = self._model_cost_per_byte.get(model)
+                self._model_cost_per_byte[model] = pb if cur is None \
+                    else 0.8 * cur + 0.2 * pb
+            if self.catalog is not None:
+                self.catalog.note_cost(model, per)
 
-    def estimated_request_cost(self, n_entity_bytes: int) -> float:
+    def estimated_request_cost(self, n_entity_bytes: int,
+                               model: Optional[str] = None) -> float:
         """Estimated device FLOPs for a request with this payload size:
         the per-byte EWMA when the model has one (payload size is the one
         admission-time signal that differentiates requests), else the flat
         per-request EWMA, else 0.0 — on ignorance every request costs the
-        same and the shedder keeps its old arrival-order behavior."""
+        same and the shedder keeps its old arrival-order behavior. With a
+        ``model``, that tenant's own EWMAs are preferred (falling back to
+        the flat ones until its first profiled batch)."""
+        if model is not None:
+            pb = self._model_cost_per_byte.get(model)
+            if pb is not None:
+                return pb * n_entity_bytes
+            per = self._model_cost_per_req.get(model)
+            if per is not None:
+                return per
         pb = self._cost_per_byte
         if pb is not None:
             return pb * n_entity_bytes
         return self._cost_per_req or 0.0
 
     def _admit_by_displacement(self, body: Optional[bytes], est: float,
-                               allowed_s: float) -> bool:
+                               allowed_s: float,
+                               model: Optional[str] = None) -> bool:
         """Cost-aware overload admission: try to admit the arriving
         request by shedding strictly MORE EXPENSIVE queued requests
         (429, ``reason="cost"``) until the queue estimate fits inside
         ``allowed_s``. Only deadline-carrying queued work is displaceable
-        (legacy no-deadline requests keep their never-shed contract).
-        False = displacement cannot free enough: the caller sheds the
-        newcomer exactly as before the cost model existed."""
-        svc = self._svc_ewma_s
+        (legacy no-deadline requests keep their never-shed contract), and
+        only SAME-MODEL work: tenant isolation means one model's overload
+        can never evict another model's queued requests (untagged
+        traffic, ``model=None``, likewise only displaces untagged work —
+        the exact single-tenant behavior). False = displacement cannot
+        free enough: the caller sheds the newcomer exactly as before the
+        cost model existed."""
+        svc = (self._model_svc.get(model) if model is not None else None) \
+            or self._svc_ewma_s
         if svc is None or svc <= 0:
             return False
         need = est - allowed_s
         k = int(need / svc) + 1  # queued requests to displace
-        arriving = self.estimated_request_cost(len(body or b""))
+        arriving = self.estimated_request_cost(len(body or b""), model)
         victims: List[_Pending] = []
         with self._lock:
             cand = []
@@ -495,8 +640,10 @@ class ServingServer:
                 slot = self._pending.get(rid)
                 if slot is None or slot.deadline is None:
                     continue
+                if slot.model != model:
+                    continue  # never displace another tenant's work
                 cost = self.estimated_request_cost(
-                    len(slot.request.entity or b""))
+                    len(slot.request.entity or b""), model)
                 if cost > arriving:
                     cand.append((cost, rid))
             if len(cand) < k:
@@ -506,7 +653,7 @@ class ServingServer:
                 victims.append(self._pending.pop(rid))
                 self._queue.remove(rid)
         for slot in victims:
-            self._shed("cost")
+            self._shed("cost", model=slot.model)
             self._finish(slot, HTTPResponseData(
                 429, "shed for cheaper work under overload",
                 {"Retry-After": "1"}), shed=True)
@@ -529,25 +676,48 @@ class ServingServer:
             _logger.debug("SLO sample failed during /slo", exc_info=True)
         serve_slo_exposition(handler, self.slo.status())
 
-    def estimated_queue_wait_s(self) -> float:
+    def estimated_queue_wait_s(self, model: Optional[str] = None) -> float:
         """Queue depth × observed per-request service time (from the
         engines' per-batch reports): what a request admitted NOW would wait
         before its reply starts. 0.0 until the first batch completes — the
-        estimator must never shed on ignorance."""
-        svc = self._svc_ewma_s
+        estimator must never shed on ignorance. With ``model``, only that
+        tenant's OWN queued requests count (per-tenant engines drain each
+        model's queue independently, so another tenant's backlog is not
+        ahead of this request)."""
+        if model is None:
+            svc = self._svc_ewma_s
+            if svc is None:
+                return 0.0
+            return len(self._queue) * svc
+        svc = self._model_svc.get(model) or self._svc_ewma_s
         if svc is None:
             return 0.0
-        return len(self._queue) * svc
+        with self._lock:
+            depth = sum(1 for rid in self._queue
+                        if (s := self._pending.get(rid)) is not None
+                        and s.model == model)
+        return depth * svc
 
     def attach_lifecycle(self, lifecycle, swap_loader=None,
-                         swap_prewarm=None) -> None:
+                         swap_prewarm=None, model: Optional[str] = None
+                         ) -> None:
         """Wire the engine's generation-tagged pipeline slot
-        (``io/lifecycle.py``) into ``/healthz`` + ``/control/*``."""
-        self.lifecycle = lifecycle
+        (``io/lifecycle.py``) into ``/healthz`` + ``/control/*``. On a
+        multi-model worker each tenant engine attaches with its ``model``
+        — one slot per model, so a swap of one never flips another; the
+        FIRST attached slot also serves as the untagged default."""
+        if model is not None:
+            self.lifecycles[model] = lifecycle
+            if swap_prewarm is not None:
+                self.swap_prewarms[model] = swap_prewarm
+            if self.lifecycle is None:
+                self.lifecycle = lifecycle
+        else:
+            self.lifecycle = lifecycle
+            if swap_prewarm is not None:
+                self.swap_prewarm = swap_prewarm
         if swap_loader is not None:
             self.swap_loader = swap_loader
-        if swap_prewarm is not None:
-            self.swap_prewarm = swap_prewarm
 
     def begin_shutdown(self) -> None:
         """Start refusing new work (503 + Retry-After, counted as
@@ -569,6 +739,12 @@ class ServingServer:
             payload["state"] = "draining"
         payload["inflight"] = self.inflight()
         payload["queue_wait_s"] = round(self.estimated_queue_wait_s(), 6)
+        if self.lifecycles:
+            # the per-tenant view: each resident model's own lifecycle
+            # slot (the fleet's per-model roll waits on models[m].generation)
+            payload["models"] = {m: slot.healthz()
+                                 for m, slot in
+                                 sorted(self.lifecycles.items())}
         body = json.dumps(payload).encode()
         try:
             handler.send_response(200)
@@ -580,14 +756,49 @@ class ServingServer:
             pass
 
     def _serve_control(self, handler, op: str, body) -> None:
-        """``POST /control/{drain,resume,swap}`` — the worker half of the
-        fleet's rolling swap. Answered entirely in the handler thread; the
-        expensive swap work runs on its own thread (lifecycle.swap_async),
-        never here and never on the request path."""
-        lc = self.lifecycle
+        """``POST /control/{drain,resume,swap,load,unload}`` — the worker
+        half of the fleet's rolling swap and, on a multi-tenant worker,
+        the tenant control plane. Every op accepts an optional ``model``
+        in its JSON body: drain/resume/swap then act on THAT model's
+        lifecycle slot only. ``load``/``unload`` fault a cataloged model
+        in / evict it via the engine host's hooks. Answered entirely in
+        the handler thread; the expensive swap work runs on its own
+        thread (lifecycle.swap_async), never here and never on the
+        request path."""
+        try:
+            payload = json.loads((body or b"{}").decode())
+            if not isinstance(payload, dict):
+                payload = {}
+        except Exception:
+            payload = {}
+        model = payload.get("model")
+        if model is not None:
+            lc = self.lifecycles.get(model)
+        else:
+            lc = self.lifecycle
         status, reply = 200, {"ok": True}
-        if lc is None:
-            status, reply = 503, {"error": "no lifecycle attached"}
+        if op in ("load", "unload"):
+            hook = self.tenant_admit if op == "load" else self.tenant_evict
+            if hook is None:
+                status, reply = 503, {"error": "not a multi-tenant worker"}
+            elif model is None:
+                status, reply = 400, {"error": f"{op} needs a model id"}
+            else:
+                try:
+                    if op == "load":
+                        hook(model, payload.get("stage_path"),
+                             int(payload.get("generation", 0)))
+                    else:
+                        hook(model)
+                    reply = {"ok": True, "model": model}
+                except KeyError as e:
+                    status, reply = 404, {"error": str(e)}
+                except Exception as e:
+                    status, reply = 400, {"error": f"{op} failed: {e}"}
+        elif lc is None:
+            status, reply = (404, {"error": f"unknown model {model!r}"}) \
+                if model is not None else \
+                (503, {"error": "no lifecycle attached"})
         elif op == "drain":
             lc.begin_drain()
             reply = lc.healthz()
@@ -596,18 +807,28 @@ class ServingServer:
             reply = lc.healthz()
         elif op == "swap":
             try:
-                payload = json.loads((body or b"{}").decode())
                 stage_path = payload["stage_path"]
                 generation = int(payload["generation"])
             except Exception as e:
                 status, reply = 400, {"error": f"bad swap body: {e}"}
             else:
                 loader = self.swap_loader or _default_swap_loader
+                prewarm = self.swap_prewarm if model is None \
+                    else self.swap_prewarms.get(model)
                 accepted = lc.swap_async(
                     lambda: loader(stage_path), generation,
-                    prewarm=self.swap_prewarm)
+                    prewarm=prewarm)
                 if accepted:
                     status, reply = 202, {"generation": generation}
+                    if model is not None:
+                        reply["model"] = model
+                        # the catalog follows the accepted swap so
+                        # /placement and snapshot() report the NEW
+                        # generation once it lands
+                        if self.catalog is not None \
+                                and model in self.catalog:
+                            self.catalog.bump(model, stage_path,
+                                              generation)
                 else:
                     status, reply = 409, {"error": "a swap is already "
                                                    "in flight"}
@@ -623,33 +844,57 @@ class ServingServer:
         except OSError:
             pass
 
-    def get_requests(self, max_n: Optional[int] = None
+    def get_requests(self, max_n: Optional[int] = None,
+                     model: Optional[str] = None
                      ) -> List[Tuple[str, HTTPRequestData]]:
         """Drain up to ``max_n`` queued request ids (the getBatch analogue).
 
         Queued work whose deadline already passed is shed HERE — answered
         504 immediately and never handed to the engine, so an expired
-        request cannot occupy a batch slot ahead of in-deadline work."""
+        request cannot occupy a batch slot ahead of in-deadline work.
+
+        ``model`` drains only THAT tenant's queued requests (per-tenant
+        engines each pull their own work; other tenants' requests keep
+        their queue positions untouched). ``model=None`` keeps the exact
+        single-tenant drain-the-prefix behavior."""
         now = time.time()
         expired: List[_Pending] = []
         out: List[Tuple[str, HTTPRequestData]] = []
         with self._lock:
-            take = self._queue if max_n is None else self._queue[:max_n]
-            for rid in take:
-                slot = self._pending.get(rid)
-                if slot is None:
-                    continue
-                if slot.deadline is not None and slot.deadline <= now:
-                    # claim the slot HERE (the pop decides the race, same
-                    # rule as respond vs the handler timeout): whoever
-                    # pops owns finalization, so the shed is counted once
-                    self._pending.pop(rid)
-                    expired.append(slot)
-                else:
-                    out.append((rid, slot.request))
-            del self._queue[:len(take)]
+            if model is None:
+                take = self._queue if max_n is None else self._queue[:max_n]
+                for rid in take:
+                    slot = self._pending.get(rid)
+                    if slot is None:
+                        continue
+                    if slot.deadline is not None and slot.deadline <= now:
+                        # claim the slot HERE (the pop decides the race,
+                        # same rule as respond vs the handler timeout):
+                        # whoever pops owns finalization, so the shed is
+                        # counted once
+                        self._pending.pop(rid)
+                        expired.append(slot)
+                    else:
+                        out.append((rid, slot.request))
+                del self._queue[:len(take)]
+            else:
+                keep: List[str] = []
+                for rid in self._queue:
+                    slot = self._pending.get(rid)
+                    if slot is None:
+                        continue  # claimed by a handler timeout: drop
+                    if slot.model != model or (
+                            max_n is not None and len(out) >= max_n):
+                        keep.append(rid)
+                        continue
+                    if slot.deadline is not None and slot.deadline <= now:
+                        self._pending.pop(rid)
+                        expired.append(slot)
+                    else:
+                        out.append((rid, slot.request))
+                self._queue[:] = keep
         for slot in expired:
-            self._shed("expired")
+            self._shed("expired", model=slot.model)
             self._finish(slot, HTTPResponseData(
                 504, "deadline expired in queue"), shed=True)
         return out
@@ -704,6 +949,13 @@ class ServingServer:
         # exemplar is passed explicitly — respond() runs after the
         # pipeline span closed, so there is no ambient trace here.
         self._m_latency.observe(lat, exemplar=exemplar)
+        if slot.model is not None:
+            # the per-tenant mirror: the model's own SLO monitor reads
+            # this family instead of the flat aggregate
+            self._models_seen.add(slot.model)
+            self._m_model_latency.labels(
+                self.server_label, slot.model).observe(
+                    lat, exemplar=exemplar)
 
     def latency_quantile(self, q: float = 0.5) -> Optional[float]:
         """Enqueue->reply latency quantile in seconds over recent requests."""
@@ -728,7 +980,7 @@ class ServingServer:
             self._pending.clear()
             self._queue.clear()
         for _rid, slot in pending:
-            self._m_shed.labels(self.server_label, "shutdown").inc()
+            self._shed("shutdown", model=slot.model)
             slot.response = HTTPResponseData(503, "server shutting down")
             slot.event.set()
             if slot.trace is not None:
@@ -744,6 +996,11 @@ class ServingServer:
             series.remove()
         for reason in ("expired", "overload", "cost", "shutdown"):
             self._m_shed.remove(self.server_label, reason)
+        for model in self._models_seen:
+            self._m_model_latency.remove(self.server_label, model)
+            self._m_model_errors.remove(self.server_label, model)
+            for reason in ("expired", "overload", "cost", "shutdown"):
+                self._m_model_shed.remove(self.server_label, model, reason)
 
 
 def _default_swap_loader(stage_path: str):
@@ -885,7 +1142,8 @@ def choose_batch_size(server: "ServingServer", max_batch: int,
 
 
 def attribute_batch_cost(server: "ServingServer", rids, reqs, cost0,
-                         flops_hist, bytes_hist) -> None:
+                         flops_hist, bytes_hist,
+                         model: Optional[str] = None) -> None:
     """Attribute one batch's profiled device cost to its requests.
 
     ``cost0`` is the engine's ``profiling.cost_snapshot()`` read from
@@ -903,13 +1161,14 @@ def attribute_batch_cost(server: "ServingServer", rids, reqs, cost0,
     span profiler hook)."""
     try:
         _attribute_batch_cost(server, rids, reqs, cost0,
-                              flops_hist, bytes_hist)
+                              flops_hist, bytes_hist, model)
     except Exception:
         _logger.exception("per-request cost attribution failed")
 
 
 def _attribute_batch_cost(server: "ServingServer", rids, reqs, cost0,
-                          flops_hist, bytes_hist) -> None:
+                          flops_hist, bytes_hist,
+                          model: Optional[str] = None) -> None:
     from ..observability.profiling import cost_snapshot
 
     f1, b1 = cost_snapshot()
@@ -918,7 +1177,7 @@ def _attribute_batch_cost(server: "ServingServer", rids, reqs, cost0,
     if n <= 0:
         return
     total_bytes = sum(len(r.entity or b"") for r in reqs)
-    server.note_batch_cost(dflops, n, total_bytes)
+    server.note_batch_cost(dflops, n, total_bytes, model=model)
     if dflops <= 0 and dbytes <= 0:
         return  # nothing profiled ran: no zero-noise series
     share_f, share_b = dflops / n, dbytes / n
@@ -1041,7 +1300,8 @@ def serve_timeline_exposition(handler, payload: Optional[dict] = None) -> None:
 
 
 @contextlib.contextmanager
-def traced_batch(server: ServingServer, rids, engine: str):
+def traced_batch(server: ServingServer, rids, engine: str,
+                 model: Optional[str] = None):
     """Per-batch trace plumbing shared by the micro-batch and continuous
     engines: closes each traced request's ``queue_wait`` span (enqueue ->
     drain) and runs the pipeline under ONE ``pipeline`` span parented to
@@ -1065,9 +1325,11 @@ def traced_batch(server: ServingServer, rids, engine: str):
     leader = traced[0].trace
     for s in traced[1:]:
         s.trace.set_attribute("fused_with", leader.trace_id)
+    attrs = {"engine": engine, "batch_size": len(rids)}
+    if model is not None:
+        attrs["model"] = model
     pipeline_span = tracer.begin_span(
-        "pipeline", parent=leader,
-        attributes={"engine": engine, "batch_size": len(rids)})
+        "pipeline", parent=leader, attributes=attrs)
     try:
         with tracing.use_span(pipeline_span):
             yield
@@ -1122,7 +1384,7 @@ class MicroBatchServingEngine:
         # micro-batches still form naturally from whatever arrives while
         # the previous batch transforms
         self._work = threading.Event()
-        server._on_enqueue = self._work.set
+        server._on_enqueue = lambda _model=None: self._work.set()
         self._batch_target_s = microbatch_target_s()
         self._m_reg = get_registry()
         (self._m_batches, self._m_batch_size, self._m_pipeline_errors,
@@ -1228,13 +1490,17 @@ class MicroBatchServingEngine:
             _logger.warning("serving engine saw pipeline errors; last: %s", self._error)
 
 
-def prewarm_pipeline(server: ServingServer, pipeline) -> bool:
+def prewarm_pipeline(server: ServingServer, pipeline,
+                     model: Optional[str] = None) -> bool:
     """Run ``pipeline`` once on a replay of the server's most recent real
     request — the off-request-path compile a hot swap pays BEFORE the
     flip, so the first post-swap batch is warm. False when no request has
     been seen yet (nothing to replay; the persisted AOT cache still
-    covers previously-seen jit signatures)."""
-    req = server.last_request
+    covers previously-seen jit signatures). With ``model``, the replay
+    sample is that tenant's OWN last request — another tenant's payload
+    shape would compile the wrong signature."""
+    req = server.last_request_by_model.get(model) if model is not None \
+        else server.last_request
     if req is None:
         return False
     reqs = np.empty(1, dtype=object)

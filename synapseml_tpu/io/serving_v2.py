@@ -35,7 +35,7 @@ from concurrent.futures import TimeoutError as FutureTimeout
 from concurrent.futures import ThreadPoolExecutor, wait as futures_wait
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from itertools import count
-from typing import Dict, List, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -47,12 +47,12 @@ from ..observability import (SLOConfig, SLOMonitor, get_registry,
 from . import faultinject
 from .http_schema import HTTPResponseData
 from .lifecycle import (LifecycleConfig, LoadAwareBalancer, WorkerLifecycle,
-                        healthz as lifecycle_healthz, post_control,
-                        wait_until)
+                        healthz as lifecycle_healthz, model_generation,
+                        post_control, wait_until)
 from .resilience import (BreakerBoard, FleetHealth, HEALTHY, HealthProber,
-                         HedgePolicy, ResilienceConfig, RetryBudget,
-                         WORKER_STATES, inject_deadline, parse_deadline,
-                         remaining_s)
+                         HedgePolicy, KeyedBreakerBoards, KeyedRetryBudgets,
+                         ResilienceConfig, RetryBudget, WORKER_STATES,
+                         inject_deadline, parse_deadline, remaining_s)
 from .serving import (MicroBatchServingEngine, ServingServer,
                       attribute_batch_cost, choose_batch_size, drain_engine,
                       engine_metrics, join_or_leak, microbatch_target_s,
@@ -60,48 +60,69 @@ from .serving import (MicroBatchServingEngine, ServingServer,
                       respond_batch, serve_metrics_exposition,
                       serve_slo_exposition, serve_timeline_exposition,
                       serve_traces_exposition, traced_batch)
+from .tenancy import (ModelCatalog, PlacementBoard, ResidencySet,
+                      model_from_request)
 
 __all__ = ["ContinuousServingEngine", "DistributedServingEngine",
-           "ProcessServingFleet", "ServiceRegistry", "RoutingServer",
+           "MultiTenantServingEngine", "ProcessServingFleet",
+           "ServiceRegistry", "RoutingServer",
            "serve_continuous", "serve_distributed"]
 
 _logger = get_logger("io.serving_v2")
 
 
 class ContinuousServingEngine:
-    """Push-mode drain -> transform -> reply loop (no micro-batch tick)."""
+    """Push-mode drain -> transform -> reply loop (no micro-batch tick).
+
+    With ``model`` set (a tenant engine inside
+    :class:`MultiTenantServingEngine`) the engine drains only THAT
+    model's queued requests, attaches its lifecycle slot under the model
+    (so swaps are per-model), labels its metric series
+    ``engine="tenant:<model>"`` (bounded by the catalog), and reports
+    batches/costs/errors under the model so per-tenant SLOs and the
+    placement cost EWMAs see the right tenant."""
 
     def __init__(self, server: ServingServer, pipeline: Transformer,
                  reply_col: str = "reply", max_batch: int = 1024,
-                 admission_schema="auto", generation: int = 0):
+                 admission_schema="auto", generation: int = 0,
+                 model: Optional[str] = None):
         self.server = server
         self.pipeline = pipeline
         self.reply_col = reply_col
         self.max_batch = max_batch
+        self.model = model
         # admission-time request validation against the pipeline's declared
         # input schema (core.schema): a 400 with the schema diff at the
-        # door, not a worker 500 mid-batch
+        # door, not a worker 500 mid-batch. A TENANT engine must not
+        # install its schema on the shared server — the last tenant would
+        # win and 400 every other model's requests.
         self._admission_knob = admission_schema
-        server.admission_schema = resolve_admission_schema(pipeline,
-                                                           admission_schema)
+        if model is None:
+            server.admission_schema = resolve_admission_schema(
+                pipeline, admission_schema)
         # generation-tagged pipeline slot (io/lifecycle.py): read once per
         # batch, so a hot swap flips atomically between batches
         self.lifecycle = WorkerLifecycle(pipeline, generation,
                                          on_swap=self._on_swap)
         server.attach_lifecycle(self.lifecycle,
-                                swap_prewarm=self._prewarm)
+                                swap_prewarm=self._prewarm, model=model)
         self._work = threading.Event()
         self._stop = threading.Event()
         self._error: Optional[BaseException] = None
         self.batches_processed = 0
         self.requests_processed = 0
-        # push hook: request arrival wakes the dispatcher immediately
-        server._on_enqueue = self._work.set
+        # push hook: request arrival wakes the dispatcher immediately (a
+        # tenant engine is woken by the host's fan-out hook instead)
+        if model is None:
+            server._on_enqueue = lambda _model=None: self._work.set()
         self._batch_target_s = microbatch_target_s()
         self._m_reg = get_registry()
+        self._engine_label = ("continuous" if model is None
+                              else f"tenant:{model}")
         (self._m_batches, self._m_batch_size, self._m_pipeline_errors,
          self._m_req_flops, self._m_req_bytes, self._m_chosen) = \
-            engine_metrics(self._m_reg, server.server_label, "continuous")
+            engine_metrics(self._m_reg, server.server_label,
+                           self._engine_label)
         self._m_reg.register_collector(self._collect_metrics)
         self._thread = threading.Thread(target=self._run,
                                         name="serving-continuous", daemon=True)
@@ -111,11 +132,17 @@ class ContinuousServingEngine:
 
     def _on_swap(self, pipeline) -> None:
         self.pipeline = pipeline
-        self.server.admission_schema = resolve_admission_schema(
-            pipeline, self._admission_knob)
+        if self.model is None:
+            self.server.admission_schema = resolve_admission_schema(
+                pipeline, self._admission_knob)
 
     def _prewarm(self, pipeline) -> None:
-        prewarm_pipeline(self.server, pipeline)
+        prewarm_pipeline(self.server, pipeline, model=self.model)
+
+    def wake(self) -> None:
+        """Signal the dispatcher that work may exist (the host's fan-out
+        enqueue hook calls this for every resident tenant engine)."""
+        self._work.set()
 
     def start(self) -> "ContinuousServingEngine":
         self._thread.start()
@@ -132,7 +159,7 @@ class ContinuousServingEngine:
                 # service-EWMA signals (bounded by max_batch)
                 limit = choose_batch_size(self.server, self.max_batch,
                                           self._batch_target_s)
-                batch = self.server.get_requests(limit)
+                batch = self.server.get_requests(limit, model=self.model)
                 if not batch:
                     break
                 self._m_chosen.set(limit)
@@ -150,16 +177,19 @@ class ContinuousServingEngine:
         t0 = time.perf_counter()
         c0 = cost_snapshot()
         try:
-            with traced_batch(self.server, ids, "continuous"):
+            with traced_batch(self.server, ids, self._engine_label,
+                              model=self.model):
                 out = pipeline.transform(table)
                 replies, out_ids = out[self.reply_col], out["id"]
                 # inside the batch trace: the bucket gets the leader
                 # request's exemplar
                 self._m_batch_size.observe(len(batch))
                 # per-request device-cost attribution (inside the trace:
-                # the batch totals land on the pipeline span)
+                # the batch totals land on the pipeline span; with a
+                # model, also on that tenant's cost EWMAs + the catalog)
                 attribute_batch_cost(self.server, ids, reqs, c0,
-                                     self._m_req_flops, self._m_req_bytes)
+                                     self._m_req_flops, self._m_req_bytes,
+                                     model=self.model)
         except Exception as e:
             _logger.exception("continuous serving pipeline failed")
             for rid in ids:
@@ -167,6 +197,8 @@ class ContinuousServingEngine:
                     500, "pipeline error", entity=str(e).encode()))
             self._error = e
             self._m_pipeline_errors.inc()
+            if self.model is not None:
+                self.server.note_model_error(self.model)
             return
         try:
             respond_batch(self.server, ids, out_ids, replies)
@@ -180,31 +212,224 @@ class ContinuousServingEngine:
                     500, "reply path error", entity=str(e).encode()))
             self._error = e
             self._m_pipeline_errors.inc()
+            if self.model is not None:
+                self.server.note_model_error(self.model)
             return
-        self.server.note_batch(len(batch), time.perf_counter() - t0)
+        self.server.note_batch(len(batch), time.perf_counter() - t0,
+                               model=self.model)
         self.batches_processed += 1
         self.requests_processed += len(batch)
 
     def latency_p50(self) -> Optional[float]:
         return self.server.latency_quantile(0.5)
 
-    def stop(self) -> None:
+    def stop(self, close_server: bool = True) -> None:
         # drain-then-stop: refuse new work, let the dispatcher answer the
-        # in-flight set (bounded), then stop the loop and the listener
-        self.server.begin_shutdown()
-        drain_engine(self.server, self._stop)
+        # in-flight set (bounded), then stop the loop and the listener.
+        # A TENANT engine passes close_server=False — the shared server
+        # belongs to the MultiTenantServingEngine host, which drains it
+        # once and closes it after every tenant dispatcher stopped.
+        if close_server:
+            self.server.begin_shutdown()
+            drain_engine(self.server, self._stop)
         self._stop.set()
         self._work.set()
         # a dispatcher wedged inside the pipeline would previously leak
         # silently; now it is logged + counted (smt_thread_leaks_total)
         join_or_leak(self._thread, 5.0,
-                     f"serving-engine:{self.server.server_label}")
-        self.server.close()
+                     f"serving-engine:{self.server.server_label}:"
+                     f"{self._engine_label}")
+        if close_server:
+            self.server.close()
         self._m_reg.unregister_collector(self._collect_metrics)
         for series in (self._m_batches, self._m_batch_size,
                        self._m_pipeline_errors, self._m_req_flops,
                        self._m_req_bytes, self._m_chosen):
             series.remove()
+
+
+class MultiTenantServingEngine:
+    """One worker, many models (io/tenancy.py's worker half).
+
+    Hosts one tenant :class:`ContinuousServingEngine` per RESIDENT model
+    on a shared :class:`ServingServer`: requests pick their tenant with
+    the ``X-SMT-Model`` header (validated against the catalog at the
+    door), each tenant dispatcher drains only its own queue, and each
+    model sits behind its OWN generation-tagged lifecycle slot — swapping
+    one never touches the others. Residency is an LRU
+    (:class:`ResidencySet`) over the persisted-AOT cache: admitting model
+    N+1 beyond ``capacity`` evicts the least-recently-served tenant,
+    whose next request faults it back in from its saved stage (warm
+    start). ``/control/load`` and ``/control/unload`` drive explicit
+    admission/eviction."""
+
+    def __init__(self, server: ServingServer,
+                 models: Dict[str, Transformer],
+                 reply_col: str = "reply", max_batch: int = 1024,
+                 catalog: Optional[ModelCatalog] = None,
+                 capacity: Optional[int] = None,
+                 stage_paths: Optional[Dict[str, str]] = None,
+                 generations: Optional[Dict[str, int]] = None):
+        if not models:
+            raise ValueError("MultiTenantServingEngine needs >= 1 model")
+        self.server = server
+        self.reply_col = reply_col
+        self.max_batch = max_batch
+        self.catalog = catalog if catalog is not None else ModelCatalog()
+        self.residency = ResidencySet(capacity=capacity,
+                                      on_evict=self._on_evict)
+        self._stop = threading.Event()
+        self._fault_wake = threading.Event()
+        self._lock = threading.Lock()
+        stage_paths = stage_paths or {}
+        generations = generations or {}
+        for m in sorted(models):
+            if m not in self.catalog:
+                self.catalog.register(m, stage_paths.get(m, ""),
+                                      generation=generations.get(m, 0))
+        server.catalog = self.catalog
+        # untagged legacy traffic lands on the first model (deterministic)
+        server.default_model = sorted(models)[0]
+        server.tenant_admit = self._tenant_admit
+        server.tenant_evict = self._tenant_evict
+        # arrival wake is TARGETED: the door stamps every slot with its
+        # tenant, so only that tenant's dispatcher drains — an all-hands
+        # wake per request made every other tenant (and the fault-in
+        # janitor's queue scan) pay for each arrival
+        server._on_enqueue = self._wake_model
+        for m in sorted(models):
+            self._spawn(m, models[m], generations.get(m, 0))
+        # fault-in janitor: requests for a cataloged-but-evicted model sit
+        # queued until their tenant is re-admitted — this thread watches
+        # for them and reloads the model from its saved stage OFF the
+        # handler threads (an LRU fault must never block the door)
+        self._fault_thread = threading.Thread(
+            target=self._fault_loop, name="tenant-fault-in", daemon=True)
+        self._fault_thread.start()
+
+    # -- engine plumbing ---------------------------------------------------
+    def engines(self) -> Dict[str, ContinuousServingEngine]:
+        with self._lock:
+            return {m: self.residency.get(m, touch=False)
+                    for m in self.residency.resident()}
+
+    def _wake_all(self) -> None:
+        for eng in self.engines().values():
+            if eng is not None:
+                eng.wake()
+        self._fault_wake.set()
+
+    def _wake_model(self, model: Optional[str] = None) -> None:
+        """Per-arrival wake: the tenant's own dispatcher when resident,
+        the fault-in janitor when not (an LRU fault), everyone when the
+        tenant is unknown (defensive — the door always stamps one)."""
+        if model is None:
+            self._wake_all()
+            return
+        eng = self.residency.get(model, touch=False)
+        if eng is not None:
+            eng.wake()
+        else:
+            self._fault_wake.set()
+
+    def _spawn(self, model: str, pipeline: Transformer,
+               generation: int = 0) -> ContinuousServingEngine:
+        eng = ContinuousServingEngine(
+            self.server, pipeline, reply_col=self.reply_col,
+            max_batch=self.max_batch, admission_schema=None,
+            generation=generation, model=model).start()
+        with self._lock:
+            self.residency.admit(model, eng)
+        return eng
+
+    def _on_evict(self, model: str, eng) -> None:
+        """ResidencySet eviction callback: stop the tenant dispatcher
+        (without closing the shared server) and detach its lifecycle
+        slot. The catalog entry SURVIVES eviction — the model's next
+        request faults it back in through the AOT cache."""
+        if eng is not None:
+            eng.stop(close_server=False)
+        self.server.lifecycles.pop(model, None)
+        self.server.swap_prewarms.pop(model, None)
+        _logger.info("tenant %s evicted from residency", model)
+
+    # -- control plane (/control/load, /control/unload) --------------------
+    def _tenant_admit(self, model: str, stage_path: Optional[str],
+                      generation: int = 0) -> None:
+        """Load (or reload) ``model``: from ``stage_path`` when given,
+        else from its catalog entry. Registers the catalog entry when
+        new; admission may LRU-evict another tenant."""
+        entry = self.catalog.get(model)
+        if stage_path is None:
+            if entry is None or not entry.stage_path:
+                raise KeyError(f"unknown model {model!r} and no stage_path")
+            stage_path = entry.stage_path
+            generation = entry.generation
+        from ..core.serialization import load_stage
+
+        pipeline = load_stage(stage_path)
+        if entry is None:
+            self.catalog.register(model, stage_path, generation=generation)
+        else:
+            self.catalog.bump(model, stage_path, generation)
+        old = self.residency.get(model, touch=False)
+        if old is not None:
+            # reload of a resident tenant: swap its slot in place rather
+            # than tearing the dispatcher down
+            old.lifecycle.install(pipeline, generation)
+            return
+        self._spawn(model, pipeline, generation)
+
+    def _tenant_evict(self, model: str) -> None:
+        """Explicit unload: residency eviction AND catalog removal, so
+        subsequent requests 404 instead of queueing for a tenant that
+        will never come back on its own."""
+        if model not in self.catalog:
+            raise KeyError(f"unknown model {model!r}")
+        self.residency.evict(model)
+        self.catalog.unregister(model)
+        if self.server.default_model == model:
+            remaining = self.catalog.models()
+            self.server.default_model = remaining[0] if remaining else None
+
+    # -- LRU fault-in ------------------------------------------------------
+    def _queued_nonresident(self) -> List[str]:
+        with self.server._lock:
+            queued = {s.model for rid in self.server._queue
+                      if (s := self.server._pending.get(rid)) is not None
+                      and s.model is not None}
+        return sorted(m for m in queued
+                      if m in self.catalog and m not in self.residency)
+
+    def _fault_loop(self) -> None:
+        while not self._stop.is_set():
+            self._fault_wake.wait(timeout=0.2)
+            self._fault_wake.clear()
+            for model in self._queued_nonresident():
+                try:
+                    self._tenant_admit(model, None)
+                    _logger.info("tenant %s faulted back into residency",
+                                 model)
+                except Exception:
+                    _logger.exception("fault-in of tenant %s failed", model)
+
+    def start(self) -> "MultiTenantServingEngine":
+        return self  # tenant dispatchers start at spawn; symmetry helper
+
+    def stop(self) -> None:
+        # one drain for the shared server, then every tenant dispatcher,
+        # then the listener — same drain-then-stop contract as the
+        # single-tenant engines
+        self.server.begin_shutdown()
+        drain_engine(self.server, self._stop)
+        self._stop.set()
+        self._fault_wake.set()
+        join_or_leak(self._fault_thread, 2.0,
+                     f"tenant-fault-in:{self.server.server_label}")
+        for model, eng in self.engines().items():
+            if eng is not None:
+                eng.stop(close_server=False)
+        self.server.close()
 
 
 class ServiceRegistry:
@@ -266,12 +491,24 @@ class RoutingServer:
 
     def __init__(self, registry: ServiceRegistry, service: str,
                  host: str = "127.0.0.1", port: int = 0, timeout: float = 30.0,
-                 resilience: Optional[ResilienceConfig] = None):
+                 resilience: Optional[ResilienceConfig] = None,
+                 catalog: Optional[ModelCatalog] = None,
+                 isolate_workers: int = 1):
         self.registry = registry
         self.service = service
         self.timeout = timeout
         self.resilience = (resilience if resilience is not None
                            else ResilienceConfig.from_env())
+        # multi-tenant front door (io/tenancy.py): with a catalog, the
+        # router validates the model id at the door (404 on unknown —
+        # bounded label cardinality starts HERE), keys breakers / retry
+        # budgets / SLO monitors per model, and orders candidates by the
+        # cost-driven placement plan
+        self.catalog = catalog
+        self.placement = (PlacementBoard(catalog,
+                                         isolate_workers=isolate_workers)
+                          if catalog is not None else None)
+        self.models_rejected = 0
         # handler threads are concurrent (ThreadingHTTPServer): bare += on
         # these from multiple threads loses updates, so every mutation
         # takes the lock (lint SMT006 enforces the discipline from here on)
@@ -324,6 +561,36 @@ class RoutingServer:
                     # merged worker snapshots, exactly like /metrics
                     outer._serve_slo(self)
                     return
+                if method == "GET" and op_path == "/placement":
+                    # the live cost-driven placement plan + per-model
+                    # cost/class rows + recent decisions (io/tenancy.py)
+                    outer._serve_placement(self)
+                    return
+                # tenant validation AT THE FRONT DOOR: an unknown model id
+                # is a client error answered here — it never reaches a
+                # worker, never opens a breaker, never burns any budget
+                model: Optional[str] = None
+                if outer.catalog is not None:
+                    model = model_from_request(self.headers, self.path)
+                    if model is not None and model not in outer.catalog:
+                        payload = json.dumps({
+                            "error": f"unknown model {model!r}",
+                            "models": outer.catalog.models(),
+                        }).encode()
+                        with outer._lock:
+                            outer.models_rejected += 1
+                            outer.requests_routed += 1
+                        try:
+                            self.send_response(404)
+                            self.send_header("Content-Type",
+                                             "application/json")
+                            self.send_header("Content-Length",
+                                             str(len(payload)))
+                            self.end_headers()
+                            self.wfile.write(payload)
+                        except OSError:
+                            pass
+                        return
                 if outer._closing:
                     # drain-then-stop: the listener stays up while
                     # in-flight forwards finish, but NEW work is refused
@@ -344,6 +611,17 @@ class RoutingServer:
                 if not targets:
                     self.send_error(503, "no workers registered")
                     return
+                if model is not None and outer.placement is not None:
+                    # cost-driven placement narrows the candidate set
+                    # (heavy tenants on their isolated workers, cheap ones
+                    # on the shared pool); an empty/stale intersection
+                    # falls back to the full registry — placement is an
+                    # optimization, never an availability constraint
+                    placed = outer.placement.targets(model)
+                    if placed:
+                        live = [t for t in targets if t in placed]
+                        if live:
+                            targets = live
                 length = int(self.headers.get("Content-Length") or 0)
                 body = self.rfile.read(length) if length else None
                 # DEADLINE: the client's absolute X-SMT-Deadline-Ms, or
@@ -367,11 +645,14 @@ class RoutingServer:
                 # forward attempt injects
                 route_span = None
                 if tracing.is_enabled():
+                    attrs = {"server": f"{outer.host}:{outer.port}",
+                             "method": method, "path": self.path}
+                    if model is not None:
+                        attrs["model"] = model
                     route_span = tracing.get_tracer().begin_span(
                         "route",
                         parent=tracing.extract_context(self.headers),
-                        attributes={"server": f"{outer.host}:{outer.port}",
-                                    "method": method, "path": self.path})
+                        attributes=attrs)
                 # Delivery contract (unchanged from the plain failover
                 # router): a DEAD worker (refused/reset) never received the
                 # request — always safe to retry; a TIMEOUT may still
@@ -401,7 +682,8 @@ class RoutingServer:
                 try:
                     reply, fail = outer._route(order, method, self.path,
                                                body, fwd_headers, deadline,
-                                               idempotent, route_span)
+                                               idempotent, route_span,
+                                               model=model)
                 finally:
                     with outer._lock:
                         outer._active_forwards -= 1
@@ -458,6 +740,11 @@ class RoutingServer:
 
         class Server(ThreadingHTTPServer):
             daemon_threads = True
+            # the front door absorbs many tenants' connection bursts at
+            # once; the http.server default backlog (5) resets the
+            # overflow at the TCP layer before any shed/deadline logic
+            # can answer honestly
+            request_queue_size = 128
 
         self._httpd = Server((host, port), Handler)
         self.host, self.port = self._httpd.server_address[:2]
@@ -538,6 +825,18 @@ class RoutingServer:
         self._breakers = BreakerBoard(cfg, slow_s=self._hedge_policy.slow_s,
                                       on_transition=self._breaker_transition)
         self._budget = RetryBudget(cfg)
+        # per-MODEL keyed boards (multi-tenant only): model A browning out
+        # on a worker opens only (A, worker)'s breaker and spends only A's
+        # retry budget — B's traffic keeps flowing. Untagged traffic keeps
+        # the flat board/budget above. Per-model SLO monitors are created
+        # lazily per cataloged model over the model-labeled families.
+        self._model_breakers = (
+            KeyedBreakerBoards(cfg, slow_s=self._hedge_policy.slow_s,
+                               on_transition=self._breaker_transition)
+            if catalog is not None else None)
+        self._model_budgets = (KeyedRetryBudgets(cfg)
+                               if catalog is not None else None)
+        self._model_slos: Dict[str, SLOMonitor] = {}
         self._pool = ThreadPoolExecutor(
             max_workers=16, thread_name_prefix=f"routing-hedge-{self.port}")
         # synced from the plain ints at snapshot time (hot-path-free)
@@ -564,6 +863,10 @@ class RoutingServer:
         — put it back in the routing table with a clean breaker."""
         self.registry.register(self.service, target)
         self._breakers.reset(target)
+        if self._model_breakers is not None:
+            # the worker restarted: no tenant's stale breaker history
+            # applies (resets the target on EVERY model's board)
+            self._model_breakers.reset(target)
         # a restarted worker's latency history is stale: start it cold
         # (round-robin) until its window re-warms
         self._balancer.forget(target)
@@ -575,16 +878,49 @@ class RoutingServer:
         self._m_breaker_trans.labels(self.server_label, state).inc()
         _logger.info("circuit breaker for %s -> %s", target, state)
 
+    def _breakers_for(self, model: Optional[str]) -> BreakerBoard:
+        """The breaker board an attempt consults: the flat per-target
+        board for untagged traffic, the MODEL's own board otherwise."""
+        if model is None or self._model_breakers is None:
+            return self._breakers
+        return self._model_breakers.board(model)
+
+    def _budget_for(self, model: Optional[str]) -> RetryBudget:
+        """The retry budget a failover/hedge spends from: one tenant's
+        retry storm must not starve another's legitimate failover."""
+        if model is None or self._model_budgets is None:
+            return self._budget
+        return self._model_budgets.budget(model)
+
+    def _model_slo(self, model: str) -> SLOMonitor:
+        """The per-model SLO monitor (lazy; keyed by catalog entries, so
+        the monitor count is bounded by deployment configuration). Reads
+        the ``smt_serving_model_*`` families via
+        ``label_filter={"model": ...}``."""
+        mon = self._model_slos.get(model)
+        if mon is None:
+            mon = SLOMonitor(SLOConfig.from_env(),
+                             label_filter={"model": {model}},
+                             name=f"model:{model}@{self.server_label}")
+            # synthetic zero baseline, same contract as the fleet monitor
+            mon.observe({"families": {}}, force=True)
+            self._model_slos[model] = mon
+        return mon
+
     # -- routing core ------------------------------------------------------
     def _route(self, order: List[str], method: str, path: str,
                body: Optional[bytes], headers: Dict[str, str],
-               deadline: float, idempotent: bool, route_span
+               deadline: float, idempotent: bool, route_span,
+               model: Optional[str] = None
                ) -> Tuple[Optional[tuple], Optional[str]]:
         """Walk the candidates with breaker-gated, budget-limited failover
         (and a hedged first attempt for idempotent methods). Returns
         ``(reply, fail)``: a ``(status, content_type, entity)`` reply, or
-        ``fail`` in ``timeout | budget | deadline | unreachable``."""
+        ``fail`` in ``timeout | budget | deadline | unreachable``.
+        ``model`` keys the breaker board and retry budget per tenant."""
         cfg = self.resilience
+        breakers = self._breakers_for(model)
+        budget = self._budget_for(model)
         attempted = 0
         tried_as_hedge: set = set()
         for i, target in enumerate(order):
@@ -595,16 +931,17 @@ class RoutingServer:
             rem = remaining_s(deadline)
             if rem is not None and rem <= 0:
                 return None, "deadline"
-            if not self._breakers.allow(target):
+            if not breakers.allow(target):
                 continue  # skipped, never sent: costs no budget
             if attempted == 0:
-                self._budget.note_primary()
-            elif not self._budget.try_spend():
-                # fleet-wide retry budget exhausted: fail FAST — failover
-                # under brownout must not amplify offered load into a
-                # retry storm (the distinct 503 + counter is the signal).
-                # The allow() slot was consumed but nothing will be sent.
-                self._breakers.release(target)
+                budget.note_primary()
+            elif not budget.try_spend():
+                # retry budget exhausted (the MODEL's own when tagged):
+                # fail FAST — failover under brownout must not amplify
+                # offered load into a retry storm (the distinct 503 +
+                # counter is the signal). The allow() slot was consumed
+                # but nothing will be sent.
+                breakers.release(target)
                 with self._lock:
                     self.retries_denied += 1
                 return None, "budget"
@@ -619,15 +956,16 @@ class RoutingServer:
                         self.hedges_suppressed += 1
                     kind, reply = self._attempt(target, method, path, body,
                                                 headers, deadline,
-                                                route_span, attempted)
+                                                route_span, attempted,
+                                                model=model)
                 else:
                     kind, reply = self._hedged_attempt(
                         target, alternates, method, path, body, headers,
-                        deadline, route_span, tried_as_hedge)
+                        deadline, route_span, tried_as_hedge, model=model)
             else:
                 kind, reply = self._attempt(target, method, path, body,
                                             headers, deadline, route_span,
-                                            attempted)
+                                            attempted, model=model)
             attempted += 1
             if kind == "reply":
                 return reply, None
@@ -645,7 +983,8 @@ class RoutingServer:
     def _attempt(self, target: str, method: str, path: str,
                  body: Optional[bytes], headers: Dict[str, str],
                  deadline: float, route_span, attempt: int,
-                 hedge: bool = False) -> Tuple[str, Optional[tuple]]:
+                 hedge: bool = False,
+                 model: Optional[str] = None) -> Tuple[str, Optional[tuple]]:
         """One forward attempt; records the breaker outcome, the health
         transition, the attempt-latency sample, and a ``forward`` span.
         Returns ``(kind, reply)``: ``reply`` (the worker answered —
@@ -659,7 +998,7 @@ class RoutingServer:
         if rem is not None and rem <= 0:
             # never sent: hand back the breaker trial slot allow() may
             # have reserved, and report the accurate outcome
-            self._breakers.release(target)
+            self._breakers_for(model).release(target)
             return ("deadline", None)
         per_attempt = max(0.001, min(self.timeout, rem))
         fwd_span = None
@@ -713,7 +1052,7 @@ class RoutingServer:
                                 success=(kind == "reply"
                                          and reply[0] < 400))
         self._m_attempt_lat.observe(latency)
-        self._breakers.on_result(target, ok, latency)
+        self._breakers_for(model).on_result(target, ok, latency)
         if kind == "reply":
             self._health.record_success(target)  # it answered: alive
         elif kind == "dead":
@@ -729,7 +1068,9 @@ class RoutingServer:
     def _hedged_attempt(self, primary: str, alternates: List[str],
                         method: str, path: str, body: Optional[bytes],
                         headers: Dict[str, str], deadline: float, route_span,
-                        tried: set) -> Tuple[str, Optional[tuple]]:
+                        tried: set,
+                        model: Optional[str] = None
+                        ) -> Tuple[str, Optional[tuple]]:
         """Tail-at-scale hedging (Dean & Barroso): when the primary has
         not answered within the live-p95 hedge delay, race one hedge on
         the next breaker-allowed worker; the first worker ANSWER wins, the
@@ -739,15 +1080,16 @@ class RoutingServer:
         draw from the same retry budget as failover; the hedge target is
         added to ``tried`` so a failed race does not re-attempt it."""
         delay = self._hedge_policy.delay_s(self.timeout)
+        breakers = self._breakers_for(model)
         try:
             f1 = self._pool.submit(self._attempt, primary, method, path,
                                    body, headers, deadline, route_span,
-                                   0, False)
+                                   0, False, model)
         except RuntimeError:
             # the pool is shut down (router closing with traffic in
             # flight): degrade to a plain inline attempt, never a crash
             return self._attempt(primary, method, path, body, headers,
-                                 deadline, route_span, 0)
+                                 deadline, route_span, 0, model=model)
         rem = remaining_s(deadline)
         try:
             return f1.result(timeout=min(delay, max(rem, 0.001)))
@@ -758,14 +1100,14 @@ class RoutingServer:
             # Hedging a queued request is pure amplification; run the
             # attempt inline on this handler thread instead.
             return self._attempt(primary, method, path, body, headers,
-                                 deadline, route_span, 0)
+                                 deadline, route_span, 0, model=model)
         hedge_target = next(
-            (t for t in alternates if self._breakers.allow(t)), None)
-        if hedge_target is None or not self._budget.try_spend():
+            (t for t in alternates if breakers.allow(t)), None)
+        if hedge_target is None or not self._budget_for(model).try_spend():
             if hedge_target is not None:
                 # allow() reserved a (possibly half-open) trial slot but
                 # the budget denied the send: hand the slot back
-                self._breakers.release(hedge_target)
+                breakers.release(hedge_target)
             # no affordable hedge: wait the primary out (bounded by the
             # deadline plus the attempt's own timeout slack)
             try:
@@ -776,9 +1118,9 @@ class RoutingServer:
         try:
             f2 = self._pool.submit(self._attempt, hedge_target, method,
                                    path, body, headers, deadline,
-                                   route_span, 1, True)
+                                   route_span, 1, True, model)
         except RuntimeError:
-            self._breakers.release(hedge_target)
+            breakers.release(hedge_target)
             try:
                 return f1.result(
                     timeout=max(remaining_s(deadline), 0.001) + 1.0)
@@ -817,7 +1159,7 @@ class RoutingServer:
                     # in-flight loser just runs out its own attempt
                     # timeout, abandoned, and reports its own outcome
                     if p.cancel():
-                        self._breakers.release(by_future[p][0])
+                        breakers.release(by_future[p][0])
                 return (kind, reply)
         return last
 
@@ -827,13 +1169,68 @@ class RoutingServer:
         serve its status — fleet burn rates from combined bucket deltas,
         exactly like fleet quantiles."""
         try:
-            self.slo.observe(self.fleet_snapshot(), force=True)
+            snap = self.fleet_snapshot()
+            self.slo.observe(snap, force=True)
         except Exception:
             _logger.debug("fleet SLO sample failed", exc_info=True)
+            snap = None
         status = self.slo.status()
         status["fleet"] = True
         status["workers"] = len(self.registry.lookup(self.service))
+        if self.catalog is not None:
+            # per-tenant monitors over the same merged snapshot, reading
+            # the model mirror families — one tenant's burn is visible
+            # (and alertable) without the aggregate moving
+            models: Dict[str, dict] = {}
+            for m in self.catalog.models():
+                mon = self._model_slo(m)
+                if snap is not None:
+                    try:
+                        mon.observe(snap, force=True)
+                    except Exception:
+                        _logger.debug("model SLO sample failed",
+                                      exc_info=True)
+                models[m] = mon.status()
+            status["models"] = models
         serve_slo_exposition(handler, status)
+
+    def _serve_placement(self, handler) -> None:
+        """``GET /placement``: the placement board's current view —
+        per-model resource class, cost EWMAs, assigned workers, and the
+        bounded decision log. 404 on a single-tenant router (no catalog:
+        there is nothing to place)."""
+        if self.placement is None:
+            body = json.dumps({"error": "placement requires a model "
+                                        "catalog (multi-tenant mode)"}
+                              ).encode()
+            handler.send_response(404)
+            handler.send_header("Content-Type", "application/json")
+            handler.send_header("Content-Length", str(len(body)))
+            handler.end_headers()
+            handler.wfile.write(body)
+            return
+        try:
+            # the grouped-merge cost path: per-tenant engines publish
+            # profiled cost histograms (engine="tenant:<model>"), the
+            # fleet snapshot merges them across workers, and the ROUTER's
+            # catalog folds the fleet-wide per-request means into its
+            # EWMAs — so placement classes come from measured device cost,
+            # not from whatever this process happened to serve itself
+            from ..observability.merge import model_cost_per_request
+
+            for m, per in model_cost_per_request(
+                    self.fleet_snapshot()).items():
+                if self.catalog is not None and m in self.catalog:
+                    self.catalog.note_cost(m, per)
+            self.placement.refresh(self.registry.lookup(self.service))
+        except Exception:
+            _logger.debug("placement refresh failed", exc_info=True)
+        body = json.dumps(self.placement.status(), indent=2).encode()
+        handler.send_response(200)
+        handler.send_header("Content-Type", "application/json")
+        handler.send_header("Content-Length", str(len(body)))
+        handler.end_headers()
+        handler.wfile.write(body)
 
     def _collect_metrics(self) -> None:
         self._m_routed.sync_total(self.requests_routed)
@@ -1059,7 +1456,7 @@ class ProcessServingFleet:
     routing table on first contact failure.
     """
 
-    def __init__(self, pipeline: Transformer, n_workers: int = 2,
+    def __init__(self, pipeline: Optional[Transformer], n_workers: int = 2,
                  service: str = "default", host: str = "127.0.0.1",
                  mode: str = "continuous", reply_timeout: float = 30.0,
                  startup_timeout: float = 60.0,
@@ -1068,7 +1465,9 @@ class ProcessServingFleet:
                  resilience: Optional[ResilienceConfig] = None,
                  fault_plan=None,
                  aot_cache_dir: Optional[str] = None,
-                 lifecycle: Optional[LifecycleConfig] = None):
+                 lifecycle: Optional[LifecycleConfig] = None,
+                 models: Optional[Dict[str, Transformer]] = None,
+                 isolate_workers: int = 1):
         import json as _json
         import os
         import shutil
@@ -1077,10 +1476,31 @@ class ProcessServingFleet:
 
         from ..core.serialization import save_stage
 
+        if pipeline is None and not models:
+            raise ValueError("either a pipeline or a models dict is "
+                             "required")
         self._tmp = tempfile.mkdtemp(prefix="serving_fleet_")
         self.generation = 0
-        self._stage_path = os.path.join(self._tmp, "pipeline_g0")
-        save_stage(pipeline, self._stage_path)
+        # multi-tenant mode: every worker process serves EVERY cataloged
+        # model (one MultiTenantServingEngine per worker); the fleet keeps
+        # a per-model generation ledger and a catalog the router validates
+        # against + places with
+        self.generations: Dict[str, int] = {}
+        self._models_spec: Dict[str, Dict[str, Any]] = {}
+        self.catalog: Optional[ModelCatalog] = None
+        if models:
+            self.catalog = ModelCatalog()
+            for m, pipe in sorted(models.items()):
+                spath = os.path.join(self._tmp, f"{m}_g0")
+                save_stage(pipe, spath)
+                self.generations[m] = 0
+                self._models_spec[m] = {"stage_path": spath,
+                                        "generation": 0}
+                self.catalog.register(m, spath, generation=0)
+            self._stage_path = None
+        else:
+            self._stage_path = os.path.join(self._tmp, "pipeline_g0")
+            save_stage(pipeline, self._stage_path)
         self.registry = ServiceRegistry()
         self.service = service
         self.startup_timeout = startup_timeout
@@ -1149,7 +1569,10 @@ class ProcessServingFleet:
                 self.registry.register(service, addr)
             self.router = RoutingServer(self.registry, service, host, 0,
                                         timeout=reply_timeout,
-                                        resilience=resilience)
+                                        resilience=resilience,
+                                        catalog=self.catalog,
+                                        isolate_workers=isolate_workers)
+            self._refresh_placement()
         except BaseException:
             # failed startup must not orphan already-spawned workers or
             # leak the saved-pipeline tempdir (stop() is unreachable when
@@ -1162,16 +1585,33 @@ class ProcessServingFleet:
 
     def _worker_cmd(self, port: int = 0) -> List[str]:
         """The worker argv for the CURRENT generation: a swap updates
-        ``_stage_path``/``generation``, so restarts and scale-ups always
-        serve the fleet's live pipeline, never the boot-time one."""
+        ``_stage_path``/``generation`` (or the per-model spec in
+        multi-tenant mode), so restarts and scale-ups always serve the
+        fleet's live pipelines, never the boot-time ones."""
+        import json as _json
         import sys
 
-        cmd = [sys.executable, "-m", "synapseml_tpu.io.serving_worker",
-               self._stage_path] + list(self._cmd_flags)
-        cmd += ["--generation", str(self.generation)]
+        cmd = [sys.executable, "-m", "synapseml_tpu.io.serving_worker"]
+        if self._models_spec:
+            cmd += ["--models-json", _json.dumps(self._models_spec)]
+        else:
+            cmd += [self._stage_path, "--generation", str(self.generation)]
+        cmd += list(self._cmd_flags)
         if port:
             cmd += ["--port", str(port)]
         return cmd
+
+    def _refresh_placement(self) -> None:
+        """Re-plan cost-driven placement over the CURRENT worker set
+        (no-op for a single-tenant fleet); decisions land in the
+        telemetry ring and ``GET /placement``."""
+        if self.router.placement is None:
+            return
+        try:
+            self.router.placement.refresh(
+                self.registry.lookup(self.service))
+        except Exception:
+            _logger.debug("placement refresh failed", exc_info=True)
 
     def _launch_worker(self, port: int = 0):
         """Popen one worker process (no handshake yet). ``port`` pins the
@@ -1303,7 +1743,8 @@ class ProcessServingFleet:
 
     # -- zero-downtime lifecycle -------------------------------------------
     def swap(self, pipeline: Transformer,
-             cfg: Optional[LifecycleConfig] = None) -> int:
+             cfg: Optional[LifecycleConfig] = None,
+             model: Optional[str] = None) -> int:
         """Zero-downtime rolling hot swap across the worker PROCESSES.
 
         The new pipeline is saved once (``core.serialization.save_stage``)
@@ -1315,12 +1756,40 @@ class ProcessServingFleet:
         resumed and re-registered. The rest of the fleet serves throughout
         — no request is ever dropped. A worker that DIES mid-roll is
         skipped (it stays out of the routing table) and the roll completes
-        on the survivors. Returns the new generation."""
+        on the survivors. Returns the new generation.
+
+        With ``model=`` (multi-tenant fleets) the roll is PER-MODEL and
+        deliberately drain-free: only that model's engine flips, so the
+        other tenants keep serving on every worker throughout — the whole
+        point of slot-isolated generations. Completion is detected via the
+        per-model generation in ``/healthz`` (``lifecycle.model_generation``)."""
         import os
 
         cfg = cfg or self.lifecycle_cfg
         from ..core.serialization import save_stage
 
+        if model is not None:
+            if model not in self._models_spec:
+                raise KeyError(f"unknown model {model!r}")
+            with self._ops_lock:
+                gen = self.generations[model] + 1
+                stage_path = os.path.join(self._tmp, f"{model}_g{gen}")
+                save_stage(pipeline, stage_path)
+                for addr in self.live_addresses():
+                    if not self._swap_one_model(addr, model, stage_path,
+                                                gen, cfg):
+                        _logger.warning(
+                            "per-model swap of %r did not land on worker "
+                            "%s; continuing on the rest", model, addr)
+                self.generations[model] = gen
+                self._models_spec[model] = {"stage_path": stage_path,
+                                            "generation": gen}
+                if self.catalog is not None:
+                    self.catalog.bump(model, stage_path, gen)
+            return gen
+        if self._models_spec:
+            raise ValueError("multi-tenant fleet: pass model= to swap "
+                             "one tenant's pipeline")
         with self._ops_lock:  # serialized against autoscaler add/remove
             gen = self.generation + 1
             stage_path = os.path.join(self._tmp, f"pipeline_g{gen}")
@@ -1378,6 +1847,27 @@ class ProcessServingFleet:
         self.registry.register(self.service, addr)
         return swapped
 
+    def _swap_one_model(self, addr: str, model: str, stage_path: str,
+                        gen: int, cfg: LifecycleConfig) -> bool:
+        """Swap ONE model on ONE worker, with NO drain and NO
+        unregistration: the other tenants' engines keep draining the
+        shared queue, so their traffic never notices the roll. The
+        worker's per-model lifecycle loads + pre-warms off the request
+        path and flips between batches; completion is the model's own
+        generation in ``/healthz`` (top-level generation is some OTHER
+        tenant's in a multi-tenant worker)."""
+        status, _ = post_control(
+            addr, "swap",
+            {"model": model, "stage_path": stage_path, "generation": gen},
+            timeout=cfg.healthz_timeout_s)
+        if status != 202:
+            return False
+        return wait_until(
+            lambda: model_generation(
+                lifecycle_healthz(addr, cfg.healthz_timeout_s),
+                model) == gen,
+            cfg.swap_timeout_s, cfg.poll_interval_s)
+
     def add_worker(self) -> Optional[str]:
         """Scale UP: spawn one more worker serving the CURRENT generation.
         With a shared AOT cache dir the worker pre-warms every persisted
@@ -1398,6 +1888,7 @@ class ProcessServingFleet:
                 self.procs.append(p)
                 self.addresses.append(addr)
             self.registry.register(self.service, addr)
+            self._refresh_placement()
         return addr
 
     def remove_worker(self, i: Optional[int] = None,
@@ -1433,6 +1924,7 @@ class ProcessServingFleet:
             with self._lists_lock:
                 self.procs.pop(i)
                 self.addresses.pop(i)
+            self._refresh_placement()
         return addr
 
     def start_autoscaler(self, cfg: Optional[LifecycleConfig] = None):

@@ -23,7 +23,13 @@ import threading
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
-    ap.add_argument("stage_path")
+    ap.add_argument("stage_path", nargs="?", default=None)
+    ap.add_argument("--models-json", default=None,
+                    help="multi-tenant worker: JSON dict of "
+                         '{"model": {"stage_path": ..., "generation": N}};'
+                         " every model loads into one shared server "
+                         "behind a MultiTenantServingEngine (the "
+                         "stage_path positional is then omitted)")
     ap.add_argument("--host", default="127.0.0.1")
     ap.add_argument("--port", type=int, default=0)
     ap.add_argument("--mode", default="continuous",
@@ -58,6 +64,8 @@ def main(argv=None) -> int:
                          "— previously-seen signatures then serve their "
                          "first request without a cold XLA compile")
     args = ap.parse_args(argv)
+    if (args.stage_path is None) == (args.models_json is None):
+        ap.error("exactly one of stage_path or --models-json is required")
 
     import importlib
 
@@ -72,7 +80,7 @@ def main(argv=None) -> int:
     from ..core.serialization import load_stage
     from ..observability import tracing
     from .serving import MicroBatchServingEngine, ServingServer
-    from .serving_v2 import ContinuousServingEngine
+    from .serving_v2 import ContinuousServingEngine, MultiTenantServingEngine
 
     if (args.trace_sample_rate is not None or args.trace_slow_ms is not None
             or args.trace_capacity is not None):
@@ -83,10 +91,16 @@ def main(argv=None) -> int:
                                  if args.trace_slow_ms is not None
                                  else None)))
 
+    import json as _json
     import time as _time
 
     t_load0 = _time.perf_counter()
-    pipeline = load_stage(args.stage_path)
+    spec = _json.loads(args.models_json) if args.models_json else None
+    if spec is not None:
+        models = {m: load_stage(e["stage_path"])
+                  for m, e in sorted(spec.items())}
+    else:
+        pipeline = load_stage(args.stage_path)
     prewarmed = {}
     if args.prewarm_aot:
         # warm start BEFORE the address announcement (= before the fleet
@@ -99,7 +113,16 @@ def main(argv=None) -> int:
     ready_s = _time.perf_counter() - t_load0
     server = ServingServer(args.host, args.port,
                            reply_timeout=args.reply_timeout)
-    if args.mode == "continuous":
+    if spec is not None:
+        # multi-tenant worker: one engine per model over ONE shared
+        # server/queue, per-model generations in /healthz, and
+        # /control/{load,unload,swap} keyed by model id
+        engine = MultiTenantServingEngine(
+            server, models, reply_col=args.reply_col,
+            stage_paths={m: e["stage_path"] for m, e in spec.items()},
+            generations={m: int(e.get("generation", 0))
+                         for m, e in spec.items()}).start()
+    elif args.mode == "continuous":
         engine = ContinuousServingEngine(
             server, pipeline, reply_col=args.reply_col,
             generation=args.generation).start()
@@ -107,7 +130,6 @@ def main(argv=None) -> int:
         engine = MicroBatchServingEngine(
             server, pipeline, reply_col=args.reply_col,
             generation=args.generation).start()
-    import json as _json
 
     print(f"ADDRESS {server.address}", flush=True)
     # AFTER the address announcement: the parent's handshake select()s on

@@ -20,7 +20,8 @@ from typing import Any, Dict, Iterable, List, Optional, Sequence
 from .metrics import bucket_quantile
 from .tracing import _merge_trace_entries
 
-__all__ = ["merge_snapshots", "histogram_quantile", "merge_traces"]
+__all__ = ["merge_snapshots", "histogram_quantile", "merge_traces",
+           "model_cost_per_request"]
 
 
 def _copy_series(s: Dict[str, Any]) -> Dict[str, Any]:
@@ -161,3 +162,46 @@ def histogram_quantile(snapshot: Dict[str, Any], name: str, q: float,
         for i, c in enumerate(s["counts"]):
             counts[i] += c
     return bucket_quantile(buckets, counts, q)
+
+
+def model_cost_per_request(snapshot: Dict[str, Any],
+                           family: str = "smt_request_flops",
+                           engine_prefix: str = "tenant:",
+                           ) -> Dict[str, float]:
+    """Per-MODEL mean profiled cost per request out of a (merged) snapshot.
+
+    The grouped-merge half of cost-driven placement: each multi-tenant
+    worker's per-tenant engine publishes its cost-attribution histogram
+    labeled ``engine="tenant:<model>"``, the front door merges the worker
+    snapshots (:func:`merge_snapshots`), and this helper groups the merged
+    series by their tenant label — so the ROUTER's catalog learns what
+    each model costs across process boundaries without any side channel
+    (workers profile, the front door places). Sum/count ratios are
+    fleet-wide means, weighted by each worker's actual request share.
+    """
+    fam = (snapshot.get("families") or {}).get(family) \
+        if isinstance(snapshot, dict) else None
+    out: Dict[str, float] = {}
+    if not isinstance(fam, dict) or fam.get("type") != "histogram":
+        return out
+    labelnames = list(fam.get("labelnames", []))
+    try:
+        ei = labelnames.index("engine")
+    except ValueError:
+        return out
+    sums: Dict[str, float] = {}
+    counts: Dict[str, float] = {}
+    for s in fam.get("series", []):
+        labels = s.get("labels", [])
+        if len(labels) <= ei:
+            continue
+        engine = str(labels[ei])
+        if not engine.startswith(engine_prefix):
+            continue
+        model = engine[len(engine_prefix):]
+        sums[model] = sums.get(model, 0.0) + float(s.get("sum", 0.0))
+        counts[model] = counts.get(model, 0.0) + float(s.get("count", 0.0))
+    for model, count in counts.items():
+        if count > 0:
+            out[model] = sums[model] / count
+    return out

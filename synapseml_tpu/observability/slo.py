@@ -142,14 +142,23 @@ def extract_sli(snapshot: Dict[str, Any], latency_slo_s: float,
     ``server`` label; the router passes nothing and sees the fleet).
     Values are CUMULATIVE counter reads; the monitor differences
     consecutive extractions, so burn rates come from bucket *deltas*.
+
+    A filter that names a ``model`` switches the extraction to the
+    per-model mirror families (``smt_serving_model_latency_seconds`` /
+    ``smt_serving_model_shed_total`` / ``smt_serving_model_errors_total``)
+    — the flat families carry no ``model`` label, so filtering them would
+    silently pass EVERY series (``_series_passes`` ignores absent label
+    names) and each tenant monitor would see the whole fleet.
     """
     fams = (snapshot.get("families") or {}) if isinstance(snapshot, dict) \
         else {}
+    per_model = bool(label_filter) and "model" in label_filter
     total = 0.0
     bad = 0.0
     exemplar: Optional[Tuple[str, float]] = None
 
-    lat = fams.get("smt_serving_latency_seconds")
+    lat = fams.get("smt_serving_model_latency_seconds" if per_model
+                   else "smt_serving_latency_seconds")
     if isinstance(lat, dict) and lat.get("type") == "histogram":
         buckets = lat.get("buckets") or []
         labelnames = list(lat.get("labelnames") or [])
@@ -175,8 +184,15 @@ def extract_sli(snapshot: Dict[str, Any], latency_slo_s: float,
                     if exemplar is None or ts >= exemplar[1]:
                         exemplar = (str(ex[0]), ts)
 
-    for name in ("smt_serving_shed_total",
-                 "smt_serving_pipeline_errors_total"):
+    if per_model:
+        counter_names = ("smt_serving_model_shed_total",
+                         "smt_serving_model_errors_total")
+        shed_name = "smt_serving_model_shed_total"
+    else:
+        counter_names = ("smt_serving_shed_total",
+                         "smt_serving_pipeline_errors_total")
+        shed_name = "smt_serving_shed_total"
+    for name in counter_names:
         fam = fams.get(name)
         if not isinstance(fam, dict):
             continue
@@ -187,7 +203,7 @@ def extract_sli(snapshot: Dict[str, Any], latency_slo_s: float,
                 continue
             v = float(s.get("value", 0.0))
             bad += v
-            if name == "smt_serving_shed_total":
+            if name == shed_name:
                 total += v  # sheds never reach the latency histogram
 
     return {"total": total, "bad": min(bad, total) if total else bad,

@@ -702,6 +702,70 @@ def _cast(inputs, attrs, ctx):
     return inputs[0].astype(dtype)
 
 
+def _qbroadcast(x, scale, zp, axis: int):
+    """Per-axis quantization params broadcast against ``x``: a 1-D
+    scale/zero_point lies along ``axis`` (ONNX per-channel form); scalars
+    broadcast as-is. Returns jnp views ready for arithmetic."""
+    scale = jnp.asarray(scale)
+    if zp is not None:
+        zp = jnp.asarray(zp)
+    nd = jnp.ndim(x)
+    if scale.ndim == 1 and nd > 1:
+        shape = [1] * nd
+        shape[axis % nd] = -1
+        scale = scale.reshape(shape)
+        if zp is not None and zp.ndim == 1:
+            zp = zp.reshape(shape)
+    return scale, zp
+
+
+@op("QuantizeLinear")
+def _quantize_linear(inputs, attrs, ctx):
+    # y = saturate(round(x / y_scale) + y_zero_point), round half to even
+    # (jnp.round IS banker's rounding); output dtype follows the
+    # zero_point (uint8 when omitted, per spec)
+    x, scale = inputs[0], inputs[1]
+    zp = inputs[2] if len(inputs) > 2 else None
+    qdtype = (np.dtype(np.uint8) if zp is None
+              else np.asarray(zp).dtype if isinstance(zp, np.ndarray)
+              else np.dtype(zp.dtype))
+    scale, zp = _qbroadcast(x, scale, zp, int(attrs.get("axis", 1)))
+    y = jnp.round(x / scale)
+    if zp is not None:
+        y = y + zp.astype(y.dtype)
+    info = np.iinfo(qdtype)
+    return jnp.clip(y, info.min, info.max).astype(qdtype)
+
+
+@op("DequantizeLinear")
+def _dequantize_linear(inputs, attrs, ctx):
+    # y = (x - x_zero_point) * x_scale, in the scale's float dtype
+    x, scale = inputs[0], inputs[1]
+    zp = inputs[2] if len(inputs) > 2 else None
+    scale, zp = _qbroadcast(x, scale, zp, int(attrs.get("axis", 1)))
+    xf = jnp.asarray(x).astype(scale.dtype)
+    if zp is not None:
+        xf = xf - zp.astype(scale.dtype)
+    return xf * scale
+
+
+@op("DynamicQuantizeLinear")
+def _dynamic_quantize_linear(inputs, attrs, ctx):
+    # uint8 affine quantization with the data's own range (the range is
+    # widened to include 0 so zero_point is always representable);
+    # returns (y, y_scale, y_zero_point) exactly per spec
+    x = jnp.asarray(inputs[0])
+    xmax = jnp.maximum(jnp.max(x), 0.0)
+    xmin = jnp.minimum(jnp.min(x), 0.0)
+    scale = ((xmax - xmin) / 255.0).astype(jnp.float32)
+    # all-zero input: the spec's scale is 0 — quantize against 1.0 to
+    # keep the kernel finite (y and zero_point are all zero either way)
+    safe = jnp.where(scale == 0, jnp.float32(1.0), scale)
+    zp = jnp.clip(jnp.round(-xmin / safe), 0, 255)
+    y = jnp.clip(jnp.round(x / safe) + zp, 0, 255).astype(jnp.uint8)
+    return y, scale, zp.astype(jnp.uint8)
+
+
 @op("Where")
 def _where(inputs, attrs, ctx):
     c, a, b = inputs[:3]

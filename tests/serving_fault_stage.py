@@ -48,6 +48,32 @@ class TagEchoReply(Transformer):
         return table.with_column("reply", replies)
 
 
+class SlowEchoReply(Transformer):
+    """Replies like :class:`TagEchoReply` but sleeps ``delay_ms`` per ROW
+    first — the multi-tenant chaos test's hog tenant: under open-loop
+    load its queue piles up seconds of simulated service time, so
+    tight-deadline requests expire IN THE QUEUE (per-model sheds) while
+    the co-resident fast tenants keep answering in milliseconds."""
+
+    tag = Param("generation tag echoed in every reply", str, default="h0")
+    delay_ms = Param("simulated service time per request row (ms)", float,
+                     default=20.0)
+
+    def _transform(self, table: Table) -> Table:
+        import time as _time
+
+        n = table.num_rows
+        _time.sleep(self.delay_ms * n / 1000.0)
+        pid = os.getpid()
+        reqs = table["request"]
+        replies = np.empty(n, dtype=object)
+        for i, r in enumerate(reqs):
+            body = (r.entity or b"").decode()
+            replies[i] = HTTPResponseData(
+                200, "OK", entity=f"{self.tag}:{pid}:{body}".encode())
+        return table.with_column("reply", replies)
+
+
 def _burn_impl(x):
     import jax.numpy as jnp
 

@@ -722,6 +722,29 @@ def test_leaf_local_histograms_match_full_pass():
     np.testing.assert_array_equal(b_leaf.feature, b_full.feature)
 
 
+def test_leaf_local_multiclass_matches_full_pass():
+    """The multiclass lift: grow_tree is vmapped over classes, so the
+    gather path runs in its branch-free fixed-buffer mode
+    (TreeConfig.leaf_buf_fixed) — a vmapped lax.switch would execute
+    every buffer branch. Trees must be IDENTICAL to the block path per
+    class, same pin as the binary parity test."""
+    rng = np.random.default_rng(34)
+    n = 6000  # > 2 * leaf_buf_min so the gather path actually engages
+    x = rng.normal(size=(n, 6))
+    y = (x[:, 0] + 0.5 * x[:, 1] > 0).astype(np.float64) \
+        + (x[:, 2] > 0.5).astype(np.float64)  # 3 classes
+    params = {"objective": "multiclass", "num_class": 3,
+              "num_iterations": 4, "num_leaves": 15}
+    b_full = train({**params, "leaf_local": False}, x, y)
+    b_leaf = train({**params, "leaf_local": True}, x, y)
+    np.testing.assert_allclose(b_leaf.leaf_value, b_full.leaf_value,
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_array_equal(b_leaf.feature, b_full.feature)
+    np.testing.assert_allclose(b_leaf.predict(x[:100]),
+                               b_full.predict(x[:100]),
+                               rtol=1e-5, atol=1e-6)
+
+
 def test_categorical_feature_mixed_names_and_indexes():
     """Indices and names may be mixed (estimators concatenate
     categorical_slot_indexes + categorical_slot_names); advisor round-2

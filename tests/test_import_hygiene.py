@@ -45,6 +45,7 @@ _GATED_MODULES = [
     "synapseml_tpu.io.serving",
     "synapseml_tpu.io.serving_v2",
     "synapseml_tpu.io.serving_worker",
+    "synapseml_tpu.io.tenancy",
     "synapseml_tpu.gbdt.boost",
     # PEP 562 lazy packages (core/lazyimport.py): the package import must
     # stay jax-free even though the submodules underneath use jax

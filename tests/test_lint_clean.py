@@ -757,6 +757,22 @@ def test_smt014_true_negative(tmp_path):
     assert findings == []
 
 
+def test_smt014_multi_tenant_model_labels_bounded():
+    """The multi-tenant data plane labels per-model series with ids from
+    the bounded ModelCatalog (unknown ids 404 at the door, so series
+    count is capped by deployment configuration, never request data) —
+    tenancy + the serving paths that consume it must stay SMT014-clean
+    WITHOUT waivers."""
+    report = analyze_paths(
+        [os.path.join(REPO_ROOT, "synapseml_tpu", "io", "tenancy.py"),
+         os.path.join(REPO_ROOT, "synapseml_tpu", "io", "serving.py"),
+         os.path.join(REPO_ROOT, "synapseml_tpu", "io", "serving_v2.py")],
+        select=["SMT014"], use_acks=False)
+    assert not report["errors"], report["errors"]
+    assert report["findings"] == [], [
+        f"{f.path}:{f.line} {f.message}" for f in report["findings"]]
+
+
 # ---------------------------------------------------------------------------
 # SARIF output
 # ---------------------------------------------------------------------------

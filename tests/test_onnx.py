@@ -370,6 +370,92 @@ def test_flatten_softmax_onehot_edge_cases():
     np.testing.assert_allclose(np.asarray(out), [[0, 0, 0], [0, 0, 1], [0, 0, 1]])
 
 
+def test_quantize_linear_golden():
+    """ONNX spec golden values: saturation at both ends and round-half-
+    to-even (3/2 -> 2, not 1)."""
+    from synapseml_tpu.onnx.ops import OPS
+
+    x = np.array([0.0, 2.0, 3.0, 1000.0, -254.0, -1000.0], np.float32)
+    y = OPS["QuantizeLinear"](
+        [jnp.asarray(x), np.float32(2.0), np.uint8(128)], {},
+        {"op_type": "QuantizeLinear", "opset": 13})
+    assert np.asarray(y).dtype == np.uint8
+    np.testing.assert_array_equal(np.asarray(y), [128, 129, 130, 255, 1, 0])
+    # int8 output follows the zero_point dtype; saturates at [-128, 127]
+    y = OPS["QuantizeLinear"](
+        [jnp.asarray(x), np.float32(2.0), np.int8(0)], {},
+        {"op_type": "QuantizeLinear", "opset": 13})
+    assert np.asarray(y).dtype == np.int8
+    np.testing.assert_array_equal(np.asarray(y), [0, 1, 2, 127, -127, -128])
+
+
+def test_quantize_linear_per_axis():
+    from synapseml_tpu.onnx.ops import OPS
+
+    x = np.array([[-1.5, 0.5, 3.4], [2.0, -5.0, 6.0]], np.float32)
+    y = OPS["QuantizeLinear"](
+        [jnp.asarray(x), np.array([1.0, 2.0], np.float32),
+         np.array([0, 10], np.int8)], {"axis": 0},
+        {"op_type": "QuantizeLinear", "opset": 13})
+    # row 0: round([-1.5, .5, 3.4]) + 0 (half-to-even: -1.5->-2, .5->0)
+    # row 1: round([1, -2.5, 3]) + 10 (-2.5 -> -2)
+    np.testing.assert_array_equal(np.asarray(y), [[-2, 0, 3], [11, 8, 13]])
+
+
+def test_dequantize_linear_golden():
+    from synapseml_tpu.onnx.ops import OPS
+
+    x = np.array([0, 3, 128, 255], np.uint8)
+    y = OPS["DequantizeLinear"](
+        [jnp.asarray(x), np.float32(2.0), np.uint8(128)], {},
+        {"op_type": "DequantizeLinear", "opset": 13})
+    assert np.asarray(y).dtype == np.float32
+    np.testing.assert_allclose(np.asarray(y), [-256.0, -250.0, 0.0, 254.0])
+    # per-axis (axis=0): row scales [2, 4], zero points [0, 1]
+    x2 = np.array([[0, 1, 2], [3, 4, 5]], np.int8)
+    y2 = OPS["DequantizeLinear"](
+        [jnp.asarray(x2), np.array([2.0, 4.0], np.float32),
+         np.array([0, 1], np.int8)], {"axis": 0},
+        {"op_type": "DequantizeLinear", "opset": 13})
+    np.testing.assert_allclose(np.asarray(y2), [[0, 2, 4], [8, 12, 16]])
+
+
+def test_dynamic_quantize_linear_golden():
+    from synapseml_tpu.onnx.ops import OPS
+
+    # range [-1, 3] widens to include 0 already: scale 4/255, zp
+    # round(63.75) = 64; inputs chosen OFF the .5 rounding boundary so
+    # the golden is stable across float orderings
+    x = np.array([-1.0, 0.0, 1.0, 3.0], np.float32)
+    y, scale, zp = OPS["DynamicQuantizeLinear"](
+        [jnp.asarray(x)], {}, {"op_type": "DynamicQuantizeLinear",
+                               "opset": 13})
+    np.testing.assert_allclose(float(scale), 4.0 / 255.0, rtol=1e-6)
+    assert int(zp) == 64 and np.asarray(zp).dtype == np.uint8
+    np.testing.assert_array_equal(np.asarray(y), [0, 64, 128, 255])
+    # all-zero input: finite everywhere, scale 0, everything quantizes to 0
+    y, scale, zp = OPS["DynamicQuantizeLinear"](
+        [jnp.zeros(4, jnp.float32)], {},
+        {"op_type": "DynamicQuantizeLinear", "opset": 13})
+    assert float(scale) == 0.0 and int(zp) == 0
+    np.testing.assert_array_equal(np.asarray(y), [0, 0, 0, 0])
+
+
+def test_quantize_dequantize_roundtrip_graph():
+    """Q -> DQ through a real graph stays within one quantization step."""
+    rng = np.random.default_rng(7)
+    x = rng.uniform(-4, 4, size=(5, 8)).astype(np.float32)
+    fn = build_fn(
+        [node("QuantizeLinear", ["x", "s", "z"], ["q"]),
+         node("DequantizeLinear", ["q", "s", "z"], ["y"])],
+        [value_info("x", np.float32, [None, 8])],
+        [value_info("y", np.float32, [None, 8])],
+        {"s": np.float32(8.0 / 255.0), "z": np.uint8(128)},
+    )
+    y = fn({"x": x})["y"]
+    np.testing.assert_allclose(np.asarray(y), x, atol=8.0 / 255.0 / 2 + 1e-6)
+
+
 def test_onnx_model_empty_table():
     """Empty partitions are normal in a partitioned pipeline; must not crash."""
     rng = np.random.default_rng(0)

@@ -95,6 +95,26 @@ def render(payload: Dict[str, Any], out=None) -> None:
                   f"{br.get('burn_long')}/{br.get('burn_short')} "
                   f"(>= {br.get('threshold')})"
                   + (f"  trace {tid}" if tid else ""), file=out)
+    models = payload.get("models") or {}
+    if models:
+        # multi-tenant front door: one budget/burn row per model, from
+        # the per-model mirror families — a flat payload (single-tenant
+        # server) simply has no "models" section and renders as before
+        print(f"  models ({len(models)}):", file=out)
+        print(f"    {'model':<20} {'remaining':>9} {'bad':>8} {'total':>8} "
+              f"{'posture':<9} firing", file=out)
+        for m in sorted(models):
+            st = models[m] or {}
+            mb = st.get("budget") or {}
+            rem = float(mb.get("remaining_fraction") or 0.0)
+            firing = ",".join(w.get("window", "?")
+                              for w in st.get("windows") or []
+                              if w.get("active")) or "-"
+            posture = "DEFENSIVE" if st.get("defensive") else "normal"
+            print(f"    {m:<20} {rem:>8.1%} "
+                  f"{mb.get('bad_events', 0):>8g} "
+                  f"{mb.get('total_events', 0):>8g} {posture:<9} {firing}",
+                  file=out)
 
 
 def main(argv=None) -> int:
@@ -104,12 +124,24 @@ def main(argv=None) -> int:
                                    "saved JSON file")
     ap.add_argument("--json", action="store_true",
                     help="dump the payload as JSON instead")
+    ap.add_argument("--model", default=None,
+                    help="render ONE tenant's budget/burn detail (the "
+                         "payload's models.<id> section); errors out on "
+                         "a flat single-tenant payload")
     ap.add_argument("--check", action="store_true",
                     help="exit 2 when any burn alert is firing (or the "
                          "defensive posture is active) — CI/cron probe")
     args = ap.parse_args(argv)
 
     payload = load_payload(args.source)
+    if args.model is not None:
+        models = payload.get("models") or {}
+        if args.model not in models:
+            known = ", ".join(sorted(models)) or "none (flat payload)"
+            print(f"error: model {args.model!r} not in payload "
+                  f"(known: {known})", file=sys.stderr)
+            return 1
+        payload = models[args.model]
     if args.json:
         json.dump(payload, sys.stdout, indent=2)
         print()

@@ -85,9 +85,10 @@ def _attrs_summary(s: Dict[str, Any]) -> str:
     # hedged request and which target won the race
     # flops/hbm_bytes: per-request (request spans) and per-batch (pipeline
     # spans) device cost attributed by the serving engines
-    for k in ("stage", "target", "server", "status", "engine", "batch_size",
-              "hedge", "hedged", "hedge_winner", "attempt", "flops",
-              "hbm_bytes", "error", "url", "trace_dir", "bytes"):
+    # model: the tenant a multi-tenant route/request/pipeline span served
+    for k in ("stage", "model", "target", "server", "status", "engine",
+              "batch_size", "hedge", "hedged", "hedge_winner", "attempt",
+              "flops", "hbm_bytes", "error", "url", "trace_dir", "bytes"):
         if k in attrs:
             v = str(attrs[k])
             keep.append(f"{k}={v[:60]}")
