@@ -283,6 +283,50 @@ def bench_gbdt_sparse(platform):
             "density": round(csr.density, 5), "ingest_s": round(ingest, 2)}
 
 
+def bench_gbdt_mesh_bin(platform):
+    """Device-side distributed binning under a mesh: raw f32 rows upload
+    sharded over 'data' and each shard bins its OWN block on device
+    (``device_bin_cat`` over replicated packed edge tables), vs the
+    host-bin control where ``np.searchsorted`` bins the full matrix on
+    the host before upload. The timed region is ``train()`` from RAW
+    rows — binning INCLUDED, unlike the higgs lane: the host-side bin
+    pass is exactly the mesh bottleneck this lane exists to watch. The
+    two paths grow bit-identical trees (pre-rounded histograms), so the
+    control isolates pure binning/upload overhead."""
+    import jax
+
+    from synapseml_tpu.gbdt import device_predict
+    from synapseml_tpu.gbdt.boost import train
+    from synapseml_tpu.runtime.layout import SpecLayout
+
+    n, d = (2_000_000, 28) if platform != "cpu" else (120_000, 28)
+    iters = 10
+    rng = np.random.default_rng(9)
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    y = (x[:, 0] + 0.4 * x[:, 5] > 0).astype(np.float64)
+    layout = SpecLayout.build(devices=jax.devices(), model_axis=None)
+    params = {"objective": "binary", "num_iterations": iters,
+              "num_leaves": 31, "max_bin": 63}
+
+    train(params, x, y, mesh=layout)  # warm the scan program
+    dt = _best_of(2, lambda: train(params, x, y, mesh=layout))
+
+    # host-bin control on the SAME mesh: knock out the use_device_bin
+    # gate (same off-switch the parity tests use); the scan program is
+    # already warm — only the bin/upload path differs
+    orig = device_predict.cats_f32_representable
+    device_predict.cats_f32_representable = lambda mapper: False
+    try:
+        dt_host = _best_of(2, lambda: train(params, x, y, mesh=layout))
+    finally:
+        device_predict.cats_f32_representable = orig
+
+    return {"train_rows_per_sec": round(n * iters / dt, 0),
+            "host_bin_rows_per_sec": round(n * iters / dt_host, 0),
+            "device_vs_host_bin": round(dt_host / dt, 3),
+            "rows": n, "iterations": iters, "n_shards": layout.data_size}
+
+
 def bench_vit_gbdt(platform, peak):
     import jax
 
@@ -1488,6 +1532,7 @@ _PRIMARY = {
     "bert_base_onnx": "sequences_per_sec_per_chip",
     "gbdt_higgs_scale": "train_rows_per_sec",
     "gbdt_sparse_hashed": "train_rows_per_sec",
+    "gbdt_mesh_bin": "train_rows_per_sec",
     "vit_to_gbdt_pipeline": "images_per_sec_end_to_end",
     "flash_attention_32k": "tflops_nominal",
     "flash_attention_gqa": "tflops_nominal",
@@ -1610,6 +1655,7 @@ def main(argv=None) -> int:
         ("bert_base_onnx", lambda: bench_bert(platform, peak)),
         ("gbdt_higgs_scale", lambda: bench_gbdt_higgs(platform)),
         ("gbdt_sparse_hashed", lambda: bench_gbdt_sparse(platform)),
+        ("gbdt_mesh_bin", lambda: bench_gbdt_mesh_bin(platform)),
         ("vit_to_gbdt_pipeline", lambda: bench_vit_gbdt(platform, peak)),
         ("flash_attention_32k", lambda: bench_flash_attention(platform, peak)),
         ("flash_attention_gqa", lambda: bench_flash_gqa(platform, peak)),

@@ -6,11 +6,12 @@ program: large tensors silently resident fully-replicated across a
 populated model axis (every fsdp blocker looks like this), conflicting
 ``with_sharding_constraint`` chains that force an implicit reshard on a
 hot path, host fallbacks that are only reachable in the mesh
-configuration (``use_device_bin`` requires ``mesh is None`` — the binning
-searchsorted runs on host exactly when 8 chips are waiting), and
-mesh-vs-single-device traces that structurally diverge where they should
-not (the bisection instrument ``test_sparse_mesh_matches_single_device``
-needs).
+configuration (``use_device_bin`` required ``mesh is None`` for three
+arcs — the binning searchsorted ran on host exactly when 8 chips were
+waiting, until the device-side distributed binning change made the flag
+mesh-capable), and mesh-vs-single-device traces that structurally
+diverge where they should not (the bisection instrument
+``test_sparse_mesh_matches_single_device`` needs).
 
 This pack traces the canonical entry points under representative
 ``SpecLayout``s — (1, 1), (4, 2) feature-parallel, and a (1, 2)
@@ -427,8 +428,9 @@ class HostFallbackUnderMesh(SpmdRule):
     The worst scaling bug is the one that only exists when the hardware
     shows up: a device-side fast path gated on ``mesh is None`` means the
     mesh configuration — the one with 8 chips waiting — does the work on
-    the HOST (the ``use_device_bin`` searchsorted guard is the canonical
-    true finding: mesh fits bin multi-million-row matrices in numpy).
+    the HOST (the ``use_device_bin`` searchsorted guard was the canonical
+    true finding — mesh fits binned multi-million-row matrices in numpy
+    for three arcs — until device-side distributed binning removed it).
     Two halves: an AST pass (jax-free, always on) flags device-path flags
     that require ``mesh is None`` and host callbacks lexically gated on
     ``mesh is not None``; the ``--spmd`` jaxpr pass flags host-callback
@@ -714,10 +716,48 @@ def _build_gbdt_sparse_pair_entry() -> Dict[str, Any]:
             "layout": mesh["layout"], "anchor_obj": boost._build_step}
 
 
+def _build_gbdt_device_bin_entry() -> Dict[str, Any]:
+    """Shard-local device binning (the mesh ``use_device_bin`` path):
+    raw f32 rows shard over ``data``, the packed edge/category tables
+    replicate, and each shard runs the same vectorized binning kernel the
+    single-device path uses — so the mesh trace must be STRUCTURALLY
+    IDENTICAL to the single-device twin (any divergence here would break
+    the bit-identical-trees parity the gbdt tests pin)."""
+    from ..gbdt import device_predict
+    from ..gbdt.binning import BinMapper
+    from ..runtime.layout import representative_layouts
+
+    import numpy as np
+
+    layout = representative_layouts()["(4,2)-fp"]
+    rng = np.random.default_rng(0)
+    # 88 rows -> 22 per shard under data=4: no dimension of the
+    # per-shard block aliases the packed-table width (max_bin) or the
+    # feature count, so the canonical dim ids line up with the
+    # single-device trace (64 rows gave 16/shard == max_bin and the
+    # structural diff flagged a spurious broadcast hunk)
+    x = rng.normal(size=(88, 6)).astype(np.float32)
+    mapper = BinMapper(max_bin=16).fit(x.astype(np.float64))
+    table, lens, cat_flags = device_predict.pack_feature_table(mapper)
+    dspec, rep = layout.batch(), layout.replicated()
+
+    def body(xb, t, ln):
+        # cat_flags stays on host: static kernel-selection metadata
+        return device_predict.device_bin_cat(xb, t, ln, cat_flags,
+                                             mapper.missing_bin)
+
+    fn = layout.shard_map(body, in_specs=(dspec, rep, rep),
+                          out_specs=dspec, check=False)
+    return {"fn": fn, "args": (x, table, lens),
+            "single_fn": body, "single_args": (x, table, lens),
+            "layout": layout, "anchor_obj": device_predict.device_bin_cat}
+
+
 def default_spmd_entries() -> List[SpmdEntry]:
     """The canonical entries, one per representative layout: (1, 1)
     degenerate, (4, 2) feature-parallel, (1, 2) tensor-parallel serving,
-    and the sparse mesh-vs-single differential pair."""
+    the sparse mesh-vs-single differential pair, and the shard-local
+    device-binning pair the mesh ``use_device_bin`` path runs."""
     return [
         SpmdEntry("onnx.mlp[tp,(1,2)]", _build_onnx_tp_entry,
                   mesh_axes=("data", "model"),
@@ -730,13 +770,16 @@ def default_spmd_entries() -> List[SpmdEntry]:
                   mesh_axes=("data", "model")),
         SpmdEntry("gbdt.grow[sparse,mesh]", _build_gbdt_sparse_pair_entry,
                   mesh_axes=("data",)),
+        SpmdEntry("gbdt.bin[device,mesh]", _build_gbdt_device_bin_entry,
+                  mesh_axes=("data", "model")),
     ]
 
 
 def differential_entry_names() -> List[str]:
     """Entries carrying a single-device twin (what ``tools/spmd_diff.py``
     can diff) — static so ``--list`` stays jax-free."""
-    return ["gbdt.grow[sparse,mesh]", "onnx.mlp[tp,(1,2)]"]
+    return ["gbdt.grow[sparse,mesh]", "gbdt.bin[device,mesh]",
+            "onnx.mlp[tp,(1,2)]"]
 
 
 # ---------------------------------------------------------------------------

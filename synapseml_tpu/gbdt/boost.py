@@ -1145,11 +1145,39 @@ def _renewed_leaf_values(node, yv, raw_col, weight, alpha: float, L: int):
     return jnp.where(total > 0, vals, 0.0).astype(jnp.float32)
 
 
+def _preround(x, n_bound: int, axis_name):
+    """Truncate gradients to a summation-exact f32 grid (deterministic
+    histograms).
+
+    Histogram cells are f32 sums whose order differs between the
+    single-device pass and the per-shard-then-``psum`` mesh pass; on
+    tie-heavy data a last-ulp difference flips a near-tied argmax split and
+    the trees diverge (the real failure behind
+    ``test_sparse_mesh_matches_single_device``). Rounding every gradient to
+    a multiple of ``ulp(factor)`` with ``factor >= max|x| * n_bound`` makes
+    every partial sum of up to ``n_bound`` terms exactly representable, so
+    ANY summation order produces the bit-identical cell value (XGBoost's
+    ``CreateRoundingFactor`` pre-rounding). ``max`` is order-independent, so
+    the mesh's ``pmax`` of shard maxima equals the single-device max and
+    both paths round on the same grid. Per-element error is bounded by
+    ``ulp(factor)/2`` — at most ``max|x| * n_bound * 2**-24``.
+    """
+    import jax.numpy as jnp
+    from jax import lax
+
+    m = jnp.max(jnp.abs(x), axis=0)
+    if axis_name is not None:
+        m = lax.pmax(m, axis_name)
+    delta = m * jnp.float32(n_bound)
+    factor = jnp.exp2(jnp.ceil(jnp.log2(jnp.maximum(delta, jnp.float32(1e-35)))))
+    return (x + factor) - factor
+
+
 def _build_step(grad_fn=None, fobj=None, *, cfg, C, lr, boosting, d, cat_idx,
                 ff, bf, bfreq, use_goss, top_rate, other_rate, mesh, axis,
                 model_axis=None,
                 pos_bf=1.0, neg_bf=1.0, sparse_meta=None, renew_alpha=None,
-                scan_iters=None, eval_metric=None, n_eval=0):
+                scan_iters=None, eval_metric=None, n_eval=0, n_bound=None):
     """Build the jitted per-iteration training step.
 
     Module-level so :func:`_cached_step` can reuse compiled programs across
@@ -1167,6 +1195,12 @@ def _build_step(grad_fn=None, fobj=None, *, cfg, C, lr, boosting, d, cat_idx,
     import jax.numpy as jnp
 
     axis_name = axis if mesh is not None else None
+    # per-shard bagging streams only exist when a bagging/GOSS mask actually
+    # consumes random bits; folding the key by axis_index unconditionally
+    # would put a mesh-only RNG head in the traced program (SMT113) for
+    # configs whose step touches no RNG at all
+    bag_rng_live = use_goss or (bfreq > 0 and (bf < 1.0 or pos_bf < 1.0
+                                               or neg_bf < 1.0))
     cat_mask_np = None
     if cat_idx:
         cat_mask_np = np.zeros(d, np.float32)
@@ -1207,6 +1241,11 @@ def _build_step(grad_fn=None, fobj=None, *, cfg, C, lr, boosting, d, cat_idx,
             g, h = grad_fn(raw, yv, wv)
         g = g.astype(jnp.float32)
         h = h.astype(jnp.float32)
+        if n_bound is not None:
+            # deterministic histograms: single-device and mesh sums become
+            # bit-identical regardless of accumulation order (see _preround)
+            g = _preround(g, n_bound, axis_name)
+            h = _preround(h, n_bound, axis_name)
 
         fmask = (jax.random.uniform(fkey, (d,)) < ff).astype(jnp.float32) if ff < 1.0 \
             else jnp.ones((d,), jnp.float32)
@@ -1260,7 +1299,7 @@ def _build_step(grad_fn=None, fobj=None, *, cfg, C, lr, boosting, d, cat_idx,
             key, k2 = jax.random.split(key)
             period = i if use_goss else i // max(bfreq, 1)
             k1 = jax.random.fold_in(bkey, period)
-            if mesh is not None:
+            if mesh is not None and bag_rng_live:
                 k1 = jax.random.fold_in(k1, jax.lax.axis_index(axis))
             trees, raw = one_iter(binned, yv, wv, raw, k1, k2)
             return (key, raw), trees
@@ -1296,6 +1335,8 @@ def _build_step(grad_fn=None, fobj=None, *, cfg, C, lr, boosting, d, cat_idx,
             it = it0 + i
             period = it if use_goss else it // max(bfreq, 1)
             k1 = jax.random.fold_in(bkey, period)
+            if mesh is not None and bag_rng_live:
+                k1 = jax.random.fold_in(k1, jax.lax.axis_index(axis))
             trees, raw = one_iter(binned, yv, wv, raw, k1, k2)
             new_eraws, ms = [], []
             for (eb, ey, ew, _), eraw in zip(eval_data, eraws):
@@ -1340,6 +1381,17 @@ def _build_step(grad_fn=None, fobj=None, *, cfg, C, lr, boosting, d, cat_idx,
         # smt_compile_seconds{fn=...} with its recompile cause, and the
         # executable's cost_analysis FLOPs attribute achieved MFU to the
         # enclosing fit() span
+        if scan_iters is not None and n_eval > 0:
+            # mesh device-eval: eval sets REPLICATE (each shard scores the
+            # full set against the replicated trees and computes the same
+            # metric panel — no distributed AUC/rank machinery needed, and
+            # the early-stop decision is shard-identical by construction);
+            # only training rows stay sharded. it0/base are scalars.
+            return profiled_jit(layout.shard_map(
+                scan_loop_eval,
+                in_specs=in_specs + (rep, rep, rep),
+                out_specs=(rep, data_spec, rep, rep, rep),
+                check=False), name="gbdt.scan_eval_sharded")
         if scan_iters is not None:
             return profiled_jit(layout.shard_map(scan_loop,
                                                  in_specs=in_specs,
@@ -1348,7 +1400,8 @@ def _build_step(grad_fn=None, fobj=None, *, cfg, C, lr, boosting, d, cat_idx,
                                 name="gbdt.scan_sharded")
 
         def sharded_iter(binned, yv, wv, raw, key, fkey):
-            key = jax.random.fold_in(key, jax.lax.axis_index(axis))
+            if bag_rng_live:
+                key = jax.random.fold_in(key, jax.lax.axis_index(axis))
             trees, new_raw = one_iter(binned, yv, wv, raw, key, fkey)
             return trees, new_raw
 
@@ -1369,7 +1422,7 @@ def _build_step(grad_fn=None, fobj=None, *, cfg, C, lr, boosting, d, cat_idx,
 def _cached_step(obj_key, *, cfg, C, lr, boosting, d, cat_idx, ff, bf, bfreq,
                  use_goss, top_rate, other_rate, mesh, axis, model_axis=None,
                  pos_bf=1.0, neg_bf=1.0, sparse_meta=None, renew_alpha=None,
-                 scan_iters=None, eval_metric=None, n_eval=0):
+                 scan_iters=None, eval_metric=None, n_eval=0, n_bound=None):
     """Compiled-step cache for built-in objectives (custom fobj / lambdarank
     close over data and stay uncached). Keyed on every static that shapes the
     traced program; jax's own jit cache then dedupes by input shape/dtype."""
@@ -1385,14 +1438,18 @@ def _cached_step(obj_key, *, cfg, C, lr, boosting, d, cat_idx, ff, bf, bfreq,
                        pos_bf=pos_bf, neg_bf=neg_bf, sparse_meta=sparse_meta,
                        renew_alpha=renew_alpha,
                        scan_iters=scan_iters, eval_metric=eval_metric,
-                       n_eval=n_eval)
+                       n_eval=n_eval, n_bound=n_bound)
 
 
-def spmd_trace_pair(n: int = 192, d: int = 24, shards: Optional[int] = None,
+def spmd_trace_pair(n: int = 224, d: int = 24, shards: Optional[int] = None,
                     seed: int = 0):
     """The sparse training step in BOTH configurations, for differential
-    static analysis — the exact shape ``test_sparse_mesh_matches_single_
-    device`` exercises, reduced to its traceable core.
+    static analysis — the shape ``test_sparse_mesh_matches_single_device``
+    exercises, reduced to its traceable core. ``n`` deliberately avoids
+    multiples of ``d`` so the row count can never alias the flattened
+    ``d * n_bins`` cell-table length under the per-line dim renaming (at
+    ``n=192=24*8`` the single-device trace accidentally fused the two dims
+    and the diff reported a spurious scan-signature hunk).
 
     ``analysis/rules_spmd.py`` (SMT112/SMT113) and ``tools/spmd_diff.py``
     trace the two callables with ``jax.make_jaxpr`` and diff the
@@ -1426,13 +1483,15 @@ def spmd_trace_pair(n: int = 192, d: int = 24, shards: Optional[int] = None,
     cfg = TreeConfig(n_bins=mapper.realized_n_bins, num_leaves=4)
     pp = dict(_DEFAULTS, objective="binary")
     _, grad_fn = _resolve_objective(pp)
-    # ff/bf at 1.0: the single-device step touches NO RNG, so every
-    # random-bits eqn in the diff is mesh-side by construction (the
-    # per-shard fold_in) — the known, reasoned divergence
+    # ff/bf at 1.0: the step touches NO RNG on either side — the mesh step
+    # only folds the bagging key per shard when a bagging/GOSS mask is
+    # live, so the two traces must now be structurally identical (the gate
+    # test + tools/spmd_diff.py golden pin exactly that). n_bound matches
+    # train()'s for this shape (n divides shards, so padded == n).
     common = dict(grad_fn=grad_fn, cfg=cfg, C=1, lr=0.1, boosting="gbdt",
                   d=d, cat_idx=None, ff=1.0, bf=1.0, bfreq=0,
                   use_goss=False, top_rate=0.2, other_rate=0.1,
-                  model_axis=None)
+                  model_axis=None, n_bound=1 << max(n - 1, 1).bit_length())
 
     y = (rng.random(n) < 0.5).astype(np.float32)
     w = np.ones(n, np.float32)
@@ -1578,12 +1637,11 @@ def train(params: Dict[str, Any], x: np.ndarray, y: Optional[np.ndarray] = None,
         if mesh is not None:
             # group-aligned sharding (reference repartition-by-group,
             # ``LightGBMRanker.scala:82-109``): whole queries per shard,
-            # lambdas local, histograms psum'd like every other objective
-            if sparse_in or dev_data:
-                raise NotImplementedError(
-                    "distributed lambdarank reorders rows on upload and needs "
-                    "dense host features; pass a numpy matrix")
-            init_fn, grad_fn, lr_order, lr_wmask, _ = make_lambdarank_mesh(
+            # lambdas local, histograms psum'd like every other objective.
+            # Sparse input reorders the CSR host-side before packing the
+            # shard blocks; a device-resident dataset reorders ON device
+            # (jnp.take by the group order, then reshard) — both below.
+            init_fn, grad_fn, lr_order, lr_wmask, lr_local = make_lambdarank_mesh(
                 group, int(mesh.shape[axis]), axis,
                 truncation=int(p["lambdarank_truncation_level"]),
                 sigma=float(p["sigmoid"]))
@@ -1646,23 +1704,21 @@ def train(params: Dict[str, Any], x: np.ndarray, y: Optional[np.ndarray] = None,
                                categorical_features=cat_features)
             mapper = mapper.fit_csr(csr) if sparse_in else mapper.fit(x)
     has_cat = bool(mapper.categorical_features)
-    if sparse_in and p["boosting"] == "dart" and mesh is not None:
-        raise NotImplementedError(
-            "boosting='dart' over sparse input under a mesh: the drop/re-add "
-            "replay runs over the shard-blocked layout's local row ids; "
-            "train dart single-replica or use gbdt/goss/rf distributed")
     reuse_dataset = dataset is not None and mapper is dataset.mapper
     # Bin on DEVICE when exact: features whose raw values are all
     # f32-representable bin identically via device_bin_cat's floored-f32
     # edges / exact category match (see pack_feature_table), and the
     # vectorized XLA binning replaces the host searchsorted pass — the
-    # single largest fixed cost at multi-million-row scale. f64-only values
-    # (incl. a PRE-FITTED mapper's non-f32 category values) keep the host
-    # path.
+    # single largest fixed cost at multi-million-row scale. Under a mesh
+    # the binning runs SHARD-LOCAL: raw rows upload under the data spec,
+    # the packed edge/category tables replicate, and each shard bins its
+    # own block (no host searchsorted exactly where the row count is
+    # largest). f64-only values (incl. a PRE-FITTED mapper's non-f32
+    # category values) keep the host path.
     from .device_predict import cats_f32_representable
 
     use_device_bin = (not sparse_in
-                      and not reuse_dataset and mesh is None
+                      and not reuse_dataset
                       and cats_f32_representable(mapper)
                       and (x_f32_in
                            or bool(np.all(x == x.astype(np.float32)))))
@@ -1773,7 +1829,14 @@ def train(params: Dict[str, Any], x: np.ndarray, y: Optional[np.ndarray] = None,
         from .sparse import shard_sparse_binned
 
         _ns = mesh.shape[axis]
-        sb_host, _local = shard_sparse_binned(csr, mapper, _ns, (-n) % _ns)
+        if lr_layout is not None:
+            # distributed lambdarank over sparse rows: reorder the CSR into
+            # the group-aligned layout before packing — lr_order already
+            # pads every shard's block to equal length, so no row wrap
+            sb_host, _local = shard_sparse_binned(
+                csr.take_rows(np.asarray(lr_layout[0])), mapper, _ns, 0)
+        else:
+            sb_host, _local = shard_sparse_binned(csr, mapper, _ns, (-n) % _ns)
         sparse_meta = (d, cfg.n_bins, _local, sb_host.max_run)
     # percentile leaf renewal (LightGBM RenewTreeOutput): quantile targets
     # its alpha, L1 the median. Under a mesh the percentile would need a
@@ -1789,6 +1852,18 @@ def train(params: Dict[str, Any], x: np.ndarray, y: Optional[np.ndarray] = None,
         # compose with data-parallel growth only; these paths stay
         # data-parallel (model-axis shards replicate, still correct)
         model_axis = None
+    # deterministic-histogram rounding bound (see _preround): next power of
+    # two over the GLOBAL padded row count. Power-of-two shard counts never
+    # push the padded total past the next power of two, so mesh and
+    # single-device fits of the same data round on the same grid and grow
+    # bit-identical trees.
+    if mesh is None:
+        _n_glob = n
+    elif lr_layout is not None:
+        _n_glob = int(lr_local) * int(mesh.shape[axis])
+    else:
+        _n_glob = n + ((-n) % layout.data_size)
+    n_bound = 1 << max(int(_n_glob) - 1, 1).bit_length()
     step_args = dict(cfg=cfg, C=C, lr=lr, boosting=boosting, d=d,
                      cat_idx=cat_idx, ff=ff, bf=bf, bfreq=bfreq,
                      use_goss=use_goss, top_rate=top_rate,
@@ -1796,7 +1871,8 @@ def train(params: Dict[str, Any], x: np.ndarray, y: Optional[np.ndarray] = None,
                      model_axis=model_axis,
                      pos_bf=float(p['pos_bagging_fraction']),
                      neg_bf=float(p['neg_bagging_fraction']),
-                     sparse_meta=sparse_meta, renew_alpha=renew_alpha)
+                     sparse_meta=sparse_meta, renew_alpha=renew_alpha,
+                     n_bound=n_bound)
     obj_key = (obj_name, C, float(p["alpha"]),
                float(p["tweedie_variance_power"]), float(p["sigmoid"]))
     step_cacheable = fobj is None and obj_name != "lambdarank"
@@ -1839,6 +1915,20 @@ def train(params: Dict[str, Any], x: np.ndarray, y: Optional[np.ndarray] = None,
                          jnp.full((pad,) + a.shape[1:], -0.0, a.dtype)],
                         axis=0)
                 return a
+            if lr_layout is not None:
+                # distributed lambdarank from a device dataset: the group
+                # reorder runs ON device (jnp.take by the group-aligned
+                # order — the raw features never cross to the host); padding
+                # slots get the -0.0 sentinel through the zeroed mask
+                _lr_ord = jnp.asarray(lr_layout[0])
+                _lr_msk = jnp.asarray(lr_layout[1], jnp.float32)
+
+                def dpad(a, fill_first=True):
+                    a = jnp.take(a, _lr_ord, axis=0)
+                    if not fill_first:
+                        a = jnp.where(_lr_msk == 0, jnp.float32(-0.0),
+                                      a * _lr_msk)
+                    return a
             binned_d = dev_put(dpad(dataset.device_binned()), data_spec)
             y_d = dev_put(dpad(
                 y_dev_in.astype(jnp.float32) if y_dev_in is not None
@@ -1866,7 +1956,16 @@ def train(params: Dict[str, Any], x: np.ndarray, y: Optional[np.ndarray] = None,
                 starts=dev_put(sb.starts, data_spec),
                 zero_bin=dev_put(sb.zero_bin, layout.replicated()),
                 d=sb.d, n_bins=sb.n_bins, n=sb.n, max_run=sb.max_run)
-            if pad:
+            if lr_layout is not None:
+                # group-aligned layout: the CSR was packed in lr_order above;
+                # permute labels/weights/margins to match (padding slots get
+                # the -0.0 sentinel via the zeroed mask)
+                lr_order, lr_wmask = lr_layout
+                y = y[lr_order]
+                w_np = np.where(lr_wmask == 0, -0.0,
+                                w_np[lr_order] * lr_wmask)
+                raw0 = raw0[lr_order]
+            elif pad:
                 y = np.concatenate([y, y[:pad]])
                 # -0.0: padding sentinel (zero weight AND zero hist count)
                 w_np = np.concatenate([w_np, np.full(pad, -0.0)])
@@ -1875,23 +1974,59 @@ def train(params: Dict[str, Any], x: np.ndarray, y: Optional[np.ndarray] = None,
             w_d = dev_put(w_np.astype(np.float32), data_spec)
             raw_d = dev_put(raw0.astype(np.float32), data_spec)
         else:
+            x_up = None
+            if use_device_bin:
+                # raw f32 rows go up instead of host-binned codes; the
+                # padding/reorder below applies to whichever matrix ships
+                x_up = np.ascontiguousarray(
+                    x32 if x32 is not None else x.astype(np.float32))
             if lr_layout is not None:
                 # lambdarank group-aligned layout: shard s's block holds its
                 # whole queries (+ -0.0-weight padding); the grad fn's group
                 # tables are in these LOCAL coordinates
                 lr_order, lr_wmask = lr_layout
-                binned_np = binned_np[lr_order]
+                if use_device_bin:
+                    x_up = x_up[lr_order]
+                else:
+                    binned_np = binned_np[lr_order]
                 y = y[lr_order]
                 w_np = np.where(lr_wmask == 0, -0.0,
                                 w_np[lr_order] * lr_wmask)
                 raw0 = raw0[lr_order]
             elif pad:
-                binned_np = np.concatenate([binned_np, binned_np[:pad]], axis=0)
+                if use_device_bin:
+                    x_up = np.concatenate([x_up, x_up[:pad]], axis=0)
+                else:
+                    binned_np = np.concatenate([binned_np, binned_np[:pad]],
+                                               axis=0)
                 y = np.concatenate([y, y[:pad]])
                 # -0.0: padding sentinel (zero weight AND zero hist count)
                 w_np = np.concatenate([w_np, np.full(pad, -0.0)])
                 raw0 = np.concatenate([raw0, raw0[:pad]], axis=0)
-            binned_d = dev_put(binned_np.astype(bin_dtype), data_spec)
+            if use_device_bin:
+                # device-side distributed binning: rows shard over ``data``,
+                # the packed edge/category tables replicate, and each shard
+                # bins its own block through the same vectorized XLA kernel
+                # as the single-device path — so mesh and host-bin fits see
+                # identical bin codes (the parity tests pin the trees
+                # bit-identical)
+                from .device_predict import device_bin_cat, pack_feature_table
+
+                table, lens, cat_flags = pack_feature_table(mapper)
+                rep_spec = layout.replicated()
+                # cat_flags stays on HOST: it is static kernel-selection
+                # metadata (device_bin_cat specializes on it), not data
+                bin_shard = layout.shard_map(
+                    lambda xb, t, ln: device_bin_cat(
+                        xb, t, ln, cat_flags,
+                        mapper.missing_bin).astype(bin_dtype),
+                    in_specs=(data_spec, rep_spec, rep_spec),
+                    out_specs=data_spec, check=False)
+                binned_d = bin_shard(dev_put(x_up, data_spec),
+                                     dev_put(table, rep_spec),
+                                     dev_put(lens, rep_spec))
+            else:
+                binned_d = dev_put(binned_np.astype(bin_dtype), data_spec)
             y_d = dev_put(y.astype(np.float32), data_spec)
             w_d = dev_put(w_np.astype(np.float32), data_spec)
             raw_d = dev_put(raw0.astype(np.float32), data_spec)
@@ -2038,20 +2173,43 @@ def train(params: Dict[str, Any], x: np.ndarray, y: Optional[np.ndarray] = None,
             node[go_right] = s + 1
         return tr.leaf_value[c][node]
 
+    _sparse_replay_mesh = None
+
     def replay_tree(tr, c):
         """(n,) leaf values of one stored tree — dart's drop/re-add replay.
 
         Dense: numpy replay over the host binned matrix. Sparse: device
         replay straight over the binned triple (``predict_binned`` gathers
         each split's column from the SparseBinned — tree bins and the triple
-        share the compact bin space, so no host matrix ever materializes)."""
+        share the compact bin space, so no host matrix ever materializes).
+        Under a mesh the triple's row ids are LOCAL to each shard block, so
+        the replay runs under ``shard_map`` (tree replicated, nodes come
+        back row-sharded over ``data`` at the padded global length)."""
         if not sparse_in:
             return predict_tree_binned(tr, host_binned(), c)
         from .grow import GrownTree, predict_binned as _pb
 
         gt = GrownTree(tr.parent[c], tr.feature[c], tr.bin[c], tr.gain[c],
                        tr.leaf_value[c], tr.leaf_hess[c], tr.cat_set[c])
-        node = np.asarray(_pb(gt, binned_d))
+        if mesh is not None:
+            nonlocal _sparse_replay_mesh
+            if _sparse_replay_mesh is None:
+                from .sparse import SparseBinned
+
+                sb = binned_d
+                rep = layout.replicated()
+                sb_spec = SparseBinned(
+                    rows=data_spec, bins=data_spec, ends=data_spec,
+                    starts=data_spec, zero_bin=rep,
+                    d=sb.d, n_bins=sb.n_bins, n=sb.n, max_run=sb.max_run)
+                # jit for the call cache: every dropped tree replays through
+                # the ONE compiled program instead of re-tracing per tree
+                _sparse_replay_mesh = jax.jit(layout.shard_map(
+                    _pb, in_specs=(rep, sb_spec), out_specs=data_spec,
+                    check=False))
+            node = np.asarray(_sparse_replay_mesh(gt, binned_d))
+        else:
+            node = np.asarray(_pb(gt, binned_d))
         return tr.leaf_value[c][node]
 
     key = jax.random.PRNGKey(int(p["seed"]))
@@ -2067,16 +2225,28 @@ def train(params: Dict[str, Any], x: np.ndarray, y: Optional[np.ndarray] = None,
 
     # Eval/early-stopping WITHOUT dart/callbacks: run chunked device scans —
     # margins and metrics stay on device; only a (chunk, n_eval) metric panel
-    # crosses to host for the early-stop decisions between chunks.
+    # crosses to host for the early-stop decisions between chunks. Under a
+    # mesh the eval sets replicate (see the scan_eval_sharded wrap): mesh
+    # training with an eval_set no longer round-trips predictions through
+    # the host every iteration.
     use_device_eval = (bool(eval_binned) and boosting != "dart"
-                       and not callbacks and mesh is None
+                       and not callbacks
                        and metric_fn is not None
                        and _dev_metric(metric_name) is not None)
     if use_device_eval and num_iter > 0:
-        eval_dev = [(eb if sparse_in else jnp.asarray(eb.astype(bin_dtype)),
-                     jnp.asarray(ey, jnp.float32),
-                     jnp.ones(len(ey), jnp.float32),
-                     jnp.asarray(eraw0, jnp.float32))
+        if mesh is not None:
+            _rep = layout.replicated()
+
+            def _eput(a):
+                return dev_put(a, _rep)
+        else:
+            def _eput(a):
+                return a
+        eval_dev = [(_eput(eb) if sparse_in
+                     else _eput(jnp.asarray(eb.astype(bin_dtype))),
+                     _eput(jnp.asarray(ey, jnp.float32)),
+                     _eput(jnp.ones(len(ey), jnp.float32)),
+                     _eput(jnp.asarray(eraw0, jnp.float32)))
                     for eb, ey, eraw0 in eval_binned]
         base_d = jnp.asarray(base, jnp.float32)
         # small fixed chunk: the whole chunk is trained before stop decisions

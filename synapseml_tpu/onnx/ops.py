@@ -29,7 +29,7 @@ import numpy as np
 from ..core.lazyimport import lazy_import
 
 # resolved on first attribute access inside an op body — importing the
-# 123-op registry (or synapseml_tpu.onnx) stays jax-free (lint SMT001)
+# 129-op registry (or synapseml_tpu.onnx) stays jax-free (lint SMT001)
 jax = lazy_import("jax")
 jnp = lazy_import("jax.numpy")
 lax = lazy_import("jax.lax")
@@ -764,6 +764,78 @@ def _dynamic_quantize_linear(inputs, attrs, ctx):
     zp = jnp.clip(jnp.round(-xmin / safe), 0, 255)
     y = jnp.clip(jnp.round(x / safe) + zp, 0, 255).astype(jnp.uint8)
     return y, scale, zp.astype(jnp.uint8)
+
+
+def _zp_shift(q, zp, axis: int):
+    """Zero-centre a quantized (u)int8 operand in int32: widening BEFORE
+    the zero_point subtraction keeps the accumulation exact (uint8 - 255
+    underflows in-dtype). A 1-D zero_point lies along ``axis``."""
+    q = jnp.asarray(q).astype(jnp.int32)
+    if zp is None:
+        return q
+    zp = jnp.asarray(zp).astype(jnp.int32)
+    if zp.ndim == 1 and q.ndim > 1:
+        shape = [1] * q.ndim
+        shape[axis % q.ndim] = -1
+        zp = zp.reshape(shape)
+    return q - zp
+
+
+@op("MatMulInteger")
+def _matmul_integer(inputs, attrs, ctx):
+    # int32 accumulation over zero-centred operands; per spec a 1-D
+    # a_zero_point is per-row (M axis of A), a 1-D b_zero_point is
+    # per-column (N axis of B). Output is always int32.
+    a = _zp_shift(inputs[0], inputs[2] if len(inputs) > 2 else None, -2)
+    b = _zp_shift(inputs[1], inputs[3] if len(inputs) > 3 else None, -1)
+    return jnp.matmul(a, b, preferred_element_type=jnp.int32)
+
+
+@op("ConvInteger")
+def _conv_integer(inputs, attrs, ctx):
+    # Conv over zero-centred int32 operands (implicit padding therefore
+    # represents x_zero_point, i.e. real zero — onnxruntime semantics);
+    # w_zero_point may be per-output-channel (axis 0 of OIHW)
+    x = _zp_shift(inputs[0], inputs[2] if len(inputs) > 2 else None, 0)
+    w = _zp_shift(inputs[1], inputs[3] if len(inputs) > 3 else None, 0)
+    rank = x.ndim - 2
+    strides = [int(s) for s in attrs.get("strides", [1] * rank)]
+    dilations = [int(d) for d in attrs.get("dilations", [1] * rank)]
+    groups = int(attrs.get("group", 1))
+    pads = _resolve_pads(attrs, rank, x.shape, w.shape[2:], strides, dilations)
+    dn = lax.conv_dimension_numbers(x.shape, w.shape,
+                                    ("NCHW"[: rank + 2], "OIHW"[: rank + 2], "NCHW"[: rank + 2])
+                                    if rank <= 2 else
+                                    ("NCDHW", "OIDHW", "NCDHW"))
+    return lax.conv_general_dilated(
+        x, w, window_strides=strides, padding=pads, rhs_dilation=dilations,
+        dimension_numbers=dn, feature_group_count=groups,
+        preferred_element_type=jnp.int32,
+    )
+
+
+@op("QLinearMatMul")
+def _qlinear_matmul(inputs, attrs, ctx):
+    # full requantizing matmul: int32 accumulate, rescale by
+    # a_scale*b_scale/y_scale, round half to even, re-centre on
+    # y_zero_point and saturate to its dtype. 1-D scales/zero_points are
+    # per-row for a and y, per-column for b (same layout rule as
+    # MatMulInteger).
+    a, a_scale, a_zp, b, b_scale, b_zp, y_scale, y_zp = inputs[:8]
+    acc = jnp.matmul(_zp_shift(a, a_zp, -2), _zp_shift(b, b_zp, -1),
+                     preferred_element_type=jnp.int32)
+
+    def _row(s):  # per-row params broadcast down the output's M axis
+        s = jnp.asarray(s).astype(jnp.float32)
+        return s.reshape(-1, 1) if s.ndim == 1 else s
+
+    scale = _row(a_scale) * jnp.asarray(b_scale).astype(jnp.float32) \
+        / _row(y_scale)
+    qdtype = (np.asarray(y_zp).dtype if isinstance(y_zp, np.ndarray)
+              else np.dtype(y_zp.dtype))
+    y = jnp.round(acc.astype(jnp.float32) * scale) + _row(y_zp)
+    info = np.iinfo(qdtype)
+    return jnp.clip(y, info.min, info.max).astype(qdtype)
 
 
 @op("Where")

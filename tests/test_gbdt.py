@@ -1013,7 +1013,11 @@ def test_lambdarank_mesh_matches_single_replica(eight_device_mesh):
     assert abs(n1 - n8) < 1e-9
 
 
-def test_lambdarank_mesh_device_dataset_raises(eight_device_mesh):
+def test_lambdarank_mesh_device_dataset_matches_numpy(eight_device_mesh):
+    """Distributed lambdarank from a DEVICE-RESIDENT dataset (formerly a
+    refusal guard): the group-aligned reorder runs on device via jnp.take —
+    no host round-trip for the features — and the fit must match the
+    numpy-matrix mesh path bit-for-bit (same binning, same group layout)."""
     import jax.numpy as jnp
 
     from synapseml_tpu.gbdt import GBDTDataset
@@ -1021,10 +1025,17 @@ def test_lambdarank_mesh_device_dataset_raises(eight_device_mesh):
     rng = np.random.default_rng(12)
     xr = rng.normal(size=(64, 4)).astype(np.float32)
     rel = rng.integers(0, 3, size=64).astype(np.float64)
+    group = np.full(8, 8)
+    params = {"objective": "lambdarank", "num_iterations": 3,
+              "num_leaves": 7, "min_data_in_leaf": 3}
     ds = GBDTDataset(jnp.asarray(xr), label=jnp.asarray(rel, jnp.float32))
-    with pytest.raises(NotImplementedError, match="dense host features"):
-        train({"objective": "lambdarank", "num_iterations": 2}, ds,
-              group=np.full(8, 8), mesh=eight_device_mesh)
+    bd = train(dict(params), ds, group=group, mesh=eight_device_mesh)
+    bn = train(dict(params), xr.astype(np.float64), rel, group=group,
+               mesh=eight_device_mesh, mapper=ds.mapper)
+    np.testing.assert_array_equal(bd.leaf_value, bn.leaf_value)
+    np.testing.assert_array_equal(bd.feature, bn.feature)
+    np.testing.assert_allclose(bd.predict(xr.astype(np.float64)),
+                               bn.predict(xr.astype(np.float64)), rtol=1e-6)
 
 
 def test_continued_training_device_dataset():
@@ -1093,6 +1104,88 @@ def test_distributed_matches_single_device_nondivisible(eight_device_mesh):
     np.testing.assert_array_equal(bd.feature, bs.feature)
     np.testing.assert_allclose(bd.predict(x), bs.predict(x),
                                rtol=1e-5, atol=1e-6)
+
+
+def _force_host_bin(monkeypatch):
+    """Route the next train() through HOST binning: boost.py gates
+    use_device_bin on cats_f32_representable (function-level import), so
+    knocking it out on the module is the narrowest off-switch."""
+    from synapseml_tpu.gbdt import device_predict
+
+    monkeypatch.setattr(device_predict, "cats_f32_representable",
+                        lambda mapper: False)
+
+
+def test_mesh_device_bin_matches_host_bin_bitwise(eight_device_mesh,
+                                                  monkeypatch):
+    """The tentpole parity pin: mesh training with SHARD-LOCAL device
+    binning (raw f32 rows sharded, packed edge tables replicated) grows
+    trees BIT-IDENTICAL to single-device host-binned training — the
+    pre-rounded histograms make the psum exact, and device_bin_cat
+    reproduces np.searchsorted binning exactly on f32 grids."""
+    rng = np.random.default_rng(77)
+    x = rng.normal(size=(2400, 10)).astype(np.float32)
+    y = ((x[:, 0] * x[:, 1] + x[:, 2]) > 0).astype(np.float64)
+    params = {"objective": "binary", "num_iterations": 10, "num_leaves": 15,
+              "min_data_in_leaf": 5}
+    bd = train(params, x, y, mesh=eight_device_mesh)  # mesh device-bin
+    _force_host_bin(monkeypatch)
+    bh = train(params, x, y)                          # single-dev host-bin
+    np.testing.assert_array_equal(bd.parent, bh.parent)
+    np.testing.assert_array_equal(bd.feature, bh.feature)
+    np.testing.assert_array_equal(bd.bin, bh.bin)
+    np.testing.assert_array_equal(bd.leaf_value, bh.leaf_value)
+    np.testing.assert_allclose(bd.predict(x), bh.predict(x),
+                               rtol=0, atol=0)
+
+
+def test_mesh_device_bin_categorical_matches_host_bin(eight_device_mesh,
+                                                      monkeypatch):
+    """Categorical features ride the same shard-local device binning (the
+    packed table carries category codes; device_bin_cat dispatches on the
+    host-side cat_flags) and must also be bit-identical to host binning.
+    f64 input with f32-exact values exercises the np.all(x == f32) arm of
+    the use_device_bin gate."""
+    rng = np.random.default_rng(78)
+    n = 2400
+    cats = rng.integers(0, 20, size=n).astype(np.float64)
+    num = rng.normal(size=n).astype(np.float32).astype(np.float64)
+    x = np.stack([cats, num], axis=1)
+    y = np.isin(cats, [1, 5, 7, 11, 16]).astype(np.float64)
+    params = {"objective": "binary", "num_iterations": 6, "num_leaves": 8,
+              "min_data_in_leaf": 5, "categorical_feature": [0]}
+    bd = train(params, x, y, mesh=eight_device_mesh)
+    _force_host_bin(monkeypatch)
+    bh = train(params, x, y)
+    np.testing.assert_array_equal(bd.feature, bh.feature)
+    np.testing.assert_array_equal(bd.bin, bh.bin)
+    np.testing.assert_array_equal(bd.leaf_value, bh.leaf_value)
+    np.testing.assert_array_equal(bd.predict(x), bh.predict(x))
+
+
+def test_mesh_device_eval_early_stop_matches_host(eight_device_mesh,
+                                                  monkeypatch):
+    """Early stopping under the mesh device-eval scan (eval sets
+    REPLICATED, every shard computes the full metric panel) stops at the
+    SAME iteration with the SAME trees as the single-device host eval
+    loop (forced via a no-op callback, which disables the device scan)."""
+    rng = np.random.default_rng(79)
+    x = rng.normal(size=(3000, 8)).astype(np.float32)
+    y = ((x[:, 0] + 0.5 * x[:, 1] + 0.1 * rng.normal(size=3000)) > 0
+         ).astype(np.float64)
+    xt, yt, xv, yv = x[:2400], y[:2400], x[2400:], y[2400:]
+    params = {"objective": "binary", "num_iterations": 40, "num_leaves": 7,
+              "min_data_in_leaf": 5, "early_stopping_round": 5,
+              "metric": "auc"}
+    bd = train(params, xt, yt, eval_set=[(xv, yv)], mesh=eight_device_mesh)
+    _force_host_bin(monkeypatch)
+    bh = train(params, xt, yt, eval_set=[(xv, yv)],
+               callbacks=[lambda *a, **k: None])
+    assert bd.best_iteration == bh.best_iteration
+    np.testing.assert_array_equal(bd.feature[:bd.num_trees],
+                                  bh.feature[:bh.num_trees])
+    np.testing.assert_array_equal(bd.leaf_value[:bd.num_trees],
+                                  bh.leaf_value[:bh.num_trees])
 
 
 def test_train_param_aliases_and_unknown_warning():

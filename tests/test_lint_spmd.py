@@ -222,13 +222,18 @@ def test_smt112_ast_true_negative(tmp_path):
     assert report["findings"] == []
 
 
-def test_smt112_ast_flags_use_device_bin_in_boost():
-    # the acceptance pin: the canonical true finding on the real file
+def test_smt112_boost_device_paths_are_mesh_capable():
+    # the acceptance pin, INVERTED since device-side distributed binning:
+    # use_device_bin / use_device_eval no longer condition on `mesh is
+    # None`, so the canonical true finding is GONE — fixed, not waived.
+    # A regression that re-gates either flag on the mesh resurrects the
+    # finding and fails here.
     report = analyze_paths(
         [os.path.join(REPO_ROOT, "synapseml_tpu", "gbdt", "boost.py")],
         select=["SMT112"], use_acks=False, root=REPO_ROOT)
     msgs = [f.message for f in report["findings"]]
-    assert any("use_device_bin" in m for m in msgs)
+    assert not any("use_device_bin" in m or "use_device_eval" in m
+                   for m in msgs), msgs
 
 
 def test_smt112_jaxpr_true_positive_mesh_only_callback():
@@ -352,14 +357,16 @@ def test_smt114_true_negative(tmp_path):
 def test_smt114_inventory_matches_known_debt():
     # the machine-readable debt inventory: exactly these guards today —
     # adding one without a LINT_ACKS row fails the gate elsewhere; this
-    # test keeps the docs/analysis.md debt table honest
+    # test keeps the docs/analysis.md debt table honest. The two boost.py
+    # refusals (distributed lambdarank over sparse/device features,
+    # dart-over-sparse under a mesh) closed with the device-side
+    # distributed binning change; only the grow.py feature-parallel-
+    # over-sparse refusal remains.
     report = analyze_paths(
         [os.path.join(REPO_ROOT, "synapseml_tpu")],
         select=["SMT114"], use_acks=False, root=REPO_ROOT)
     where = sorted(f.path for f in report["findings"])
-    assert where == ["synapseml_tpu/gbdt/boost.py",
-                     "synapseml_tpu/gbdt/boost.py",
-                     "synapseml_tpu/gbdt/grow.py"]
+    assert where == ["synapseml_tpu/gbdt/grow.py"]
 
 
 # ---------------------------------------------------------------------------
@@ -376,11 +383,14 @@ def test_spmd_pack_skipped_when_selection_has_no_spmd_codes():
 def test_spmd_gate_default_entries_zero_unwaived():
     findings, errors = run_spmd_pack(root=REPO_ROOT)
     assert errors == []
-    # the two standing, reasoned findings the pack was built to surface
+    # the one standing, reasoned finding the pack still surfaces
     assert any(f.code == "SMT110" and "w_tied" in f.message
                for f in findings), "ONNX tp tied-weight replication"
-    assert any(f.code == "SMT113" and "sparse" in f.message
-               for f in findings), "sparse mesh-vs-single divergence"
+    # the sparse mesh-vs-single divergence is GONE: the conditional
+    # per-shard RNG fold and the trace-pair shape fix converged the twins
+    # (test_sparse_mesh_matches_single_device passes; golden pins exit 0)
+    assert not any(f.code == "SMT113" for f in findings), [
+        f.message for f in findings if f.code == "SMT113"]
     waivers = load_waivers(os.path.join(REPO_ROOT, "LINT_ACKS.md"))
     unwaived, waived, _ = apply_waivers(findings, waivers)
     assert unwaived == [], [f"{f.code} {f.location}: {f.message}"
@@ -461,27 +471,40 @@ def test_cli_full_spmd_run_clean():
 
 
 def test_spmd_diff_golden():
-    """The committed golden pins the sparse entry's divergence: the
-    mesh-only RNG fold at the head and the sparse grower's scan-signature
-    drift. A jax upgrade or a grower change that moves the divergence
-    must regenerate the golden DELIBERATELY:
-    ``python tools/spmd_diff.py --entry 'gbdt.grow[sparse,mesh]' --json``.
-    """
+    """The committed golden now pins the sparse pair CONVERGED: after the
+    conditional per-shard RNG fold (no bagging -> no mesh-only RNG head)
+    and the trace-pair shape fix (n=224 kills the dim-aliasing hunk), the
+    mesh and single-device traces are structurally identical and the CLI
+    exits 0. A change that re-diverges them fails here — rerun
+    ``python tools/spmd_diff.py --entry 'gbdt.grow[sparse,mesh]' --json``
+    only for a DELIBERATE regeneration (e.g. a jax upgrade that renames
+    primitives on both sides)."""
     golden_path = os.path.join(REPO_ROOT, "tests", "artifacts",
                                "spmd_diff_sparse_golden.json")
     with open(golden_path) as f:
         golden = json.load(f)
+    assert golden["identical"] is True and golden["hunks"] == []
     r = subprocess.run(
         [sys.executable, os.path.join(REPO_ROOT, "tools", "spmd_diff.py"),
          "--entry", "gbdt.grow[sparse,mesh]", "--json"],
         capture_output=True, text=True, timeout=600)
-    assert r.returncode == 1, r.stderr  # divergent -> exit 1
+    assert r.returncode == 0, r.stderr + r.stdout  # identical -> exit 0
     got = json.loads(r.stdout)
     assert got == golden
-    # and the first hunk IS the reasoned RNG head
-    assert got["hunks"][0]["mesh_index"] == 0
-    assert any("random_fold_in" in line
-               for line in got["hunks"][0]["mesh_only"])
+    assert got["mesh_eqns"] == got["single_eqns"]
+
+
+def test_spmd_diff_device_bin_entry_identical():
+    """The mesh device-bin path (shard-local device_bin_cat over
+    replicated packed tables) must trace structurally identical to the
+    single-device binning kernel — the static half of the
+    bit-identical-trees parity the gbdt tests pin."""
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO_ROOT, "tools", "spmd_diff.py"),
+         "--entry", "gbdt.bin[device,mesh]"],
+        capture_output=True, text=True, timeout=600)
+    assert r.returncode == 0, r.stderr + r.stdout
+    assert "structurally identical" in r.stdout
 
 
 def test_spmd_diff_identical_twin_exits_zero():

@@ -441,6 +441,93 @@ def test_dynamic_quantize_linear_golden():
     np.testing.assert_array_equal(np.asarray(y), [0, 0, 0, 0])
 
 
+def test_matmul_integer_golden():
+    """ONNX spec example: uint8 operands, per-tensor zero points, int32
+    accumulation (widening BEFORE the zp subtraction — naive uint8 math
+    would wrap)."""
+    from synapseml_tpu.onnx.ops import OPS
+
+    a = np.array([[11, 7, 3], [10, 6, 2], [9, 5, 1], [8, 4, 0]], np.uint8)
+    b = np.array([[1, 4], [2, 5], [3, 6]], np.uint8)
+    y = OPS["MatMulInteger"](
+        [jnp.asarray(a), jnp.asarray(b), np.uint8(12), np.uint8(0)], {},
+        {"op_type": "MatMulInteger", "opset": 13})
+    assert np.asarray(y).dtype == np.int32
+    np.testing.assert_array_equal(
+        np.asarray(y),
+        [[-38, -83], [-44, -98], [-50, -113], [-56, -128]])
+    # 1-D b_zero_point is per-COLUMN: shifting column 1 by 1 subtracts
+    # sum(A - a_zp) per row from that column only
+    y2 = OPS["MatMulInteger"](
+        [jnp.asarray(a), jnp.asarray(b), np.uint8(12),
+         np.array([0, 1], np.uint8)], {},
+        {"op_type": "MatMulInteger", "opset": 13})
+    row_sums = (a.astype(np.int32) - 12).sum(1)
+    np.testing.assert_array_equal(
+        np.asarray(y2)[:, 1], np.asarray(y)[:, 1] - row_sums)
+
+
+def test_conv_integer_golden():
+    """ONNX spec example: 3x3 uint8 image, x_zero_point 1, all-ones 2x2
+    kernel -> plain 2x2 window sums of (x - 1), int32 out."""
+    from synapseml_tpu.onnx.ops import OPS
+
+    x = np.arange(2, 11, dtype=np.uint8).reshape(1, 1, 3, 3)
+    w = np.ones((1, 1, 2, 2), np.uint8)
+    y = OPS["ConvInteger"](
+        [jnp.asarray(x), jnp.asarray(w), np.uint8(1)], {},
+        {"op_type": "ConvInteger", "opset": 13})
+    assert np.asarray(y).dtype == np.int32
+    np.testing.assert_array_equal(
+        np.asarray(y).reshape(2, 2), [[12, 16], [24, 28]])
+    # with explicit padding the implicit border contributes zero in the
+    # shifted domain, i.e. real x_zero_point pixels (onnxruntime semantics)
+    yp = OPS["ConvInteger"](
+        [jnp.asarray(x), jnp.asarray(w), np.uint8(1)],
+        {"pads": [1, 1, 1, 1]},
+        {"op_type": "ConvInteger", "opset": 13})
+    assert np.asarray(yp).shape == (1, 1, 4, 4)
+    np.testing.assert_array_equal(np.asarray(yp)[0, 0, 1:3, 1:3],
+                                  [[12, 16], [24, 28]])
+    assert int(np.asarray(yp)[0, 0, 0, 0]) == 1  # lone corner pixel: 2-1
+
+
+def test_qlinear_matmul_golden():
+    """ONNX spec example: full requantizing uint8 matmul (int32
+    accumulate, rescale, round half to even, re-centre, saturate)."""
+    from synapseml_tpu.onnx.ops import OPS
+
+    a = np.array([[208, 236, 0, 238], [3, 214, 255, 29]], np.uint8)
+    b = np.array([[152, 51, 244], [60, 26, 255], [0, 127, 246],
+                  [127, 254, 247]], np.uint8)
+    y = OPS["QLinearMatMul"](
+        [jnp.asarray(a), np.float32(0.0066), np.uint8(113),
+         jnp.asarray(b), np.float32(0.00705), np.uint8(114),
+         np.float32(0.0107), np.uint8(118)], {},
+        {"op_type": "QLinearMatMul", "opset": 13})
+    assert np.asarray(y).dtype == np.uint8
+    np.testing.assert_array_equal(np.asarray(y),
+                                  [[168, 115, 255], [1, 66, 151]])
+
+
+def test_matmul_integer_graph_matches_dequant_path():
+    """MatMulInteger through a real graph == dequantize-then-float-matmul
+    to within accumulated float error, and exactly equals the exact
+    integer reference."""
+    rng = np.random.default_rng(11)
+    a = rng.integers(0, 255, size=(6, 16), dtype=np.uint8)
+    w = rng.integers(0, 255, size=(16, 5), dtype=np.uint8)
+    fn = build_fn(
+        [node("MatMulInteger", ["a", "w", "az", "wz"], ["y"])],
+        [value_info("a", np.uint8, [None, 16])],
+        [value_info("y", np.int32, None)],
+        {"w": w, "az": np.uint8(121), "wz": np.uint8(130)},
+    )
+    y = np.asarray(fn({"a": a})["y"])
+    ref = (a.astype(np.int32) - 121) @ (w.astype(np.int32) - 130)
+    np.testing.assert_array_equal(y, ref)
+
+
 def test_quantize_dequantize_roundtrip_graph():
     """Q -> DQ through a real graph stays within one quantization step."""
     rng = np.random.default_rng(7)

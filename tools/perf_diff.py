@@ -49,6 +49,7 @@ PRIMARY = {
     "bert_base_onnx": "sequences_per_sec_per_chip",
     "gbdt_higgs_scale": "train_rows_per_sec",
     "gbdt_sparse_hashed": "train_rows_per_sec",
+    "gbdt_mesh_bin": "train_rows_per_sec",
     "vit_to_gbdt_pipeline": "images_per_sec_end_to_end",
     "flash_attention_32k": "tflops_nominal",
     "flash_attention_gqa": "tflops_nominal",
