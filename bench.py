@@ -584,6 +584,113 @@ def bench_onnx_tp(platform, peak):
             "compile_warm_s": round(warm_s, 2)}
 
 
+def bench_onnx_fsdp_hbm(platform):
+    """Beyond-HBM serving lane (ROADMAP item 4): the same ONNX graph
+    served twice — fully replicated (control) and over a 3-D
+    ``(data, fsdp, model)`` ``SpecLayout`` with weights STORED
+    row-sharded over 'fsdp' and all-gathered transiently at each
+    consumer. Stamps ``hbm_peak_bytes`` — the exact per-device at-rest
+    weight residency (shard bytes per device), the same proxy on every
+    backend so the ratio is apples-to-apples; the raw
+    ``device.memory_stats()`` watermark rides along as
+    ``device_hbm_peak_bytes`` when the backend reports one — plus the
+    ratios the ratchet gates on
+    (tests/test_bench_ratchet.py): ``hbm_vs_replicated`` must stay below
+    1.0 while ``rows_per_sec_ratio`` holds >= 0.9; breaching either
+    needs a reasoned ``hbm:``/``thr:`` BENCH_ACKS.md waiver."""
+    import jax
+
+    from synapseml_tpu.observability.profiling import memory_stats
+    from synapseml_tpu.onnx import builder
+    from synapseml_tpu.onnx.importer import OnnxFunction
+    from synapseml_tpu.onnx.wire import serialize_model
+    from synapseml_tpu.runtime.layout import SpecLayout
+
+    n_dev = len(jax.devices())
+    model_sz = 2 if n_dev % 2 == 0 else 1
+    fsdp_sz = 2 if model_sz == 2 and n_dev % 4 == 0 else 1
+    fsdp_kw = {"fsdp": fsdp_sz} if fsdp_sz > 1 else {}
+    layout = SpecLayout.build(data=1, model=model_sz,
+                              devices=jax.devices()[:fsdp_sz * model_sz],
+                              **fsdp_kw)
+    d, hsz = (512, 4096) if platform != "cpu" else (256, 1024)
+    rng = np.random.default_rng(7)
+    w1 = (rng.normal(size=(d, hsz)) / np.sqrt(d)).astype(np.float32)
+    b1 = np.zeros(hsz, np.float32)
+    w2 = (rng.normal(size=(hsz, d)) / np.sqrt(hsz)).astype(np.float32)
+    g = builder.make_graph(
+        [builder.node("MatMul", ["x", "w1"], ["h0"]),
+         builder.node("Add", ["h0", "b1"], ["h1"]),
+         builder.node("Relu", ["h1"], ["h2"]),
+         builder.node("MatMul", ["h2", "w2"], ["y"])],
+        "fsdp_mlp",
+        [builder.value_info("x", np.float32, [None, d])],
+        [builder.value_info("y", np.float32, [None, d])],
+        initializers={"w1": w1, "b1": b1, "w2": w2})
+    mb = serialize_model(builder.make_model(g))
+    batch = 256 if platform != "cpu" else 64
+    x = rng.normal(size=(batch, d)).astype(np.float32)
+    # float32 both sides: byte accounting must compare like with like
+    fn_rep = OnnxFunction(mb, dtype_policy="float32")
+    fn_fsdp = OnnxFunction(mb, dtype_policy="float32", layout=layout)
+    stored = [r for r in fn_fsdp.placement_report()
+              if r["decision"] == "fsdp"]
+    ref = np.asarray(fn_rep({"x": x})["y"], np.float32)
+    out = np.asarray(fn_fsdp({"x": x})["y"], np.float32)
+    rel_err = float(np.abs(out - ref).max() / max(np.abs(ref).max(), 1e-6))
+
+    def _resident_weight_bytes(fn):
+        # exact at-rest residency of the executor's weights, per device:
+        # sharded jax arrays count their local shard bytes, host numpy
+        # constants stage replicated onto every device of the layout
+        per_dev: dict = {}
+        n_layout_dev = fsdp_sz * model_sz
+        for arr in fn.constants.values():
+            shards = getattr(arr, "addressable_shards", None)
+            if shards:
+                for sh in shards:
+                    did = sh.device.id
+                    per_dev[did] = per_dev.get(did, 0) + int(sh.data.nbytes)
+            else:
+                for did in range(n_layout_dev):
+                    per_dev[did] = per_dev.get(did, 0) + int(
+                        getattr(arr, "nbytes", 0))
+        return max(per_dev.values())
+
+    # the ratio is always proxy-vs-proxy (exact, apples-to-apples); the
+    # raw allocator watermark — smt_device_hbm_peak_bytes' source — is
+    # stamped alongside when the backend reports one (it also contains
+    # activations and the replicated control run, so it must not feed
+    # the ratio)
+    rep_bytes = _resident_weight_bytes(fn_rep)
+    fsdp_bytes = _resident_weight_bytes(fn_fsdp)
+    stats = memory_stats()
+    device_peak = max(int(ms.get("peak_bytes_in_use",
+                                 ms.get("bytes_in_use", 0)))
+                      for _, ms in stats) if stats else None
+
+    def step_rep(eps, xv):
+        return fn_rep._run_positional(xv + eps)[0].sum()
+
+    def step_fsdp(eps, xv):
+        return fn_fsdp._run_positional(xv + eps)[0].sum()
+
+    iters = 20 if platform != "cpu" else 4
+    dt_rep, _, _ = _timed_device_loop(step_rep, iters, x)
+    dt_fsdp, _, warm_s = _timed_device_loop(step_fsdp, iters, x)
+    return {"rows_per_sec": round(batch / dt_fsdp, 1),
+            "rows_per_sec_ratio": round(dt_rep / dt_fsdp, 3),
+            "hbm_peak_bytes": int(fsdp_bytes),
+            "hbm_peak_bytes_replicated": int(rep_bytes),
+            "hbm_vs_replicated": round(fsdp_bytes / max(rep_bytes, 1), 3),
+            "device_hbm_peak_bytes": device_peak,
+            "fsdp": fsdp_sz, "model": model_sz,
+            "stored_weights": len(stored),
+            "stored_bytes": int(sum(r["nbytes"] for r in stored)),
+            "parity_max_rel_err": rel_err,
+            "compile_warm_s": round(warm_s, 2)}
+
+
 def bench_serving(platform):
     """Serving latency p50/p99: continuous (push) vs micro-batch engines over
     a trivial pipeline. Reference north-star: sub-millisecond continuous p50
@@ -1492,6 +1599,41 @@ def stagnation_violations(here=None, n_rounds=STAGNATION_ROUNDS,
     return offenders
 
 
+FSDP_HBM_CEILING = 1.0       # hbm_vs_replicated at/above this fails CI
+FSDP_THROUGHPUT_FLOOR = 0.9  # rows_per_sec_ratio below this fails CI
+
+
+def fsdp_hbm_violations(here=None, waivers=None):
+    """The beyond-HBM lane's ABSOLUTE gate (round-over-round ratios
+    cannot see it): ``onnx_fsdp_hbm.hbm_vs_replicated`` must stay below
+    :data:`FSDP_HBM_CEILING` — fsdp storage that stops saving memory is
+    the lane's whole point gone — while ``rows_per_sec_ratio`` holds
+    >= :data:`FSDP_THROUGHPUT_FLOOR` (the all-gather-on-use must not
+    buy that memory with the throughput the HBM headroom exists to
+    raise). Waive as ``(round, "hbm:onnx_fsdp_hbm")`` /
+    ``(round, "thr:onnx_fsdp_hbm")``."""
+    import os
+
+    if here is None:
+        here = os.path.dirname(os.path.abspath(__file__))
+    if waivers is None:
+        waivers = load_waivers(os.path.join(here, "BENCH_ACKS.md"))
+    offenders = []
+    for rnd, extra in sorted(_committed_rounds(here).items()):
+        lane = extra.get("onnx_fsdp_hbm")
+        if not isinstance(lane, dict):
+            continue
+        hbm = lane.get("hbm_vs_replicated")
+        if isinstance(hbm, (int, float)) and hbm >= FSDP_HBM_CEILING \
+                and (rnd, "hbm:onnx_fsdp_hbm") not in waivers:
+            offenders.append((rnd, "hbm:onnx_fsdp_hbm", hbm))
+        thr = lane.get("rows_per_sec_ratio")
+        if isinstance(thr, (int, float)) and thr < FSDP_THROUGHPUT_FLOOR \
+                and (rnd, "thr:onnx_fsdp_hbm") not in waivers:
+            offenders.append((rnd, "thr:onnx_fsdp_hbm", thr))
+    return offenders
+
+
 def unwaived_regressions(here=None, threshold=RATCHET_THRESHOLD,
                          waivers=None):
     """The one CI gate (tests/test_bench_ratchet.py asserts it empty):
@@ -1501,8 +1643,11 @@ def unwaived_regressions(here=None, threshold=RATCHET_THRESHOLD,
     - per-lane ``vs_prev_round`` ratios below ``threshold``
       (``(round, lane, ratio)``),
     - lane MFU under its :data:`MFU_FLOORS` floor
-      (``(round, "mfu:<lane>", mfu)``), and
+      (``(round, "mfu:<lane>", mfu)``),
     - flat-with-headroom stagnation (``(round, "flat:<lane>", value)``),
+    - the beyond-HBM lane's absolute gate
+      (``(round, "hbm:onnx_fsdp_hbm", ratio)`` /
+      ``(round, "thr:onnx_fsdp_hbm", ratio)``),
 
     each without a matching ``BENCH_ACKS.md`` waiver row. Empty means the
     ratchet holds."""
@@ -1522,6 +1667,7 @@ def unwaived_regressions(here=None, threshold=RATCHET_THRESHOLD,
                 offenders.append((rnd, config, ratio))
     offenders.extend(mfu_violations(here=here, waivers=waivers))
     offenders.extend(stagnation_violations(here=here, waivers=waivers))
+    offenders.extend(fsdp_hbm_violations(here=here, waivers=waivers))
     return offenders
 
 
@@ -1537,6 +1683,7 @@ _PRIMARY = {
     "flash_attention_32k": "tflops_nominal",
     "flash_attention_gqa": "tflops_nominal",
     "onnx_tp_sharding": "rows_per_sec",
+    "onnx_fsdp_hbm": "rows_per_sec",
     "serving_overload": "p99_collapse_ratio",
     "multi_tenant_serving": "uncontended_throughput_ratio",
     "swap_under_load": "swap_p99_ratio",
@@ -1570,7 +1717,7 @@ def stale_waivers(here=None, waivers=None):
     stale = []
     for rnd, config in sorted(waivers):
         lane = config.split(":", 1)[1] if config.startswith(
-            ("mfu:", "flat:")) else config
+            ("mfu:", "flat:", "hbm:", "thr:")) else config
         if rnd not in rounds:
             stale.append((rnd, config,
                           f"round {rnd} has no committed BENCH_r*.json"))
@@ -1660,6 +1807,7 @@ def main(argv=None) -> int:
         ("flash_attention_32k", lambda: bench_flash_attention(platform, peak)),
         ("flash_attention_gqa", lambda: bench_flash_gqa(platform, peak)),
         ("onnx_tp_sharding", lambda: bench_onnx_tp(platform, peak)),
+        ("onnx_fsdp_hbm", lambda: bench_onnx_fsdp_hbm(platform)),
         ("serving_latency", lambda: bench_serving(platform)),
         ("serving_overload", lambda: bench_serving_overload(platform)),
         ("multi_tenant_serving",

@@ -14,9 +14,9 @@ diverge where they should not (the bisection instrument
 ``test_sparse_mesh_matches_single_device`` needs).
 
 This pack traces the canonical entry points under representative
-``SpecLayout``s — (1, 1), (4, 2) feature-parallel, and a (1, 2)
-tensor-parallel ONNX serving layout — and walks the jaxprs with sharding
-awareness. Two rules additionally run as ordinary AST rules in the
+``SpecLayout``s — (1, 1), (4, 2) feature-parallel, and a (1, 2, 2)
+fsdp+tensor-parallel ONNX serving layout — and walks the jaxprs with
+sharding awareness. Two rules additionally run as ordinary AST rules in the
 default jax-free pass (SMT112's host-fallback-guard half and SMT114's
 refusal-guard inventory), so the debt they enumerate cannot silently
 grow even when no one pays for a trace.
@@ -350,7 +350,11 @@ class ConstraintConflict(SpmdRule):
     placement disagreement, invisible in the source because each
     constraint looks locally reasonable. Flags any value that is
     re-constrained to a different spec (directly chained or fanned out
-    from the same producer).
+    from the same producer). One chain is sanctioned: the fsdp
+    all-gather-on-use re-pin (``layout.gather_for_use``), where the later
+    spec is exactly the earlier spec with the layout's fsdp axis dropped
+    — that reshard is the POINT (transient gathered copy, row-sharded
+    residency), not a disagreement.
     """
 
     code = "SMT111"
@@ -359,17 +363,30 @@ class ConstraintConflict(SpmdRule):
                  "GSPMD to insert an implicit reshard on the hot path")
 
     @staticmethod
-    def _constraint_spec(eqn) -> Optional[str]:
+    def _constraint_spec(eqn) -> Optional[Any]:
         s = eqn.params.get("sharding")
         if s is None:
             return None
-        return str(getattr(s, "spec", s))
+        return getattr(s, "spec", s)
+
+    @staticmethod
+    def _is_fsdp_repin(layout, a, b) -> bool:
+        """True when one spec is the other's all-gathered *use* form under
+        the layout's fsdp axis — the intentional stored→use re-pin (or the
+        symmetric use→stored re-shard after an update step)."""
+        use_spec = getattr(layout, "use_spec", None)
+        if use_spec is None or getattr(layout, "fsdp_axis", None) is None:
+            return False
+        try:
+            return use_spec(a) == b or use_spec(b) == a
+        except Exception:
+            return False
 
     def check_entry(self, traced: TracedSpmdEntry) -> Iterable[Finding]:
         if not traced.entry.hot:
             return []
         findings: List[Finding] = []
-        committed: Dict[int, str] = {}   # id(var) -> spec committed to it
+        committed: Dict[int, Any] = {}   # id(var) -> spec committed to it
         seen_pairs: Set[Tuple[str, str]] = set()
         for eqn in iter_eqns(traced.closed.jaxpr):
             prim = getattr(eqn.primitive, "name", "?")
@@ -378,15 +395,20 @@ class ConstraintConflict(SpmdRule):
             spec = self._constraint_spec(eqn)
             if spec is None:
                 continue
+            key = str(spec)
             for var in eqn.invars:
                 prev = committed.get(id(var))
-                if prev is not None and prev != spec \
-                        and (prev, spec) not in seen_pairs:
-                    seen_pairs.add((prev, spec))
+                if prev is None:
+                    continue
+                pkey = str(prev)
+                if pkey != key and (pkey, key) not in seen_pairs \
+                        and not self._is_fsdp_repin(traced.layout,
+                                                    prev, spec):
+                    seen_pairs.add((pkey, key))
                     findings.append(self.entry_finding(
                         traced,
-                        f"value constrained to {prev} is re-constrained to "
-                        f"{spec} — GSPMD must insert an implicit "
+                        f"value constrained to {pkey} is re-constrained to "
+                        f"{key} — GSPMD must insert an implicit "
                         f"all-gather/reshard between the two pins; agree on "
                         f"one spec per value"))
             for var in eqn.outvars:
@@ -630,8 +652,10 @@ class RefusalGuardInventory(Rule):
 def _spmd_mlp_bytes():
     """The tp-serving stand-in model: the tiny MLP plus a TIED projection
     weight consumed in two roles (``MatMul`` rhs AND ``Gemm`` transB rhs —
-    the tied-embedding pattern). The planner replicates on the role
-    conflict; SMT110 is what makes that decision visible."""
+    the tied-embedding pattern). Under a tp-only layout the planner
+    replicates on the role conflict (SMT110's canonical finding); under
+    an fsdp layout it stores the tied weight row-sharded and all-gathers
+    at each consumer — the finding's resolution."""
     import numpy as np
 
     from ..onnx import builder
@@ -655,17 +679,21 @@ def _spmd_mlp_bytes():
     return serialize_model(builder.make_model(g))
 
 
-def _build_onnx_tp_entry() -> Dict[str, Any]:
-    """Model-parallel ONNX serving over a (1, 2) layout: MatMul weights
-    column-shard over ``model``, the tied weight replicates on the role
-    conflict (SMT110's canonical planner finding). The no-layout twin
-    gives SMT113 a structurally-identical baseline (constraints strip)."""
+def _build_onnx_fsdp_entry() -> Dict[str, Any]:
+    """Beyond-HBM ONNX serving over the (1, 2, 2) layout: MatMul weights
+    column-shard over ``model`` and are additionally STORED row-sharded
+    over ``fsdp`` (all-gathered at each consumer). The tied weight — the
+    planner's old replicate-on-conflict debt, SMT110's canonical finding
+    — now stores over fsdp too, so the finding is resolved rather than
+    waived; SMT111 sees the stored→use re-pin chain and must recognize it
+    as the sanctioned fsdp gather. The no-layout twin gives SMT113 a
+    structurally-identical baseline (constraints strip)."""
     import numpy as np
 
     from ..onnx.importer import OnnxFunction
     from ..runtime.layout import representative_layouts
 
-    layout = representative_layouts()["(1,2)-tp"]
+    layout = representative_layouts()["(1,2,2)"]
     model = _spmd_mlp_bytes()
     of = OnnxFunction(model, dtype_policy="float32", layout=layout)
     single = OnnxFunction(model, dtype_policy="float32")
@@ -755,12 +783,13 @@ def _build_gbdt_device_bin_entry() -> Dict[str, Any]:
 
 def default_spmd_entries() -> List[SpmdEntry]:
     """The canonical entries, one per representative layout: (1, 1)
-    degenerate, (4, 2) feature-parallel, (1, 2) tensor-parallel serving,
-    the sparse mesh-vs-single differential pair, and the shard-local
-    device-binning pair the mesh ``use_device_bin`` path runs."""
+    degenerate, (4, 2) feature-parallel, (1, 2, 2) fsdp+tensor-parallel
+    serving, the sparse mesh-vs-single differential pair, and the
+    shard-local device-binning pair the mesh ``use_device_bin`` path
+    runs."""
     return [
-        SpmdEntry("onnx.mlp[tp,(1,2)]", _build_onnx_tp_entry,
-                  mesh_axes=("data", "model"),
+        SpmdEntry("onnx.mlp[fsdp,(1,2,2)]", _build_onnx_fsdp_entry,
+                  mesh_axes=("data", "fsdp", "model"),
                   replicated_bytes_limit=32 << 10),
         SpmdEntry("gbdt.grow[feature-parallel,(1,1)]",
                   _build_gbdt_fp_entry("(1,1)"),
@@ -779,7 +808,7 @@ def differential_entry_names() -> List[str]:
     """Entries carrying a single-device twin (what ``tools/spmd_diff.py``
     can diff) — static so ``--list`` stays jax-free."""
     return ["gbdt.grow[sparse,mesh]", "gbdt.bin[device,mesh]",
-            "onnx.mlp[tp,(1,2)]"]
+            "onnx.mlp[fsdp,(1,2,2)]"]
 
 
 # ---------------------------------------------------------------------------

@@ -1800,11 +1800,11 @@ def train(params: Dict[str, Any], x: np.ndarray, y: Optional[np.ndarray] = None,
         max_cat_threshold=int(p["max_cat_threshold"]),
         parallelism="voting" if parallelism.startswith("voting") else "data",
         top_k=int(p["top_k"]),
-        # multiclass vmaps grow_tree: a vmapped lax.switch would run every
-        # buffer branch (~2n/step), so C > 1 switches to the branch-free
-        # fixed covering buffer instead of giving the fast path up
-        # (sparse growth is already leaf-transient by construction)
-        leaf_local=bool(p["leaf_local"]) and not sparse_in,
+        # multiclass vmaps grow_tree: a vmapped lax.cond/switch runs every
+        # branch (~2 full histogram passes/step), so C > 1 keeps the fast
+        # path off.  Sparse single-class growth routes through the
+        # carried-histogram half-pass in _grow_tree_sparse instead.
+        leaf_local=bool(p["leaf_local"]) and not (sparse_in and C > 1),
         leaf_buf_fixed=C > 1,
     )
     cat_mask_np = None
@@ -2009,7 +2009,14 @@ def train(params: Dict[str, Any], x: np.ndarray, y: Optional[np.ndarray] = None,
                 # bins its own block through the same vectorized XLA kernel
                 # as the single-device path — so mesh and host-bin fits see
                 # identical bin codes (the parity tests pin the trees
-                # bit-identical)
+                # bit-identical).
+                # The packed edge tables stay REPLICATED even on an fsdp
+                # layout (no store-over-fsdp): every shard reads every
+                # feature's edges every binning step (rows x all features),
+                # so a row-sharded table would all-gather per step and save
+                # nothing between steps — the table is (d, max_bins+1) f32,
+                # orders of magnitude under the weight tensors the fsdp
+                # axis exists for, and binning is one-shot per fit anyway.
                 from .device_predict import device_bin_cat, pack_feature_table
 
                 table, lens, cat_flags = pack_feature_table(mapper)

@@ -480,7 +480,8 @@ def _grow_tree_sparse(sb, grad, hess, row_weight, feature_mask,
     import jax.numpy as jnp
     from jax import lax
 
-    from .sparse import sparse_column, sparse_histogram_split
+    from .sparse import (sparse_column, sparse_histogram_side,
+                         sparse_histogram_split)
 
     n = grad.shape[0]
     d, B = sb.d, sb.n_bins
@@ -488,6 +489,18 @@ def _grow_tree_sparse(sb, grad, hess, row_weight, feature_mask,
     l1, l2 = cfg.lambda_l1, cfg.lambda_l2
     has_cat = cat_mask is not None
     voting = cfg.parallelism == "voting" and axis_name is not None
+    # Leaf-local half pass (the sparse analogue of the dense gather
+    # ladder): leaf-wise growth usually splits a leaf the PREVIOUS step
+    # just materialized, so its full (d, B, 3) histogram is still in hand
+    # — carry the last step's two child panels, histogram only the
+    # SMALLER child of the current split (a 3-channel pass instead of the
+    # 6-channel both-sides pass) and derive the sibling by parent
+    # subtraction. Opt-in: the carry keeps one (2, d, B, 3) panel
+    # resident for the whole loop, a real cost at hashed-text width.
+    # Voting mode is excluded — PV-tree's election works off LOCAL
+    # histograms and reduces only elected candidates, so a carried
+    # REDUCED parent panel has nothing to subtract from.
+    use_ll = bool(cfg.leaf_local) and not voting
     if voting:
         k_local = min(cfg.top_k, d)
         k_global = min(2 * cfg.top_k, d)
@@ -565,17 +578,19 @@ def _grow_tree_sparse(sb, grad, hess, row_weight, feature_mask,
         feat = jnp.take_along_axis(sel, (idx // B)[:, None], axis=1)[:, 0]
         return bg, feat.astype(jnp.int32), (idx % B).astype(jnp.int32)
 
-    def split_and_summarize(side):
-        """side (n,) {0 left, 1 right, 2 inactive} -> child summaries+totals."""
-        ghc = jnp.stack([grad * row_weight, hess * row_weight, row_weight],
+    ghc_all = jnp.stack([grad * row_weight, hess * row_weight, row_weight],
                         axis=-1)
-        h2, totals = sparse_histogram_split(sb, ghc, side)
+
+    def split_and_summarize(side):
+        """side (n,) {0 left, 1 right, 2 inactive} -> child summaries +
+        totals + the (possibly reduced) child histograms themselves."""
+        h2, totals = sparse_histogram_split(sb, ghc_all, side)
         if axis_name is not None:
             totals = lax.psum(totals, axis_name)
             if not voting:
                 h2 = lax.psum(h2, axis_name)
         bg, bf, bb = best_of_children(h2)
-        return bg, bf, bb, totals
+        return bg, bf, bb, totals, h2
 
     nnz_pad = sb.rows.shape[0]
 
@@ -602,8 +617,13 @@ def _grow_tree_sparse(sb, grad, hess, row_weight, feature_mask,
         return hist.at[jnp.take(sb.zero_bin, f)].add(tot - hist.sum(0))
 
     def step(s, state):
-        (node, best_gain, best_feat, best_bin, G_leaf, H_leaf,
-         parent, feat, bin_, gains, cat_sets, depth) = state
+        if use_ll:
+            (node, best_gain, best_feat, best_bin, G_leaf, H_leaf,
+             parent, feat, bin_, gains, cat_sets, depth,
+             carry_h2, carry_ids) = state
+        else:
+            (node, best_gain, best_feat, best_bin, G_leaf, H_leaf,
+             parent, feat, bin_, gains, cat_sets, depth) = state
         leaf_gain = best_gain
         if cfg.max_depth > 0:
             leaf_gain = jnp.where(depth < cfg.max_depth, leaf_gain, -jnp.inf)
@@ -642,8 +662,56 @@ def _grow_tree_sparse(sb, grad, hess, row_weight, feature_mask,
         node = jnp.where(went_right, s + 1, node)
         side = jnp.where(member & ok,
                          jnp.where(go_left, 0, 1), 2).astype(jnp.int32)
-        (c_gain, c_feat, c_bin), totals = (lambda r: (r[:3], r[3]))(
-            split_and_summarize(side))
+        if use_ll:
+            # leaf-local half-pass: when the leaf being split is one of the
+            # two children produced by the PREVIOUS step, its reduced
+            # histogram is already in the carry — histogram only the smaller
+            # child and derive the sibling as parent - small.  ``l`` (and so
+            # ``hit``) comes from the REDUCED summaries, uniform across
+            # shards, and no collective sits inside either cond branch; the
+            # psum happens once, outside.
+            cnt_l = (side == 0).sum().astype(jnp.int32)
+            cnt_r = (side == 1).sum().astype(jnp.int32)
+            if axis_name is not None:
+                cnt_l = lax.psum(cnt_l, axis_name)
+                cnt_r = lax.psum(cnt_r, axis_name)
+            smaller_right = cnt_r <= cnt_l
+            hit = (l == carry_ids[0]) | (l == carry_ids[1])
+            parent_h = jnp.where(l == carry_ids[0], carry_h2[0], carry_h2[1])
+            mask_small = jnp.where(smaller_right, side == 1, side == 0)
+
+            def _half(_):
+                h_small, _t = sparse_histogram_side(sb, ghc_all, mask_small)
+                return jnp.stack([h_small, h_small])
+
+            def _full(_):
+                h2_loc, _t = sparse_histogram_split(sb, ghc_all, side)
+                return h2_loc
+
+            h2 = lax.cond(hit, _half, _full, None)
+            if axis_name is not None:
+                h2 = lax.psum(h2, axis_name)
+            small = h2[0]
+            h2_hit = jnp.where(smaller_right,
+                               jnp.stack([parent_h - small, small]),
+                               jnp.stack([small, parent_h - small]))
+            h2 = jnp.where(hit, h2_hit, h2)
+            # totals from masked panel sums directly — bitwise identical to
+            # the full pass's ghc6 channel sums, so leaf values never depend
+            # on which histogram path ran
+            totals = jnp.stack(
+                [(ghc_all * (side == 0).astype(jnp.float32)[:, None]).sum(0),
+                 (ghc_all * (side == 1).astype(jnp.float32)[:, None]).sum(0)])
+            if axis_name is not None:
+                totals = lax.psum(totals, axis_name)
+            c_gain, c_feat, c_bin = best_of_children(h2)
+            carry_h2 = jnp.where(ok, h2, carry_h2)
+            new_ids = jnp.stack([l.astype(jnp.int32),
+                                 jnp.asarray(s + 1, jnp.int32)])
+            carry_ids = jnp.where(ok, new_ids, carry_ids)
+        else:
+            (c_gain, c_feat, c_bin), totals = (lambda r: (r[:3], r[3]))(
+                split_and_summarize(side))
         upd = lambda a, v0, v1: a.at[l].set(v0).at[s + 1].set(v1)
         best_gain = jnp.where(ok, upd(best_gain, c_gain[0], c_gain[1]),
                               best_gain)
@@ -662,12 +730,15 @@ def _grow_tree_sparse(sb, grad, hess, row_weight, feature_mask,
         child_depth = jnp.where(ok, depth[l] + 1, depth[l]).astype(jnp.int32)
         depth = jnp.where(ok, depth.at[s + 1].set(child_depth)
                           .at[l].set(child_depth), depth)
-        return (node, best_gain, best_feat, best_bin, G_leaf, H_leaf,
-                parent, feat, bin_, gains, cat_sets, depth)
+        out = (node, best_gain, best_feat, best_bin, G_leaf, H_leaf,
+               parent, feat, bin_, gains, cat_sets, depth)
+        if use_ll:
+            out = out + (carry_h2, carry_ids)
+        return out
 
     # root: everything on side 0
     root_side = jnp.zeros(n, jnp.int32)
-    r_gain, r_feat, r_bin, r_tot = split_and_summarize(root_side)
+    r_gain, r_feat, r_bin, r_tot, r_h2 = split_and_summarize(root_side)
     neg = jnp.full(L, -jnp.inf, jnp.float32)
     state0 = (
         jnp.zeros(n, dtype=jnp.int32),
@@ -683,8 +754,17 @@ def _grow_tree_sparse(sb, grad, hess, row_weight, feature_mask,
         jnp.zeros((L - 1, B), dtype=jnp.int8),
         jnp.zeros(L, dtype=jnp.int32),
     )
-    (node, _bg, _bf, _bb, G_leaf, H_leaf, parent, feat, bin_, gains,
-     cat_sets, _depth) = lax.fori_loop(0, L - 1, step, state0)
+    if use_ll:
+        # the root split put EVERY row on side 0, so r_h2[0] is the full
+        # root histogram: seeding slot 0 with it (slot 1 dead at -1) makes
+        # step 0's split of leaf 0 a carry hit with parent = root
+        state0 = state0 + (jnp.stack([r_h2[0], jnp.zeros_like(r_h2[0])]),
+                           jnp.asarray([0, -1], jnp.int32))
+        (node, _bg, _bf, _bb, G_leaf, H_leaf, parent, feat, bin_, gains,
+         cat_sets, _depth, _ch, _ci) = lax.fori_loop(0, L - 1, step, state0)
+    else:
+        (node, _bg, _bf, _bb, G_leaf, H_leaf, parent, feat, bin_, gains,
+         cat_sets, _depth) = lax.fori_loop(0, L - 1, step, state0)
 
     leaf_value = -_thresh_l1(G_leaf, l1) / (H_leaf + l2)
     leaf_value = jnp.where(H_leaf > 0, leaf_value, 0.0)

@@ -412,6 +412,39 @@ def sparse_histogram_split(sb: SparseBinned, ghc, side):
     return h2, totals
 
 
+def sparse_histogram_side(sb: SparseBinned, ghc, mask):
+    """(d, B, 3) histogram of ONE row subset — the leaf-local half-pass.
+
+    ``mask`` (n,) bool/0-1: rows of the SMALLER child of a split. Same
+    scatter-free cumsum as :func:`sparse_histogram_split` but over a
+    3-channel panel instead of 6 — half the gather + prefix work per
+    step. Channel-wise the cumsum, mean-centering and zero-bin residual
+    are computed independently, so this histogram is BITWISE equal to the
+    matching side of the full split pass; only the sibling the caller
+    derives by parent subtraction picks up a different fp rounding.
+    Returns ``(h, tot)`` with ``tot`` (3,) the masked panel sums.
+    """
+    import jax.numpy as jnp
+
+    d, B = sb.d, sb.n_bins
+    ghc3 = ghc.astype(jnp.float32) * mask.astype(jnp.float32)[:, None]
+    ghc3p = jnp.concatenate([ghc3, jnp.zeros((1, 3), jnp.float32)], axis=0)
+    panel = jnp.take(ghc3p, sb.rows, axis=0)                 # (nnz_pad, 3)
+
+    cell_sums = _cell_sum_fn(panel)
+    cell_starts = jnp.concatenate(
+        [jnp.zeros((1,), sb.ends.dtype), sb.ends[:-1]])
+    h = cell_sums(sb.ends, cell_starts).reshape(d, B, 3)
+
+    tot = ghc3.sum(axis=0)                                   # (3,)
+    per_feat = h.sum(axis=1)                                 # (d, 3)
+    zero_onehot = (jnp.arange(B)[None, :] ==
+                   sb.zero_bin[:, None]).astype(jnp.float32)  # (d, B)
+    h = h + zero_onehot[:, :, None] * (tot[None, None, :]
+                                       - per_feat[:, None, :])
+    return h, tot
+
+
 def sparse_histogram(sb: SparseBinned, ghc):
     """(d, B, 3) histogram of an (n, 3) [grad, hess, weight] panel (all rows
     on one side — the root histogram / test entry point)."""

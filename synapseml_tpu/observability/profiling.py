@@ -526,10 +526,16 @@ class ProfiledJit:
     """
 
     def __init__(self, fn, name: Optional[str] = None,
-                 static_argnames: Sequence[str] = ()):
+                 static_argnames: Sequence[str] = (),
+                 closure_key: Optional[str] = None):
         self._fn = fn
         self.name = name or getattr(fn, "__name__", "fn")
         self._static_argnames = tuple(static_argnames)
+        # fingerprint of closure state the input signature cannot see —
+        # e.g. the ONNX executor's weight placement plan (a (2,2,2)
+        # fsdp-stored executable must never be served from a replicated
+        # instance's persisted entry of the same fn name and input avals)
+        self._closure_key = closure_key or ""
         self._lock = threading.Lock()
         self._cache: Dict[Any, _CompiledEntry] = {}
         # digest -> entry deserialized from the persisted AOT cache
@@ -684,7 +690,7 @@ class ProfiledJit:
             _AOT_MAGIC, self.name, str(treedef),
             "|".join(repr(a) for a in avals),
             "|".join(repr(p) for p in placements),
-            repr(static),
+            repr(static), self._closure_key,
         ] + self._runtime_key()
         return hashlib.sha256("\x1f".join(parts).encode()).hexdigest()[:32]
 
@@ -847,15 +853,22 @@ class ProfiledJit:
 
 
 def profiled_jit(fn=None, *, name: Optional[str] = None,
-                 static_argnames: Sequence[str] = ()):
+                 static_argnames: Sequence[str] = (),
+                 closure_key: Optional[str] = None):
     """Wrap ``fn`` in a :class:`ProfiledJit` (decorator or call form).
+
+    ``closure_key`` joins the persisted-AOT digest: pass a fingerprint of
+    any closure state (weight placement plans, dtype policy) that two
+    same-named wrappers could disagree on.
 
     >>> step = profiled_jit(_step_impl, name="gbdt.step")
     """
     if fn is None:
         return lambda f: ProfiledJit(f, name=name,
-                                     static_argnames=static_argnames)
-    return ProfiledJit(fn, name=name, static_argnames=static_argnames)
+                                     static_argnames=static_argnames,
+                                     closure_key=closure_key)
+    return ProfiledJit(fn, name=name, static_argnames=static_argnames,
+                       closure_key=closure_key)
 
 
 # ---------------------------------------------------------------------------

@@ -142,7 +142,8 @@ class OnnxFunction:
         self._const_plan: List[Dict[str, Any]] = []
         self._const_specs: Dict[str, Any] = (
             self._plan_const_specs() if layout is not None
-            and getattr(layout, "model_size", 1) > 1 else {})
+            and (getattr(layout, "model_size", 1) > 1
+                 or getattr(layout, "fsdp_size", 1) > 1) else {})
         for name, spec in self._const_specs.items():
             const = self.constants[name]
             if self.dtype_policy == "bfloat16":
@@ -158,8 +159,18 @@ class OnnxFunction:
         from ..observability.profiling import profiled_jit
 
         graph_name = getattr(self.graph, "name", "") or "graph"
+        # the persisted-AOT digest must see the weight placement: the
+        # same graph under a replicated, (1,2)-tp or (2,2,2)-fsdp layout
+        # compiles three different executables behind identical input
+        # avals, and loading the wrong one raises (at best)
+        closure_key = f"dtype={self.dtype_policy}"
+        if self._const_specs:
+            closure_key += ";layout=" + str(layout.describe()) + ";" + \
+                ",".join(f"{n}:{self._const_specs[n]}"
+                         for n in sorted(self._const_specs))
         self._jit = profiled_jit(self._run_positional,
-                                 name=f"onnx.{graph_name}")
+                                 name=f"onnx.{graph_name}",
+                                 closure_key=closure_key)
 
     # -- public ------------------------------------------------------------------
 
@@ -194,7 +205,19 @@ class OnnxFunction:
         Anything else (biases, norm params, shape operands, multi-role
         weights) replicates — GSPMD still partitions the surrounding
         compute. Shape arithmetic never involves these tensors, so
-        constant folding is unaffected."""
+        constant folding is unaffected.
+
+        Under a 3-D layout (``fsdp_size > 1``) the planner knows a THIRD
+        decision besides shard-over-model/replicate: store-over-fsdp +
+        gather-at-consumer. Weights are *stored* row-sharded over the
+        fsdp axis (stacked on top of any model sharding) and all-gathered
+        transiently at the point of use (``gather_for_use`` re-pin inside
+        the jit). This finally gives multi-role weights a correct answer:
+        a tied tensor consumed as both a MatMul RHS and a transposed Gemm
+        RHS cannot pick one resident sharded form, but it CAN store
+        row-sharded and hand each consumer its own transient gathered
+        copy — at-rest HBM drops by 1/fsdp instead of paying full
+        replication."""
         roles: Dict[str, set] = {}
 
         def scan(graph):
@@ -227,6 +250,7 @@ class OnnxFunction:
             scan(f)
         layout = self.layout
         m = layout.model_size
+        f = getattr(layout, "fsdp_size", 1)
         specs: Dict[str, Any] = {}
 
         def record(name: str, decision: str, reason: str) -> None:
@@ -239,30 +263,82 @@ class OnnxFunction:
                 "nbytes": int(const.nbytes),
                 "decision": decision, "reason": reason})
 
+        def fsdp_store_dim(const, avoid: Optional[int]) -> Optional[int]:
+            # first dim (skipping any model-sharded one) whose size splits
+            # over the fsdp axis — the row dim the weight is STORED over
+            if f <= 1:
+                return None
+            for sd in range(const.ndim):
+                if sd != avoid and const.shape[sd] % f == 0:
+                    return sd
+            return None
+
         for name, rs in roles.items():
+            const = self.constants[name]
+            is_float = np.issubdtype(const.dtype, np.floating)
             if len(rs) != 1 or None in rs:
                 kinds = sorted(str(r) for r in rs)
-                record(name, "replicated",
-                       f"consumer-role conflict ({', '.join(kinds)}) — no "
-                       f"single shardable role; tied/multi-use weight")
+                conflict = (f"consumer-role conflict ({', '.join(kinds)}) — "
+                            f"no single shardable role; tied/multi-use "
+                            f"weight")
+                # store-over-fsdp only pays for real WEIGHTS (some consumer
+                # wanted it sharded); pure-elementwise operands (biases,
+                # norm params: roles == {None}) stay replicated as before
+                sd = (fsdp_store_dim(const, None)
+                      if is_float and rs != {None} else None)
+                if sd is None:
+                    record(name, "replicated", conflict)
+                    continue
+                # THE fsdp decision: no resident sharded form satisfies
+                # every consumer, but row-sharded STORAGE + a transient
+                # gathered copy per consumer satisfies all of them
+                specs[name] = layout.fsdp_weight(rank=const.ndim, dim=sd)
+                record(name, "fsdp",
+                       f"stored over fsdp={f} on dim {sd}, all-gathered at "
+                       f"each consumer — resolves {conflict}")
                 continue
             kind, dim = next(iter(rs))
-            const = self.constants[name]
-            if not np.issubdtype(const.dtype, np.floating):
+            if not is_float:
                 record(name, "replicated",
                        f"non-float dtype {const.dtype} (shape operand / "
                        f"index table)")
                 continue
-            if const.shape[dim] % m:
+            if m > 1 and const.shape[dim] % m == 0:
+                use = (layout.conv_weight(rank=const.ndim)
+                       if kind == "conv"
+                       else layout.col_weight(rank=const.ndim, dim=dim))
+                sd = fsdp_store_dim(const, avoid=dim)
+                if sd is None:
+                    specs[name] = use
+                    record(name, "sharded",
+                           f"{kind} weight: dim {dim} over model={m}")
+                else:
+                    # SNIPPETS [3] embeddings layout: use-sharded over
+                    # model AND stored row-sharded over fsdp — at rest
+                    # each device holds 1/(f*m) of the tensor
+                    specs[name] = layout.fsdp_weight(
+                        rank=const.ndim, dim=sd, use_spec=use)
+                    record(name, "fsdp",
+                           f"{kind} weight: dim {dim} over model={m}, "
+                           f"stored over fsdp={f} on dim {sd}; fsdp axis "
+                           f"all-gathered on use")
+                continue
+            if m > 1:
                 record(name, "replicated",
                        f"{kind} dim {dim} size {const.shape[dim]} not "
                        f"divisible by model={m}")
                 continue
-            specs[name] = (layout.conv_weight(rank=const.ndim)
-                           if kind == "conv"
-                           else layout.col_weight(rank=const.ndim, dim=dim))
-            record(name, "sharded",
-                   f"{kind} weight: dim {dim} over model={m}")
+            # model axis unpopulated (fsdp-only layout): storage sharding
+            # is still worth it for weight-role tensors
+            sd = fsdp_store_dim(const, None)
+            if sd is None:
+                record(name, "replicated",
+                       f"{kind} weight: no dim divisible by fsdp={f}")
+                continue
+            specs[name] = layout.fsdp_weight(rank=const.ndim, dim=sd)
+            record(name, "fsdp",
+                   f"{kind} weight: stored over fsdp={f} on dim {sd}, "
+                   f"all-gathered on use")
         for name in self.constants:
             if name not in roles:
                 record(name, "replicated",
@@ -274,10 +350,13 @@ class OnnxFunction:
         """Per-initializer residency decisions under the tensor-parallel
         layout, largest tensor first — each row names the tensor, its
         host-side footprint, and WHY the planner sharded or replicated it.
-        Empty without a populated model axis (nothing to shard across).
-        The SPMD lint pack (``analysis/rules_spmd.py`` SMT110) turns every
-        large replicated row into a finding, so the planner's silent
-        "replicate on conflict" choices surface before they cost HBM."""
+        Empty without a populated model or fsdp axis (nothing to shard
+        across). The SPMD lint pack (``analysis/rules_spmd.py`` SMT110)
+        turns every large replicated row into a finding, so the planner's
+        silent "replicate on conflict" choices surface before they cost
+        HBM; ``fsdp`` rows document the store-over-fsdp +
+        gather-at-consumer placements (reason strings carry the stored
+        dim and axis sizes)."""
         return sorted((dict(r) for r in self._const_plan),
                       key=lambda r: (-r["nbytes"], r["tensor"]))
 
@@ -319,8 +398,16 @@ class OnnxFunction:
                 # re-pin the tensor-parallel placement inside the traced
                 # program so GSPMD partitions the consuming matmul however
                 # jit chose to stage the closure constant
-                v = self.layout.constraint(jnp.asarray(v),
-                                           self._const_specs[name])
+                spec = self._const_specs[name]
+                v = self.layout.constraint(jnp.asarray(v), spec)
+                use = self.layout.use_spec(spec) \
+                    if hasattr(self.layout, "use_spec") else spec
+                if use != spec:
+                    # stored-over-fsdp weight: all-gather-on-use. The
+                    # re-pin to the use spec makes GSPMD insert the
+                    # all-gather here, so the gathered copy is a transient
+                    # of this step — at rest only the row shards persist.
+                    v = self.layout.gather_for_use(v, spec)
             env[name] = v
         for name, arr in zip(self.input_names, arrays):
             env[name] = self._cast_policy_in(arr)

@@ -258,11 +258,14 @@ def _matmul(inputs, attrs, ctx):
 @op("Gemm")
 def _gemm(inputs, attrs, ctx):
     a, b = inputs[0], inputs[1]
-    if attrs.get("transA", 0):
-        a = a.T
-    if attrs.get("transB", 0):
-        b = b.T
-    out = attrs.get("alpha", 1.0) * jnp.matmul(a, b, preferred_element_type=ctx.get("accum_dtype"))
+    # transA/transB fold into the contraction dims: no transposed copy is
+    # ever materialized, so a device-resident (sharded/fsdp-stored) B
+    # traces identically to a host-constant B
+    ca = 0 if attrs.get("transA", 0) else 1
+    cb = 1 if attrs.get("transB", 0) else 0
+    out = attrs.get("alpha", 1.0) * lax.dot_general(
+        a, b, (((ca,), (cb,)), ((), ())),
+        preferred_element_type=ctx.get("accum_dtype"))
     if len(inputs) > 2 and inputs[2] is not None:
         out = out + attrs.get("beta", 1.0) * inputs[2]
     return out.astype(a.dtype) if out.dtype != a.dtype else out
@@ -812,6 +815,35 @@ def _conv_integer(inputs, attrs, ctx):
         dimension_numbers=dn, feature_group_count=groups,
         preferred_element_type=jnp.int32,
     )
+
+
+@op("QLinearConv")
+def _qlinear_conv(inputs, attrs, ctx):
+    # full requantizing Conv: ConvInteger's zero-centred int32
+    # accumulation, an optional int32 bias (per spec already quantized
+    # with scale x_scale*w_scale, zero_point 0 — added into the
+    # accumulator), then rescale by x_scale*w_scale/y_scale, round half
+    # to even, re-centre on y_zero_point and saturate to its dtype.
+    # w_scale/w_zero_point may be per-output-channel (OIHW axis 0).
+    x, x_scale, x_zp, w, w_scale, w_zp, y_scale, y_zp = inputs[:8]
+    bias = inputs[8] if len(inputs) > 8 and inputs[8] is not None else None
+    acc = _conv_integer([x, w, x_zp, w_zp], attrs, ctx)
+    nd = acc.ndim
+
+    def _chan(s):  # per-channel params lie along the output-channel axis
+        s = jnp.asarray(s).astype(jnp.float32)
+        return s.reshape((1, -1) + (1,) * (nd - 2)) if s.ndim == 1 else s
+
+    if bias is not None:
+        acc = acc + jnp.asarray(bias).astype(jnp.int32).reshape(
+            (1, -1) + (1,) * (nd - 2))
+    scale = jnp.asarray(x_scale).astype(jnp.float32) * _chan(w_scale) \
+        / jnp.asarray(y_scale).astype(jnp.float32)
+    qdtype = (np.asarray(y_zp).dtype if isinstance(y_zp, np.ndarray)
+              else np.dtype(y_zp.dtype))
+    y = jnp.round(acc.astype(jnp.float32) * scale) + _chan(y_zp)
+    info = np.iinfo(qdtype)
+    return jnp.clip(y, info.min, info.max).astype(qdtype)
 
 
 @op("QLinearMatMul")

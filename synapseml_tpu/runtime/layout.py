@@ -7,10 +7,10 @@ parallelism: a model bigger than one chip's HBM cannot serve at all, and
 GBDT histograms cannot split work over features. This module is the one
 place mesh construction and tensor placement live:
 
-- **Named 2-D meshes** ``(data, model)`` built on
-  :func:`~synapseml_tpu.runtime.topology.make_mesh`, degrading gracefully
-  to ``(1, 1)`` on a single chip and to 1-D when only one axis is
-  populated (``model_axis=None``). The same code runs from 1 chip to a
+- **Named 2-D/3-D meshes** ``(data, model)`` / ``(data, fsdp, model)``
+  built on :func:`~synapseml_tpu.runtime.topology.make_mesh`, degrading
+  gracefully to ``(1, 1)`` on a single chip and to 1-D when only one axis
+  is populated (``model_axis=None``). The same code runs from 1 chip to a
   pod — axis sizes change, programs don't.
 - **Canonical PartitionSpecs per tensor role**: :meth:`SpecLayout.batch`
   (rows over ``data``), :meth:`SpecLayout.replicated` (params),
@@ -19,6 +19,15 @@ place mesh construction and tensor placement live:
   (output channels over ``model``), :meth:`SpecLayout.feature_blocks`
   (GBDT histogram feature blocks: rows over ``data`` x features over
   ``model``).
+- **Beyond-HBM storage specs** (ROADMAP item 4, SNIPPETS [3] pattern): an
+  optional third ``fsdp`` mesh axis over which parameters are *stored*
+  row-sharded (:meth:`SpecLayout.fsdp_weight`,
+  :meth:`SpecLayout.embed_weight`) and all-gathered only at the point of
+  use (:meth:`SpecLayout.gather_for_use` — a ``with_sharding_constraint``
+  re-pin inside jit, so GSPMD inserts the collective and the gathered
+  copy is a transient of the step, never resident). Per-device at-rest
+  HBM for an fsdp-stored tensor is ``nbytes / (fsdp * model)`` of the
+  replicated cost, bought with one all-gather per use.
 - **Placement helpers**: :meth:`SpecLayout.sharding` /
   :meth:`SpecLayout.put` / :meth:`SpecLayout.constraint`, plus a thin
   :meth:`SpecLayout.shard_map` that wraps
@@ -52,14 +61,20 @@ class SpecLayout:
     mesh: Any                               # jax.sharding.Mesh
     data_axis: str = "data"
     model_axis: Optional[str] = "model"
+    # optional third axis for row-sharded parameter STORAGE (weights live
+    # sharded over it, all-gathered at point of use). None -> the 2-D
+    # layout every pre-fsdp caller built; nothing changes for them.
+    fsdp_axis: Optional[str] = None
 
     # -- constructors -----------------------------------------------------------
 
     @classmethod
     def build(cls, data: Optional[int] = None, model: Optional[int] = None,
-              *, devices: Optional[Sequence] = None,
+              *, fsdp: Optional[int] = None,
+              devices: Optional[Sequence] = None,
               data_axis: str = "data",
-              model_axis: Optional[str] = "model") -> "SpecLayout":
+              model_axis: Optional[str] = "model",
+              fsdp_axis: str = "fsdp") -> "SpecLayout":
         """Build a layout over the available devices.
 
         ``model=m`` populates the model axis with ``m`` devices and the
@@ -69,6 +84,10 @@ class SpecLayout:
         every variant degrades to a ``(1, 1)`` mesh — specs still resolve,
         collectives become no-ops. ``model_axis=None`` builds a 1-D mesh
         over ``data_axis`` only (e.g. the sequence-parallel ``seq`` axis).
+
+        ``fsdp=f`` inserts a third axis between ``data`` and ``model``
+        (mesh ``(data, fsdp, model)``) over which parameters are *stored*
+        row-sharded; omitting it keeps the 2-D mesh bit-for-bit.
         """
         from .topology import make_mesh
 
@@ -78,32 +97,49 @@ class SpecLayout:
             devices = jax.devices()
         n = len(devices)
         if model_axis is None:
+            if fsdp:
+                raise ValueError("fsdp axis requires a model_axis mesh "
+                                 "(1-D layouts have nowhere to insert it)")
             shape: Tuple[int, ...] = (int(data) if data else n,)
             mesh = make_mesh((data_axis,), shape=shape, devices=devices)
             return cls(mesh=mesh, data_axis=data_axis, model_axis=None)
+        f2 = int(fsdp) if fsdp else 1
+        if f2 < 1:
+            raise ValueError(f"fsdp axis size must be >= 1, got {f2}")
         if model is None and data is None:
-            d2, m2 = n, 1
+            if n % f2:
+                raise ValueError(
+                    f"fsdp axis size {f2} must divide the {n} available "
+                    f"devices (pass data= explicitly for a partial mesh)")
+            d2, m2 = n // f2, 1
         elif model is None:
             d2, m2 = int(data), 1
         elif data is None:
             m2 = int(model)
-            if m2 < 1 or n % m2:
+            if m2 < 1 or n % (m2 * f2):
                 raise ValueError(
-                    f"model axis size {m2} must divide the {n} available "
-                    f"devices (pass data= explicitly for a partial mesh)")
-            d2 = n // m2
+                    f"model x fsdp axis sizes {m2} x {f2} must divide the "
+                    f"{n} available devices (pass data= explicitly for a "
+                    f"partial mesh)")
+            d2 = n // (m2 * f2)
         else:
             d2, m2 = int(data), int(model)
+        if fsdp:
+            mesh = make_mesh((data_axis, fsdp_axis, model_axis),
+                             shape=(d2, f2, m2), devices=devices)
+            return cls(mesh=mesh, data_axis=data_axis,
+                       model_axis=model_axis, fsdp_axis=fsdp_axis)
         mesh = make_mesh((data_axis, model_axis), shape=(d2, m2),
                          devices=devices)
         return cls(mesh=mesh, data_axis=data_axis, model_axis=model_axis)
 
     @classmethod
     def from_mesh(cls, mesh, data_axis: Optional[str] = None,
-                  model_axis=_UNSET) -> "SpecLayout":
+                  model_axis=_UNSET, fsdp_axis=_UNSET) -> "SpecLayout":
         """Wrap an existing mesh. ``data_axis`` defaults to ``'data'`` when
         the mesh has it, else the mesh's first axis; ``model_axis`` to
-        ``'model'`` when present (else None — 1-D degradation)."""
+        ``'model'`` and ``fsdp_axis`` to ``'fsdp'`` when present (else
+        None — 2-D/1-D degradation)."""
         names = tuple(mesh.axis_names)
         if data_axis is None:
             data_axis = "data" if "data" in names else names[0]
@@ -114,7 +150,14 @@ class SpecLayout:
                                      and data_axis != "model") else None
         if model_axis is not None and model_axis not in names:
             raise ValueError(f"mesh axes {names} have no {model_axis!r} axis")
-        return cls(mesh=mesh, data_axis=data_axis, model_axis=model_axis)
+        if fsdp_axis is _UNSET:
+            fsdp_axis = "fsdp" if ("fsdp" in names
+                                   and data_axis != "fsdp"
+                                   and model_axis != "fsdp") else None
+        if fsdp_axis is not None and fsdp_axis not in names:
+            raise ValueError(f"mesh axes {names} have no {fsdp_axis!r} axis")
+        return cls(mesh=mesh, data_axis=data_axis, model_axis=model_axis,
+                   fsdp_axis=fsdp_axis)
 
     # -- sizes ------------------------------------------------------------------
 
@@ -129,8 +172,14 @@ class SpecLayout:
         return int(self.mesh.shape[self.model_axis])
 
     @property
+    def fsdp_size(self) -> int:
+        if self.fsdp_axis is None:
+            return 1
+        return int(self.mesh.shape[self.fsdp_axis])
+
+    @property
     def n_devices(self) -> int:
-        return self.data_size * self.model_size
+        return self.data_size * self.fsdp_size * self.model_size
 
     @property
     def axis_names(self) -> Tuple[str, ...]:
@@ -143,6 +192,8 @@ class SpecLayout:
     def describe(self) -> dict:
         """JSON-able mesh summary (stamped into MULTICHIP artifacts)."""
         out = {self.data_axis: self.data_size}
+        if self.fsdp_axis is not None:
+            out[self.fsdp_axis] = self.fsdp_size
         if self.model_axis is not None:
             out[self.model_axis] = self.model_size
         return out
@@ -189,6 +240,87 @@ class SpecLayout:
             return P(self.data_axis)
         return P(self.data_axis, self.model_axis)
 
+    # -- fsdp storage specs (row-sharded at rest, all-gathered on use) ----------
+
+    def fsdp_weight(self, rank: int = 1, dim: int = 0, use_spec=None):
+        """STORAGE spec of a parameter row-sharded over ``fsdp`` at ``dim``.
+
+        ``use_spec`` is the tensor's point-of-use spec (default replicated);
+        the storage spec stacks the fsdp axis on top of it (a dim already
+        sharded over ``model`` stores over ``(fsdp, model)``). Degrades to
+        ``use_spec`` itself when the layout has no fsdp axis, so adopting
+        call sites stay correct on 2-D and 1-D meshes.
+        """
+        from jax.sharding import PartitionSpec as P
+
+        base: list = list(use_spec) if use_spec is not None else []
+        base += [None] * (rank - len(base))
+        if self.fsdp_axis is not None:
+            cur = base[dim]
+            if cur is None:
+                base[dim] = self.fsdp_axis
+            elif isinstance(cur, tuple):
+                base[dim] = (self.fsdp_axis,) + cur
+            else:
+                base[dim] = (self.fsdp_axis, cur)
+        return P(*base)
+
+    def embed_weight(self, rank: int = 2):
+        """Embedding-table STORAGE: rows (vocab dim 0) sharded over
+        ``fsdp x model`` jointly (the SNIPPETS [3] ``embeddings`` layout) —
+        at rest each device holds ``1 / (fsdp * model)`` of the table."""
+        from jax.sharding import PartitionSpec as P
+
+        row = tuple(a for a in (self.fsdp_axis, self.model_axis)
+                    if a is not None)
+        axes: list = [None] * rank
+        if row:
+            axes[0] = row if len(row) > 1 else row[0]
+        return P(*axes)
+
+    def use_spec(self, stored_spec):
+        """Point-of-use spec of a stored-over-fsdp tensor: the storage spec
+        with the fsdp axis stripped (what the consumer math wants resident —
+        replicated, or still ``model``-sharded for a tensor-parallel dim)."""
+        from jax.sharding import PartitionSpec as P
+
+        if self.fsdp_axis is None:
+            return stored_spec
+
+        def strip(entry):
+            if entry == self.fsdp_axis:
+                return None
+            if isinstance(entry, tuple):
+                kept = tuple(a for a in entry if a != self.fsdp_axis)
+                return kept if len(kept) > 1 else (kept[0] if kept else None)
+            return entry
+
+        return P(*[strip(e) for e in stored_spec])
+
+    def gather_for_use(self, x, stored_spec):
+        """All-gather-on-use re-pin INSIDE a traced program.
+
+        ``with_sharding_constraint`` to :meth:`use_spec` makes GSPMD insert
+        the all-gather over ``fsdp`` right where the value is consumed; the
+        gathered copy is a transient of the jitted step (freed with the
+        step's temporaries), while the bound argument stays row-sharded at
+        rest. No-op (identity constraint) on layouts without an fsdp axis.
+        """
+        return self.constraint(x, self.use_spec(stored_spec))
+
+    def donated_gather(self, stored_spec):
+        """Explicit eager gather for hot loops that dispatch many steps per
+        stored tensor: a jitted identity with ``out_shardings`` pinned to
+        :meth:`use_spec`. The caller runs it per batch of uses and lets the
+        returned (gathered) buffer die — or donates it into the consumer's
+        jit — so the full copy is alive only across those dispatches. The
+        stored argument is deliberately NOT donated: storage persists.
+        """
+        import jax
+
+        out = self.sharding(self.use_spec(stored_spec))
+        return jax.jit(lambda t: t, out_shardings=out)
+
     # -- placement --------------------------------------------------------------
 
     def sharding(self, spec):
@@ -221,11 +353,17 @@ class SpecLayout:
 
     def state_dict(self) -> dict:
         """Axis names + sizes only — a Mesh is bound to live devices and
-        cannot travel; the loading process rebuilds it over ITS devices."""
-        return {"data_axis": self.data_axis,
-                "model_axis": self.model_axis or "",
-                "data": self.data_size,
-                "model": self.model_size}
+        cannot travel; the loading process rebuilds it over ITS devices.
+        The fsdp keys are only written for 3-D layouts, so artifacts saved
+        by 2-D trainers stay byte-identical to the pre-fsdp format."""
+        out = {"data_axis": self.data_axis,
+               "model_axis": self.model_axis or "",
+               "data": self.data_size,
+               "model": self.model_size}
+        if self.fsdp_axis is not None:
+            out["fsdp_axis"] = self.fsdp_axis
+            out["fsdp"] = self.fsdp_size
+        return out
 
     @staticmethod
     def from_state_dict(d: dict) -> "SpecLayout":
@@ -233,25 +371,39 @@ class SpecLayout:
         results (placement only — parity-tested), so when this process has
         fewer devices than the saved shape the layout degrades to what fits
         (ultimately ``(1, 1)``) instead of failing the load — a 1-chip
-        serving worker can load a pipeline saved on an 8-chip trainer."""
+        serving worker can load a pipeline saved on an 8-chip
+        ``(2, 2, 2)`` trainer. Degradation collapses ``fsdp`` first (it
+        only changes at-rest storage), then ``model``, then ``data``."""
         import jax
 
         data_axis = str(d["data_axis"])
         model_axis = str(d.get("model_axis") or "") or None
+        fsdp_axis = str(d.get("fsdp_axis") or "") or None
         want_data, want_model = int(d["data"]), int(d.get("model", 1))
+        want_fsdp = int(d.get("fsdp", 1)) if fsdp_axis else 1
         n = len(jax.devices())
         if model_axis is None:
             return SpecLayout.build(data=min(want_data, n),
                                     data_axis=data_axis, model_axis=None)
-        if want_data * want_model > n:
+        if want_data * want_fsdp * want_model > n:
             import logging
 
+            saved = f"{data_axis}={want_data}"
+            if fsdp_axis:
+                saved += f", {fsdp_axis}={want_fsdp}"
+            saved += f", {model_axis}={want_model}"
             logging.getLogger("synapseml_tpu.layout").warning(
-                "saved layout (%s=%d, %s=%d) needs %d devices, have %d; "
-                "degrading", data_axis, want_data, model_axis, want_model,
-                want_data * want_model, n)
+                "saved layout (%s) needs %d devices, have %d; degrading",
+                saved, want_data * want_fsdp * want_model, n)
             want_model = max(1, min(want_model, n))
-            want_data = max(1, min(want_data, n // want_model))
+            want_fsdp = max(1, min(want_fsdp, n // want_model))
+            want_data = max(1, min(want_data,
+                                   n // (want_model * want_fsdp)))
+        if fsdp_axis and want_fsdp > 1:
+            return SpecLayout.build(data=want_data, model=want_model,
+                                    fsdp=want_fsdp, data_axis=data_axis,
+                                    model_axis=model_axis,
+                                    fsdp_axis=fsdp_axis)
         return SpecLayout.build(data=want_data, model=want_model,
                                 data_axis=data_axis, model_axis=model_axis)
 
@@ -268,11 +420,13 @@ def representative_layouts(devices=None) -> dict:
     spmd_diff.py`` need REPRESENTATIVE layouts, not whatever this host
     happens to have: ``(1,1)`` (the degenerate single-chip mesh every
     program must tolerate), ``(1,2)-tp`` (tensor-parallel serving — the
-    model axis populated, SMT110's replication hazard live), and
-    ``(4,2)-fp`` (the 2-D feature-parallel GBDT shape). Each degrades
-    gracefully to the devices actually present (a 1-chip host still
-    traces everything, with axis sizes collapsed to 1) so the pack runs
-    identically on a laptop and an 8-chip pod slice.
+    model axis populated, SMT110's replication hazard live), ``(4,2)-fp``
+    (the 2-D feature-parallel GBDT shape), and ``(1,2,2)`` (the 3-D
+    fsdp storage mesh — store-over-fsdp plans and their
+    all-gather-on-use re-pins get re-traced). Each degrades gracefully to
+    the devices actually present (a 1-chip host still traces everything,
+    with axis sizes collapsed to 1) so the pack runs identically on a
+    laptop and an 8-chip pod slice.
     """
     if devices is None:
         import jax
@@ -282,11 +436,15 @@ def representative_layouts(devices=None) -> dict:
     n = len(devices)
     m = 2 if n >= 2 else 1
     d = 4 if n >= 4 * m else max(1, n // m)
+    f = 2 if n >= 2 * m else 1
+    fsdp_kw = {"fsdp": f} if f > 1 else {}
     return {
         "(1,1)": SpecLayout.build(data=1, model=1, devices=devices[:1]),
         "(1,2)-tp": SpecLayout.build(data=1, model=m, devices=devices[:m]),
         "(4,2)-fp": SpecLayout.build(data=d, model=m,
                                      devices=devices[:d * m]),
+        "(1,2,2)": SpecLayout.build(data=1, model=m, devices=devices[:f * m],
+                                    **fsdp_kw),
     }
 
 

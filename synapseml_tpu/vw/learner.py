@@ -11,6 +11,13 @@ scatter-adds into the dense 2^b weight/accumulator vectors. Multi-pass training
 re-scans the data; under a mesh each shard trains on its rows and weights are
 ``pmean``-averaged at every pass boundary (VW AllReduce semantics). Losses:
 squared | logistic | hinge | quantile.
+
+Under a 3-D layout with an ``fsdp`` axis the dense 2^b vectors (``w``,
+``g2``, ``scale`` — at ``num_bits=28`` each is 1 GiB) are *stored*
+row-sharded over fsdp between passes and all-gathered transiently at the
+pass step (``SpecLayout.gather_for_use``), so at rest each device holds
+``1/fsdp`` of the learner state. Placement-only: results are bit-identical
+to the replicated path.
 """
 
 from __future__ import annotations
@@ -199,8 +206,17 @@ def train_linear(
         )
         args = (layout.put(bi, ds), layout.put(bv, ds),
                 layout.put(by, ds), layout.put(bw, ds))
+        # beyond-HBM storage (ROADMAP item 4): on a 3-D layout the dense
+        # 2^b vectors live row-sharded over fsdp BETWEEN passes and are
+        # all-gathered only for the pass step, so the full copies are
+        # transients of the compiled program, never resident at rest
+        fsdp_store = (layout.fsdp_weight(rank=1, dim=0)
+                      if getattr(layout, "fsdp_size", 1) > 1 else None)
+        store_layout = layout
     else:
         axis_name = None
+        fsdp_store = None
+        store_layout = None
         nb = -(-n // batch_size)
         pad_rows = nb * batch_size - n
 
@@ -226,7 +242,22 @@ def train_linear(
         # under the virtual-device test mesh; a single program forms the
         # rendezvous once.
         def body(st, _):
-            return step_fn(st, bi, bv, by, bw), None
+            if fsdp_store is not None:
+                # all-gather-on-use: the pass consumes a transient full
+                # copy; placement only, bits unchanged
+                st = st._replace(
+                    w=store_layout.gather_for_use(st.w, fsdp_store),
+                    g2=store_layout.gather_for_use(st.g2, fsdp_store),
+                    scale=store_layout.gather_for_use(st.scale, fsdp_store))
+            st = step_fn(st, bi, bv, by, bw)
+            if fsdp_store is not None:
+                # re-pin the carried state to row-sharded storage (a
+                # replicated->sharded re-pin is a local slice, no comm)
+                st = st._replace(
+                    w=store_layout.constraint(st.w, fsdp_store),
+                    g2=store_layout.constraint(st.g2, fsdp_store),
+                    scale=store_layout.constraint(st.scale, fsdp_store))
+            return st, None
         return jax.lax.scan(body, state, None, length=passes)[0]
 
     state = LinearLearnerState(*(np.asarray(s) for s in state0))

@@ -107,3 +107,96 @@ class JitBurnReply(Transformer):
             replies[i] = HTTPResponseData(
                 200, "OK", entity=f"{pid}:{body}".encode())
         return table.with_column("reply", replies)
+
+
+# ---------------------------------------------------------------------------
+# beyond-HBM proof stage (ISSUE 19): an ONNX MLP whose replicated weights
+# bust a VIRTUAL per-device HBM budget, served through the normal process
+# fleet with the weights STORED row-sharded over the 3-D layout's fsdp
+# axis and all-gathered transiently at each consumer
+# ---------------------------------------------------------------------------
+
+# the virtual single-device weight budget: the replicated model (~3.0 MB
+# of float32 weights) does NOT fit; fsdp-stored over (fsdp=2, model=2)
+# (~0.76 MB per device at rest) does
+FSDP_DEVICE_BUDGET_BYTES = 2 << 20
+
+_FSDP_D, _FSDP_H = 192, 2048
+_fsdp_executors: dict = {}
+
+
+def _fsdp_onnx_fn(use_fsdp):
+    """Build (once per process) the beyond-HBM MLP executor — replicated
+    control, or weights fsdp-stored over a ``(1, 2, 2)`` SpecLayout."""
+    key = bool(use_fsdp)
+    if key not in _fsdp_executors:
+        import jax
+
+        from synapseml_tpu.onnx import builder
+        from synapseml_tpu.onnx.importer import OnnxFunction
+        from synapseml_tpu.onnx.wire import serialize_model
+        from synapseml_tpu.runtime.layout import SpecLayout
+
+        d, h = _FSDP_D, _FSDP_H
+        rng = np.random.default_rng(11)
+        w1 = (rng.normal(size=(d, h)) / np.sqrt(d)).astype(np.float32)
+        b1 = np.zeros(h, np.float32)
+        w2 = (rng.normal(size=(h, d)) / np.sqrt(h)).astype(np.float32)
+        g = builder.make_graph(
+            [builder.node("MatMul", ["x", "w1"], ["h0"]),
+             builder.node("Add", ["h0", "b1"], ["h1"]),
+             builder.node("Relu", ["h1"], ["h2"]),
+             builder.node("MatMul", ["h2", "w2"], ["y"])],
+            "hbm_proof_mlp",
+            [builder.value_info("x", np.float32, [None, d])],
+            [builder.value_info("y", np.float32, [None, d])],
+            initializers={"w1": w1, "b1": b1, "w2": w2})
+        mb = serialize_model(builder.make_model(g))
+        kw = {}
+        if use_fsdp:
+            kw["layout"] = SpecLayout.build(data=1, model=2, fsdp=2,
+                                            devices=jax.devices()[:4])
+        _fsdp_executors[key] = OnnxFunction(mb, dtype_policy="float32",
+                                            **kw)
+    return _fsdp_executors[key]
+
+
+def _fsdp_resident_bytes(fn, n_layout_dev):
+    """Max per-device at-rest weight bytes: sharded arrays count their
+    local shard, host numpy constants count replicated on every device
+    the executor would serve from."""
+    per_dev: dict = {}
+    for arr in fn.constants.values():
+        shards = getattr(arr, "addressable_shards", None)
+        if shards:
+            for sh in shards:
+                did = sh.device.id
+                per_dev[did] = per_dev.get(did, 0) + int(sh.data.nbytes)
+        else:
+            for did in range(n_layout_dev):
+                per_dev[did] = per_dev.get(did, 0) + int(
+                    getattr(arr, "nbytes", 0))
+    return max(per_dev.values())
+
+
+class FsdpOnnxReply(Transformer):
+    """Serves the beyond-HBM MLP and replies ``{resident}:{checksum}`` —
+    per-device at-rest weight bytes measured INSIDE the worker process
+    that holds them, plus an output checksum so the test can pin
+    replicated-vs-fsdp numeric parity across fleets."""
+
+    use_fsdp = Param("store weights row-sharded over the fsdp axis",
+                     bool, default=False)
+
+    def _transform(self, table: Table) -> Table:
+        fn = _fsdp_onnx_fn(self.use_fsdp)
+        x = np.linspace(-1.0, 1.0, 8 * _FSDP_D,
+                        dtype=np.float32).reshape(8, _FSDP_D)
+        y = np.asarray(fn({"x": x})["y"], np.float32)
+        resident = _fsdp_resident_bytes(fn, 4 if self.use_fsdp else 1)
+        body = f"{resident}:{float(np.abs(y).sum()):.4f}".encode()
+        n = table.num_rows
+        replies = np.empty(n, dtype=object)
+        replies[:] = [HTTPResponseData(200, "OK", entity=body)
+                      for _ in range(n)]
+        return table.with_column("reply", replies)

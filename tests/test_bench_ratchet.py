@@ -348,3 +348,46 @@ def test_cpu_refusal_artifact_shape():
     assert doc["metric"] == "resnet50_onnx_images_per_sec_per_chip"
     assert doc["value"] is None
     assert not any(k in doc["extra"] for k in bench._PRIMARY)
+
+
+# ---------------------------------------------------------------------------
+# Beyond-HBM gate: the onnx_fsdp_hbm lane must actually shrink at-rest
+# per-device weight bytes (hbm_vs_replicated < 1.0) without giving up
+# throughput (rows_per_sec_ratio >= 0.9) — an absolute gate, not a
+# round-over-round ratchet, because the whole point of fsdp storage is a
+# ratio that holds in every round
+# ---------------------------------------------------------------------------
+
+def test_fsdp_hbm_gate_flags_ceiling_and_floor(tmp_path):
+    _write_round(tmp_path, 8, {
+        "onnx_fsdp_hbm": {"hbm_vs_replicated": 1.02,
+                          "rows_per_sec_ratio": 0.85}})
+    offenders = bench.fsdp_hbm_violations(here=str(tmp_path), waivers=set())
+    assert (8, "hbm:onnx_fsdp_hbm", 1.02) in offenders
+    assert (8, "thr:onnx_fsdp_hbm", 0.85) in offenders
+    # folded into the one CI gate
+    gate = bench.unwaived_regressions(here=str(tmp_path), waivers=set())
+    assert (8, "hbm:onnx_fsdp_hbm", 1.02) in gate
+    # reasoned waiver rows clear each key independently
+    assert bench.fsdp_hbm_violations(
+        here=str(tmp_path),
+        waivers={(8, "hbm:onnx_fsdp_hbm")}) == [(8, "thr:onnx_fsdp_hbm", 0.85)]
+    assert bench.fsdp_hbm_violations(
+        here=str(tmp_path),
+        waivers={(8, "hbm:onnx_fsdp_hbm"), (8, "thr:onnx_fsdp_hbm")}) == []
+
+
+def test_fsdp_hbm_gate_passes_healthy_lane(tmp_path):
+    _write_round(tmp_path, 8, {
+        "onnx_fsdp_hbm": {"hbm_vs_replicated": 0.251,
+                          "rows_per_sec_ratio": 0.93}})
+    assert bench.fsdp_hbm_violations(here=str(tmp_path), waivers=set()) == []
+
+
+def test_fsdp_hbm_gate_skips_rounds_without_the_lane(tmp_path):
+    # rounds predating the lane (r04-r06) simply don't stamp it; the gate
+    # must not invent violations for them, nor for error rounds
+    _write_round(tmp_path, 5, {
+        "resnet50_onnx": {"images_per_sec_per_chip": 12000.0, "mfu": 0.47}})
+    _write_round(tmp_path, 8, {"onnx_fsdp_hbm": {"error": "boom"}})
+    assert bench.fsdp_hbm_violations(here=str(tmp_path), waivers=set()) == []

@@ -12,9 +12,30 @@ import pytest
 import benchmark_utils as bu
 
 
+# Tier-1 window: the breast-cancer rows train 100 iterations each (~30s a
+# row on one CPU core).  The gbdt row stays — it anchors the reference-band
+# floor test — but rf/dart/goss quality is already ratcheted per-mode on
+# the synthetic classifier rows, so their REAL-data rows run only in the
+# full (slow-included) suite.
+_SLOW_IDS = {
+    ("benchmarks_gbdt_realdata.csv", "breast_cancer-rf"),
+    ("benchmarks_gbdt_realdata.csv", "breast_cancer-dart"),
+    ("benchmarks_gbdt_realdata.csv", "breast_cancer-goss"),
+    # friedman dart/goss ride the full suite: regressor quality is pinned
+    # bitwise vs sklearn in test_gbdt_crosscheck, friedman-gbdt and all
+    # three peaks rows keep the regressor ratchet in the tier-1 window
+    ("benchmarks_gbdt_regressor.csv", "friedman-dart"),
+    ("benchmarks_gbdt_regressor.csv", "friedman-goss"),
+}
+
+
 def _rows(name):
-    return [pytest.param(r, id=f"{r['dataset']}-{r['variant']}")
-            for r in bu.read_benchmarks(name)]
+    out = []
+    for r in bu.read_benchmarks(name):
+        id_ = f"{r['dataset']}-{r['variant']}"
+        marks = [pytest.mark.slow] if (name, id_) in _SLOW_IDS else []
+        out.append(pytest.param(r, id=id_, marks=marks))
+    return out
 
 
 def _compare(measured: float, row: dict):

@@ -116,6 +116,8 @@ def test_train_binary(data):
     assert _auc(y[2400:], b.predict(x[2400:])) > 0.92
 
 
+@pytest.mark.slow  # 60-iteration/31-leaf compile; l2 accuracy is also
+# pinned vs sklearn in test_gbdt_crosscheck and via the regressor stage
 def test_train_regression(data):
     x, _, yr, _ = data
     b = train({"objective": "regression", "num_iterations": 60, "num_leaves": 31},
@@ -144,7 +146,14 @@ def test_boosting_modes(data, mode):
     assert _auc(y[2400:], b.predict(x[2400:])) > 0.88, mode
 
 
-@pytest.mark.parametrize("objective", ["l1", "huber", "quantile", "poisson", "tweedie"])
+# quantile/poisson stay quality-pinned vs sklearn in test_gbdt_crosscheck,
+# so their ~4s training runs here ride only the full (slow-included) suite
+@pytest.mark.parametrize(
+    "objective",
+    ["l1", "huber",
+     pytest.param("quantile", marks=pytest.mark.slow),
+     pytest.param("poisson", marks=pytest.mark.slow),
+     "tweedie"])
 def test_regression_objectives(data, objective):
     x, _, yr, _ = data
     target = np.exp(yr / 4) if objective in ("poisson", "tweedie") else yr
@@ -169,6 +178,8 @@ def test_custom_fobj(data):
     assert _auc(y[2400:], b.predict(x[2400:])) > 0.9
 
 
+@pytest.mark.slow  # the 200-iteration scan compile dominates; early stopping
+# stays tier-1-covered by the estimator API test and the mesh device-eval pin
 def test_early_stopping(data):
     x, y, _, _ = data
     b = train({"objective": "binary", "num_iterations": 200, "num_leaves": 15,
@@ -807,6 +818,8 @@ def test_categorical_treeshap_additivity():
 # -- voting parallel (round 2) -------------------------------------------------------
 
 
+@pytest.mark.slow  # accuracy-only voting run (4096x24, 10 iters); the exact
+# single-replica voting parity pin and the sparse voting test stay tier-1
 def test_voting_parallel_trains_accurately(eight_device_mesh):
     rng = np.random.default_rng(63)
     n, d = 4096, 24
@@ -933,6 +946,9 @@ def test_gbdt_class_aware_bagging(data):
     assert not np.allclose(b.predict(x), b_plain.predict(x))
 
 
+@pytest.mark.slow  # three 25-iter dart fits; dart stays tier-1-covered by
+# boosting_modes[dart], the sparse dart mesh parity test and the peaks-dart
+# benchmark row — only the uniform_drop/xgboost_dart_mode flags ride along
 def test_gbdt_dart_modes(data):
     x, y, _, _ = data
     common = {"objective": "binary", "boosting": "dart", "num_iterations": 25,
@@ -1106,81 +1122,75 @@ def test_distributed_matches_single_device_nondivisible(eight_device_mesh):
                                rtol=1e-5, atol=1e-6)
 
 
-def _force_host_bin(monkeypatch):
-    """Route the next train() through HOST binning: boost.py gates
-    use_device_bin on cats_f32_representable (function-level import), so
-    knocking it out on the module is the narrowest off-switch."""
-    from synapseml_tpu.gbdt import device_predict
+@pytest.fixture(scope="module")
+def mesh_device_bin_pair(eight_device_mesh):
+    """ONE mesh device-bin train + ONE host-bin single-device train,
+    shared by the three mesh-device parity tests below. The workload
+    folds all three concerns together — f32 raw rows (the x_f32_in arm
+    of the use_device_bin gate), a categorical feature riding the packed
+    table, and an eval set with early stopping under the device-eval
+    scan — so the paired ~5s train compiles run once instead of six
+    times; the per-test assertions are cheap."""
+    rng = np.random.default_rng(77)
+    n = 3000
+    cats = rng.integers(0, 20, size=n).astype(np.float32)
+    num = rng.normal(size=(n, 5)).astype(np.float32)
+    x = np.concatenate([cats[:, None], num], axis=1)
+    noise = 0.1 * rng.normal(size=n)
+    y = ((num[:, 0] * num[:, 1] + num[:, 2] + noise > 0)
+         | np.isin(cats, [1, 5, 7])).astype(np.float64)
+    xt, yt, xv, yv = x[:2400], y[:2400], x[2400:], y[2400:]
+    params = {"objective": "binary", "num_iterations": 30, "num_leaves": 7,
+              "min_data_in_leaf": 5, "categorical_feature": [0],
+              "early_stopping_round": 5, "metric": "auc"}
+    bd = train(params, xt, yt, eval_set=[(xv, yv)], mesh=eight_device_mesh)
+    with pytest.MonkeyPatch.context() as mp:
+        from synapseml_tpu.gbdt import device_predict
 
-    monkeypatch.setattr(device_predict, "cats_f32_representable",
-                        lambda mapper: False)
+        mp.setattr(device_predict, "cats_f32_representable",
+                   lambda mapper: False)
+        bh = train(params, xt, yt, eval_set=[(xv, yv)],
+                   callbacks=[lambda *a, **k: None])
+    return bd, bh, xt
 
 
-def test_mesh_device_bin_matches_host_bin_bitwise(eight_device_mesh,
-                                                  monkeypatch):
+def test_mesh_device_bin_matches_host_bin_bitwise(mesh_device_bin_pair):
     """The tentpole parity pin: mesh training with SHARD-LOCAL device
     binning (raw f32 rows sharded, packed edge tables replicated) grows
     trees BIT-IDENTICAL to single-device host-binned training — the
     pre-rounded histograms make the psum exact, and device_bin_cat
     reproduces np.searchsorted binning exactly on f32 grids."""
-    rng = np.random.default_rng(77)
-    x = rng.normal(size=(2400, 10)).astype(np.float32)
-    y = ((x[:, 0] * x[:, 1] + x[:, 2]) > 0).astype(np.float64)
-    params = {"objective": "binary", "num_iterations": 10, "num_leaves": 15,
-              "min_data_in_leaf": 5}
-    bd = train(params, x, y, mesh=eight_device_mesh)  # mesh device-bin
-    _force_host_bin(monkeypatch)
-    bh = train(params, x, y)                          # single-dev host-bin
-    np.testing.assert_array_equal(bd.parent, bh.parent)
-    np.testing.assert_array_equal(bd.feature, bh.feature)
-    np.testing.assert_array_equal(bd.bin, bh.bin)
-    np.testing.assert_array_equal(bd.leaf_value, bh.leaf_value)
-    np.testing.assert_allclose(bd.predict(x), bh.predict(x),
+    bd, bh, xt = mesh_device_bin_pair
+    assert bd.num_trees == bh.num_trees
+    T = bd.num_trees
+    np.testing.assert_array_equal(bd.parent[:T], bh.parent[:T])
+    np.testing.assert_array_equal(bd.feature[:T], bh.feature[:T])
+    np.testing.assert_array_equal(bd.bin[:T], bh.bin[:T])
+    np.testing.assert_array_equal(bd.leaf_value[:T], bh.leaf_value[:T])
+    np.testing.assert_allclose(bd.predict(xt), bh.predict(xt),
                                rtol=0, atol=0)
 
 
-def test_mesh_device_bin_categorical_matches_host_bin(eight_device_mesh,
-                                                      monkeypatch):
+def test_mesh_device_bin_categorical_matches_host_bin(mesh_device_bin_pair):
     """Categorical features ride the same shard-local device binning (the
     packed table carries category codes; device_bin_cat dispatches on the
-    host-side cat_flags) and must also be bit-identical to host binning.
-    f64 input with f32-exact values exercises the np.all(x == f32) arm of
-    the use_device_bin gate."""
-    rng = np.random.default_rng(78)
-    n = 2400
-    cats = rng.integers(0, 20, size=n).astype(np.float64)
-    num = rng.normal(size=n).astype(np.float32).astype(np.float64)
-    x = np.stack([cats, num], axis=1)
-    y = np.isin(cats, [1, 5, 7, 11, 16]).astype(np.float64)
-    params = {"objective": "binary", "num_iterations": 6, "num_leaves": 8,
-              "min_data_in_leaf": 5, "categorical_feature": [0]}
-    bd = train(params, x, y, mesh=eight_device_mesh)
-    _force_host_bin(monkeypatch)
-    bh = train(params, x, y)
-    np.testing.assert_array_equal(bd.feature, bh.feature)
-    np.testing.assert_array_equal(bd.bin, bh.bin)
-    np.testing.assert_array_equal(bd.leaf_value, bh.leaf_value)
-    np.testing.assert_array_equal(bd.predict(x), bh.predict(x))
+    host-side cat_flags): the mesh trees must actually USE categorical
+    splits on column 0 and their bitsets must match host binning's."""
+    bd, bh, _ = mesh_device_bin_pair
+    T = bd.num_trees
+    cat_splits = (bd.feature[:T] == 0) & (bd.bin[:T] < 0) \
+        & (bd.parent[:T] >= 0)
+    assert cat_splits.any()
+    np.testing.assert_array_equal(bd.cat_set[:T], bh.cat_set[:T])
 
 
-def test_mesh_device_eval_early_stop_matches_host(eight_device_mesh,
-                                                  monkeypatch):
+def test_mesh_device_eval_early_stop_matches_host(mesh_device_bin_pair):
     """Early stopping under the mesh device-eval scan (eval sets
     REPLICATED, every shard computes the full metric panel) stops at the
     SAME iteration with the SAME trees as the single-device host eval
     loop (forced via a no-op callback, which disables the device scan)."""
-    rng = np.random.default_rng(79)
-    x = rng.normal(size=(3000, 8)).astype(np.float32)
-    y = ((x[:, 0] + 0.5 * x[:, 1] + 0.1 * rng.normal(size=3000)) > 0
-         ).astype(np.float64)
-    xt, yt, xv, yv = x[:2400], y[:2400], x[2400:], y[2400:]
-    params = {"objective": "binary", "num_iterations": 40, "num_leaves": 7,
-              "min_data_in_leaf": 5, "early_stopping_round": 5,
-              "metric": "auc"}
-    bd = train(params, xt, yt, eval_set=[(xv, yv)], mesh=eight_device_mesh)
-    _force_host_bin(monkeypatch)
-    bh = train(params, xt, yt, eval_set=[(xv, yv)],
-               callbacks=[lambda *a, **k: None])
+    bd, bh, _ = mesh_device_bin_pair
+    assert bd.best_iteration is not None
     assert bd.best_iteration == bh.best_iteration
     np.testing.assert_array_equal(bd.feature[:bd.num_trees],
                                   bh.feature[:bh.num_trees])

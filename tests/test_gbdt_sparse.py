@@ -415,6 +415,59 @@ def test_sparse_mesh_matches_single_device():
                                atol=1e-6)
 
 
+def test_sparse_leaf_local_matches_full_pass():
+    """Sparse growth under ``leaf_local``: each step re-histograms only the
+    SMALLER child of the leaf split in the previous step (half-pass over the
+    carried parent panel) and derives the sibling as parent - small.  The
+    small-child histogram is bitwise identical to the matching slot of the
+    full two-sided pass, and leaf totals come from direct masked channel
+    sums either way — so tree STRUCTURE must be bitwise equal and leaf
+    values equal to fp-rounding of the (parent - small) subtraction."""
+    X, y = _sparse_data(1200, 120, density=0.08, seed=5)
+    params = {"objective": "binary", "num_iterations": 6, "num_leaves": 15,
+              "min_data_in_leaf": 5}
+    b_full = train({**params, "leaf_local": False}, X, y)
+    b_leaf = train({**params, "leaf_local": True}, X, y)
+    np.testing.assert_array_equal(b_leaf.parent, b_full.parent)
+    np.testing.assert_array_equal(b_leaf.feature, b_full.feature)
+    np.testing.assert_array_equal(b_leaf.bin, b_full.bin)
+    np.testing.assert_allclose(b_leaf.leaf_value, b_full.leaf_value,
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(b_leaf.predict(X), b_full.predict(X),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_sparse_leaf_local_mesh_matches_single_device(eight_device_mesh):
+    """The carried-parent half-pass under data-parallel growth: ``l`` (and
+    so the carry hit) derives from the REDUCED summaries — uniform across
+    shards — the smaller side is chosen by GLOBAL psummed counts, and the
+    psum of the half histogram sits outside the cond.  The mesh fit must
+    track the single-device leaf-local fit."""
+    X, y = _sparse_data(997, 120, density=0.08, seed=6)  # odd n: row padding
+    params = {"objective": "binary", "num_iterations": 6, "num_leaves": 15,
+              "min_data_in_leaf": 5, "leaf_local": True}
+    b1 = train(dict(params), X, y)
+    b8 = train(dict(params), X, y, mesh=eight_device_mesh)
+    np.testing.assert_array_equal(b1.feature, b8.feature)
+    np.testing.assert_allclose(b8.predict(X), b1.predict(X), rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_sparse_leaf_local_multiclass_stays_on_full_pass():
+    """Multiclass sparse growth vmaps the grower over classes; a vmapped
+    lax.cond runs BOTH histogram branches, so the boost gate keeps
+    leaf_local off there (boost.py).  The fit must still work and match
+    the explicit full-pass fit exactly."""
+    rng = np.random.default_rng(7)
+    X, _ = _sparse_data(600, 60, density=0.1, seed=7)
+    y = rng.integers(0, 3, 600).astype(float)
+    params = {"objective": "multiclass", "num_class": 3,
+              "num_iterations": 3, "num_leaves": 7, "min_data_in_leaf": 5}
+    b_off = train({**params, "leaf_local": False}, X, y)
+    b_on = train({**params, "leaf_local": True}, X, y)
+    np.testing.assert_array_equal(b_on.predict(X), b_off.predict(X))
+
+
 def test_sparse_voting_parallel():
     import jax
     from jax.sharding import Mesh

@@ -5,12 +5,13 @@ zero-unwaived gate over the real layout-parameterized entries, and the
 Fixture entries are tiny synthetic ``SpmdEntry`` objects traced on CPU
 (``jax.make_jaxpr`` only — no compile, no execution) under real
 ``SpecLayout`` meshes (the conftest pins 8 virtual CPU devices). The
-gate traces the repo's REAL entries — the tensor-parallel ONNX serving
-path, the 2-D feature-parallel gbdt grower, and the sparse
-mesh-vs-single differential pair — and pins the two findings this pack
-was built to surface: the ONNX planner's replicate-on-conflict decision
-for the tied weight (SMT110) and the ``use_device_bin`` host-binning
-guard (SMT112), each carrying a reasoned LINT_ACKS.md row.
+gate traces the repo's REAL entries — the fsdp+tensor-parallel ONNX
+serving path over (1, 2, 2), the 2-D feature-parallel gbdt grower, and
+the sparse mesh-vs-single differential pair — and pins the two findings
+this pack was built to surface as RESOLVED: the ONNX planner's
+replicate-on-conflict decision for the tied weight (SMT110, closed by
+the fsdp store-and-gather plan) and the ``use_device_bin`` host-binning
+guard (SMT112, closed by device-side distributed binning).
 """
 
 import json
@@ -165,6 +166,41 @@ def test_smt111_true_negative_consistent_pins():
         "fn": f, "args": (np.ones((4, 4), np.float32),),
         "layout": layout})
     assert _findings(entry, "SMT111") == []
+
+
+def test_smt111_fsdp_gather_repin_is_sanctioned():
+    # the stored->use re-pin IS a reshard, but it is the documented
+    # all-gather-on-use pattern: fsdp axis dropped from the stored spec,
+    # everything else identical -> no finding. A genuine disagreement on
+    # the same chain still fires.
+    from synapseml_tpu.runtime.layout import SpecLayout
+
+    devs = jax.devices()
+    if len(devs) < 4:
+        pytest.skip("needs >= 4 devices (conftest pins 8 virtual)")
+    layout = SpecLayout.build(data=1, model=2, fsdp=2, devices=devs[:4])
+    stored = layout.fsdp_weight(rank=2, dim=0,
+                                use_spec=layout.col_weight(rank=2))
+
+    def gather_only(x):
+        a = layout.constraint(x, stored)
+        return layout.gather_for_use(a, stored)
+
+    entry = SpmdEntry("fix.fsdp.gather", lambda: {
+        "fn": gather_only, "args": (np.ones((4, 4), np.float32),),
+        "layout": layout})
+    assert _findings(entry, "SMT111") == []
+
+    def gather_then_conflict(x):
+        a = layout.constraint(x, stored)
+        b = layout.gather_for_use(a, stored)
+        return layout.constraint(b, layout.batch(rank=2))
+
+    entry2 = SpmdEntry("fix.fsdp.conflict", lambda: {
+        "fn": gather_then_conflict, "args": (np.ones((4, 4), np.float32),),
+        "layout": layout})
+    fs = _findings(entry2, "SMT111")
+    assert fs and "re-constrained" in fs[0].message
 
 
 def test_smt111_cold_entries_are_exempt():
@@ -383,9 +419,17 @@ def test_spmd_pack_skipped_when_selection_has_no_spmd_codes():
 def test_spmd_gate_default_entries_zero_unwaived():
     findings, errors = run_spmd_pack(root=REPO_ROOT)
     assert errors == []
-    # the one standing, reasoned finding the pack still surfaces
-    assert any(f.code == "SMT110" and "w_tied" in f.message
-               for f in findings), "ONNX tp tied-weight replication"
+    # the tied-weight replication finding is GONE — pinned absent: the
+    # fsdp planner stores w_tied row-sharded over `fsdp` and all-gathers
+    # at each consumer, so the replicate-on-conflict decision (and its
+    # LINT_ACKS waiver row) retired with the (1,2,2) entry
+    assert not any(f.code == "SMT110" and "w_tied" in f.message
+                   for f in findings), [
+        f.message for f in findings if f.code == "SMT110"]
+    # and the sanctioned stored->use gather re-pin must NOT read as an
+    # SMT111 constraint conflict
+    assert not any(f.code == "SMT111" for f in findings), [
+        f.message for f in findings if f.code == "SMT111"]
     # the sparse mesh-vs-single divergence is GONE: the conditional
     # per-shard RNG fold and the trace-pair shape fix converged the twins
     # (test_sparse_mesh_matches_single_device passes; golden pins exit 0)
@@ -426,15 +470,41 @@ def test_placement_report_tp_names_every_initializer():
     assert of1.placement_report() == []
 
 
+def test_placement_report_fsdp_stores_tied_weight():
+    # the acceptance pin for the fsdp planner: under (1,2,2) the tied
+    # weight STORES over fsdp (decision row with the gather reason)
+    # instead of replicating on the role conflict — the SMT110 waiver's
+    # retirement in planner terms
+    from synapseml_tpu.analysis.rules_spmd import _spmd_mlp_bytes
+    from synapseml_tpu.onnx.importer import OnnxFunction
+    from synapseml_tpu.runtime.layout import representative_layouts
+
+    if len(jax.devices()) < 4:
+        pytest.skip("needs >= 4 devices (conftest pins 8 virtual)")
+    layout = representative_layouts()["(1,2,2)"]
+    of = OnnxFunction(_spmd_mlp_bytes(), dtype_policy="float32",
+                      layout=layout)
+    rows = {r["tensor"]: r for r in of.placement_report()}
+    assert rows["w_tied"]["decision"] == "fsdp"
+    assert "all-gather" in rows["w_tied"]["reason"]
+    assert "conflict" in rows["w_tied"]["reason"]
+    assert rows["w1"]["decision"] == "fsdp"      # stacked fsdp x model
+    assert rows["b1"]["decision"] == "replicated"  # pure bias stays put
+
+
 def test_representative_layouts_degrade_to_available_devices():
     from synapseml_tpu.runtime.layout import representative_layouts
 
     lays = representative_layouts()
-    assert set(lays) == {"(1,1)", "(1,2)-tp", "(4,2)-fp"}
+    assert set(lays) == {"(1,1)", "(1,2)-tp", "(4,2)-fp", "(1,2,2)"}
     assert lays["(1,1)"].n_devices == 1
     assert lays["(1,2)-tp"].model_size == min(2, len(jax.devices()))
+    if len(jax.devices()) >= 4:
+        assert lays["(1,2,2)"].fsdp_size == 2
+        assert lays["(1,2,2)"].model_size == 2
     one = representative_layouts(devices=jax.devices()[:1])
     assert one["(4,2)-fp"].n_devices == 1  # degrades, never raises
+    assert one["(1,2,2)"].n_devices == 1
 
 
 def test_spmd_trace_pair_traces_both_ways():
@@ -510,7 +580,7 @@ def test_spmd_diff_device_bin_entry_identical():
 def test_spmd_diff_identical_twin_exits_zero():
     r = subprocess.run(
         [sys.executable, os.path.join(REPO_ROOT, "tools", "spmd_diff.py"),
-         "--entry", "onnx.mlp[tp,(1,2)]"],
+         "--entry", "onnx.mlp[fsdp,(1,2,2)]"],
         capture_output=True, text=True, timeout=600)
     assert r.returncode == 0, r.stderr + r.stdout
     assert "structurally identical" in r.stdout

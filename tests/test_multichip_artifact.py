@@ -1,9 +1,12 @@
 """The committed MULTICHIP artifact: the driver's multi-chip gate output.
 
-Since ISSUE 14 the dryrun runs every engine over ONE canonical 2-D
-``(data, model)`` ``SpecLayout`` mesh (``runtime/layout.py``) — this test
-pins the committed artifact to that shape so a regression back to 1-D
-data-parallel-only dryruns fails CI, not just review.
+Since ISSUE 14 the dryrun runs every engine over ONE canonical
+``SpecLayout`` mesh (``runtime/layout.py``); since ISSUE 19 that mesh is
+the 3-D ``(data, fsdp, model)`` beyond-HBM layout when 8 devices allow
+it — ONNX weights store row-sharded over ``fsdp`` and all-gather at each
+consumer, and the tail stamps the fsdp decision (``FSDP_ONNX``). This
+test pins the committed artifact to that shape so a regression back to
+2-D (or 1-D data-parallel-only) dryruns fails CI, not just review.
 """
 
 import glob
@@ -33,11 +36,25 @@ def test_latest_multichip_artifact_is_ok():
     assert art["n_devices"] >= 8
 
 
-def test_latest_multichip_artifact_exercises_2d_mesh():
+def test_latest_multichip_artifact_exercises_3d_mesh():
     with open(_latest_artifact()) as f:
         art = json.load(f)
     mesh = art.get("mesh")
     assert mesh, "artifact missing the mesh stamp (layout.describe())"
-    assert set(mesh) == {"data", "model"}
-    assert mesh["model"] >= 2, "model axis unpopulated: not a 2-D dryrun"
-    assert mesh["data"] * mesh["model"] == art["n_devices"]
+    assert set(mesh) == {"data", "fsdp", "model"}
+    assert mesh["model"] >= 2, "model axis unpopulated: not a tp dryrun"
+    assert mesh["fsdp"] >= 2, "fsdp axis unpopulated: not a 3-D dryrun"
+    assert mesh["data"] * mesh["fsdp"] * mesh["model"] == art["n_devices"]
+
+
+def test_latest_multichip_artifact_stamps_fsdp_storage():
+    # the in-run beyond-HBM proof line: at least one ONNX weight STORED
+    # row-sharded over the fsdp axis, with output parity vs the
+    # replicated path asserted inside the dryrun itself
+    with open(_latest_artifact()) as f:
+        art = json.load(f)
+    tail = art.get("tail", "")
+    m = re.search(r"FSDP_ONNX stored=(\d+) bytes=(\d+)", tail)
+    assert m, f"dryrun tail missing the FSDP_ONNX stamp: {tail!r}"
+    assert int(m.group(1)) > 0
+    assert int(m.group(2)) > 0

@@ -21,7 +21,22 @@ def test_notebooks_exist():
     assert len(NOTEBOOKS) >= 5
 
 
-@pytest.mark.parametrize("path", NOTEBOOKS,
-                         ids=[os.path.basename(p) for p in NOTEBOOKS])
+# the training-heavy demos (60s/30s/20s/13s on one CPU core) run only in
+# the full suite; every feature they demo has dedicated unit coverage
+# (recommendation: test_recommendation.py + the SAR benchmark row), and the
+# remaining notebooks still smoke the demo infrastructure each tier-1 run
+_SLOW_NOTEBOOKS = {"01_lightgbm_classification.py",
+                   "10_hyperparameter_tuning.py",
+                   "11_sparse_text_gbdt.py",
+                   "05_recommendation_and_more.py"}
+
+
+@pytest.mark.parametrize(
+    "path",
+    [pytest.param(p, marks=([pytest.mark.slow]
+                            if os.path.basename(p) in _SLOW_NOTEBOOKS
+                            else []))
+     for p in NOTEBOOKS],
+    ids=[os.path.basename(p) for p in NOTEBOOKS])
 def test_notebook_runs(path):
     runpy.run_path(path, run_name="__main__")

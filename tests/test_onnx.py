@@ -510,6 +510,75 @@ def test_qlinear_matmul_golden():
                                   [[168, 115, 255], [1, 66, 151]])
 
 
+def test_qlinear_conv_golden():
+    """ONNX-spec QLinearConv shape (the 1x1-kernel spec example): uint8
+    image and kernel with per-channel w_scale/w_zero_point arrays, int32
+    accumulation over zero-centred operands, rescale by
+    x_scale*w_scale/y_scale, round half to even, re-centre, saturate."""
+    from synapseml_tpu.onnx.ops import OPS
+
+    rng = np.random.default_rng(5)
+    x = rng.integers(0, 256, size=(1, 1, 7, 7), dtype=np.uint8)
+    x_scale, x_zp = np.float32(0.00369204697), np.uint8(132)
+    w = np.array([0], np.uint8).reshape(1, 1, 1, 1)
+    w_scale = np.array([0.00172794575], np.float32)
+    w_zp = np.array([255], np.uint8)
+    y_scale, y_zp = np.float32(0.00162681262), np.uint8(123)
+    y = OPS["QLinearConv"](
+        [jnp.asarray(x), x_scale, x_zp, jnp.asarray(w), w_scale, w_zp,
+         y_scale, y_zp], {},
+        {"op_type": "QLinearConv", "opset": 13})
+    assert np.asarray(y).dtype == np.uint8
+    acc = (x.astype(np.int32) - 132) * (0 - 255)
+    ref = np.clip(np.round(
+        acc.astype(np.float32)
+        * np.float32(float(x_scale) * float(w_scale[0]) / float(y_scale)))
+        + 123, 0, 255).astype(np.uint8)
+    np.testing.assert_array_equal(np.asarray(y), ref)
+
+
+def test_qlinear_conv_graph_bias_padding_per_channel():
+    """QLinearConv through a real graph: 2 output channels with DISTINCT
+    per-channel scales/zero_points, an int32 bias (spec: quantized at
+    x_scale*w_scale, added into the accumulator) and explicit padding —
+    exactly equals a naive integer reference requantized the same way."""
+    rng = np.random.default_rng(6)
+    x = rng.integers(0, 256, size=(1, 2, 5, 5), dtype=np.uint8)
+    w = rng.integers(0, 256, size=(2, 2, 3, 3), dtype=np.uint8)
+    bias = np.array([700, -1300], np.int32)
+    x_scale, x_zp = np.float32(0.02), np.uint8(120)
+    w_scale = np.array([0.015, 0.03], np.float32)
+    w_zp = np.array([110, 140], np.uint8)
+    y_scale, y_zp = np.float32(0.05), np.uint8(128)
+    fn = build_fn(
+        [node("QLinearConv",
+              ["x", "xs", "xz", "w", "ws", "wz", "ys", "yz", "b"], ["y"],
+              pads=[1, 1, 1, 1])],
+        [value_info("x", np.uint8, [None, 2, 5, 5])],
+        [value_info("y", np.uint8, None)],
+        {"xs": x_scale, "xz": x_zp, "w": w, "ws": w_scale, "wz": w_zp,
+         "ys": y_scale, "yz": y_zp, "b": bias})
+    y = np.asarray(fn({"x": x})["y"])
+    assert y.shape == (1, 2, 5, 5) and y.dtype == np.uint8
+    # naive reference: zero-centred int32 conv with zero-padding in the
+    # SHIFTED domain (pad pixels are real x_zero_point), then requantize
+    xc = x.astype(np.int32) - int(x_zp)
+    xp = np.zeros((1, 2, 7, 7), np.int32)
+    xp[:, :, 1:6, 1:6] = xc
+    ref = np.empty((1, 2, 5, 5), np.uint8)
+    for o in range(2):
+        wc = w[o].astype(np.int32) - int(w_zp[o])
+        scale = np.float32(float(x_scale) * float(w_scale[o])
+                           / float(y_scale))
+        for i in range(5):
+            for j in range(5):
+                acc = int((xp[0, :, i:i + 3, j:j + 3] * wc).sum()) \
+                    + int(bias[o])
+                q = np.round(np.float32(acc) * scale) + int(y_zp)
+                ref[0, o, i, j] = np.uint8(np.clip(q, 0, 255))
+    np.testing.assert_array_equal(y, ref)
+
+
 def test_matmul_integer_graph_matches_dequant_path():
     """MatMulInteger through a real graph == dequantize-then-float-matmul
     to within accumulated float error, and exactly equals the exact
@@ -656,3 +725,63 @@ def test_tp_sharding_bf16_policy():
                       layout=SpecLayout.build(data=2, model=4))
     out = np.asarray(fn({"x": x})["y"])
     np.testing.assert_allclose(out, ref, rtol=2e-2, atol=2e-2)
+
+
+# -- beyond-HBM storage: the fsdp axis of runtime/layout.py -------------------
+
+def test_fsdp_planner_stores_weights_and_matches_reference():
+    """Under a 3-D (data, fsdp, model) layout the planner's third decision
+    kicks in: matmul weights are use-sharded over 'model' AND stored
+    row-sharded over 'fsdp' (1/(f*m) of the tensor per device at rest),
+    all-gathered transiently at each consumer — outputs match the
+    replicated reference."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from synapseml_tpu.runtime.layout import SpecLayout
+
+    if len(jax.devices()) < 4:
+        pytest.skip("needs 4 devices for the (1,2,2) layout")
+    rng = np.random.default_rng(21)
+    mb = _tp_mlp_bytes(rng)
+    x = rng.normal(size=(16, 32)).astype(np.float32)
+    ref = np.asarray(OnnxFunction(mb)({"x": x})["y"])
+    layout = SpecLayout.build(data=1, model=2, fsdp=2,
+                              devices=jax.devices()[:4])
+    fn = OnnxFunction(mb, layout=layout)
+    assert fn._const_specs["w1"] == P("fsdp", "model")
+    assert fn._const_specs["w2"] == P("fsdp", "model")
+    by_name = {r["tensor"]: r for r in fn.placement_report()}
+    assert by_name["w1"]["decision"] == "fsdp"
+    assert "all-gather" in by_name["w1"]["reason"]
+    assert by_name["b1"]["decision"] == "replicated"
+    # at rest each device holds exactly 1/(fsdp*model) of the weight
+    w1 = fn.constants["w1"]
+    assert w1.sharding.spec == P("fsdp", "model")
+    assert max(s.data.nbytes for s in w1.addressable_shards) == \
+        w1.nbytes // 4
+    np.testing.assert_allclose(np.asarray(fn({"x": x})["y"]), ref,
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_fsdp_only_layout_stores_without_model_axis():
+    """model=1, fsdp=2: no tensor-parallel use sharding is possible, but
+    storage sharding still pays — weights store row-sharded over fsdp and
+    gather at the consumer."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from synapseml_tpu.runtime.layout import SpecLayout
+
+    rng = np.random.default_rng(22)
+    mb = _tp_mlp_bytes(rng)
+    x = rng.normal(size=(8, 32)).astype(np.float32)
+    ref = np.asarray(OnnxFunction(mb)({"x": x})["y"])
+    layout = SpecLayout.build(data=1, model=1, fsdp=2,
+                              devices=jax.devices()[:2])
+    fn = OnnxFunction(mb, layout=layout)
+    assert fn._const_specs["w1"] == P("fsdp", None)
+    assert {r["tensor"] for r in fn.placement_report()
+            if r["decision"] == "fsdp"} == {"w1", "w2"}
+    np.testing.assert_allclose(np.asarray(fn({"x": x})["y"]), ref,
+                               rtol=1e-5, atol=1e-6)

@@ -529,6 +529,35 @@ def test_aot_cache_persists_and_new_instance_hits(fresh_registry, aot_dir):
         "count"] == before
 
 
+def test_aot_cache_closure_key_separates_placement_plans(fresh_registry,
+                                                         aot_dir):
+    # same fn name, same input avals, DIFFERENT closure placement plan
+    # (a replicated vs an fsdp-stored ONNX executor in miniature): the
+    # digests must differ so neither instance loads the other's
+    # executable — a distinct .aot file per closure key, miss counted
+    # for each
+    x = np.ones((16, 16), np.float32)
+    pj_rep = profiling.profiled_jit(_heavy, name="t.ckey",
+                                    closure_key="layout=replicated")
+    pj_rep(x)
+    pj_fsdp = profiling.profiled_jit(
+        _heavy, name="t.ckey",
+        closure_key="layout=(1,2,2);w:P('fsdp', 'model')")
+    pj_fsdp(x)
+    files = [f for f in os.listdir(aot_dir) if f.startswith("t.ckey-")]
+    assert len(files) == 2
+    snap = fresh_registry.snapshot()
+    assert _series(snap, "smt_aot_cache_misses_total")[("t.ckey",)][
+        "value"] == 2
+    # and a fresh same-key instance still hits its own entry
+    pj3 = profiling.profiled_jit(_heavy, name="t.ckey",
+                                 closure_key="layout=replicated")
+    pj3(x)
+    snap2 = fresh_registry.snapshot()
+    assert _series(snap2, "smt_aot_cache_hits_total")[("t.ckey",)][
+        "value"] == 1
+
+
 def test_aot_cache_prewarm_loads_every_entry(fresh_registry, aot_dir):
     pj = profiling.profiled_jit(_heavy, name="t.prewarm")
     pj(np.ones((8, 8), np.float32))

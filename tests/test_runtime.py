@@ -243,6 +243,151 @@ def test_spec_layout_persists_through_save_load(tmp_path):
     assert degraded.n_devices <= 8
 
 
+def test_spec_layout_build_3d_and_fsdp_specs():
+    from jax.sharding import PartitionSpec as P
+
+    from synapseml_tpu.runtime import SpecLayout
+
+    lay = SpecLayout.build(data=2, model=2, fsdp=2)
+    assert lay.describe() == {"data": 2, "fsdp": 2, "model": 2}
+    assert (lay.data_size, lay.fsdp_size, lay.model_size) == (2, 2, 2)
+    assert lay.n_devices == 8
+    # STORAGE stacks the fsdp axis onto the point-of-use spec
+    assert lay.fsdp_weight(rank=1) == P("fsdp")
+    assert lay.fsdp_weight(rank=2, dim=0,
+                           use_spec=lay.col_weight(rank=2)) == \
+        P("fsdp", "model")
+    # a dim already model-sharded stores jointly over (fsdp, model)
+    assert lay.fsdp_weight(rank=2, dim=1, use_spec=P(None, "model")) == \
+        P(None, ("fsdp", "model"))
+    assert lay.embed_weight() == P(("fsdp", "model"), None)
+    # use_spec strips exactly the fsdp axis: what the consumer math wants
+    assert lay.use_spec(P("fsdp", "model")) == P(None, "model")
+    assert lay.use_spec(P(None, ("fsdp", "model"))) == P(None, "model")
+    assert lay.use_spec(P("fsdp")) == P(None)
+    # 2-D degradation: storage collapses to the use spec, adopting call
+    # sites stay correct without a 3-D mesh
+    lay2 = SpecLayout.build(data=4, model=2)
+    assert lay2.fsdp_size == 1 and lay2.fsdp_axis is None
+    assert lay2.fsdp_weight(rank=2, dim=0,
+                            use_spec=P(None, "model")) == P(None, "model")
+    assert lay2.use_spec(P(None, "model")) == P(None, "model")
+
+
+def test_spec_layout_fsdp_build_validation():
+    from synapseml_tpu.runtime import SpecLayout
+
+    with pytest.raises(ValueError, match="model_axis"):
+        SpecLayout.build(model_axis=None, fsdp=2)
+    with pytest.raises(ValueError, match="divide"):
+        SpecLayout.build(model=2, fsdp=3)
+
+
+def test_spec_layout_fsdp_gather_parity():
+    """Row-sharded storage + all-gather-on-use computes exactly what the
+    replicated path computes; the stored argument stays fsdp-sharded."""
+    import jax
+    import jax.numpy as jnp
+
+    from synapseml_tpu.runtime import SpecLayout
+
+    lay = SpecLayout.build(data=2, model=2, fsdp=2)
+    rng = np.random.default_rng(3)
+    w = rng.normal(size=(8, 6)).astype(np.float32)
+    x = rng.normal(size=(4, 8)).astype(np.float32)
+    stored = lay.fsdp_weight(rank=2, dim=0, use_spec=lay.col_weight(rank=2))
+    w_dev = lay.put(w, stored)
+    assert w_dev.sharding.spec == stored
+
+    @jax.jit
+    def f(xv, wv):
+        return xv @ lay.gather_for_use(wv, stored)
+
+    np.testing.assert_array_equal(np.asarray(f(x, w_dev)), x @ w)
+    # storage is untouched by use: still row-sharded at rest
+    assert w_dev.sharding.spec == stored
+    # the explicit eager path lands on the use spec
+    g = lay.donated_gather(stored)
+    gathered = g(w_dev)
+    assert gathered.sharding.spec == lay.use_spec(stored)
+    np.testing.assert_array_equal(np.asarray(gathered), w)
+    # per-device at-rest residency really is nbytes / (fsdp * model)
+    shard_bytes = {s.device.id: s.data.nbytes
+                   for s in w_dev.addressable_shards}
+    assert max(shard_bytes.values()) == w.nbytes // 4
+    # no-op identity on a 2-D layout: same call sites, no fsdp axis
+    lay2 = SpecLayout.build(data=4, model=2)
+    stored2 = lay2.fsdp_weight(rank=2, dim=0,
+                               use_spec=lay2.col_weight(rank=2))
+    w2 = lay2.put(w, stored2)
+    np.testing.assert_array_equal(
+        np.asarray(jax.jit(
+            lambda v: lay2.gather_for_use(v, stored2))(w2)), w)
+
+
+def test_spec_layout_3d_save_load_and_degradation(caplog):
+    import logging
+
+    from synapseml_tpu.runtime import SpecLayout
+
+    lay = SpecLayout.build(data=2, model=2, fsdp=2)
+    back = SpecLayout.from_state_dict(lay.state_dict())
+    assert back == lay
+    # pre-fsdp artifacts stay byte-identical: no fsdp keys on 2-D layouts
+    assert "fsdp" not in SpecLayout.build(data=4, model=2).state_dict()
+    # degradation collapses data first, keeps the storage shape: a saved
+    # (4,2,2) on this 8-device host serves as (2,2,2)
+    big = dict(lay.state_dict(), data=4)
+    with caplog.at_level(logging.WARNING, "synapseml_tpu.layout"):
+        degraded = SpecLayout.from_state_dict(big)
+    assert degraded.describe() == {"data": 2, "fsdp": 2, "model": 2}
+    assert any("degrading" in r.message for r in caplog.records)
+
+
+def test_spec_layout_3d_degrades_to_single_chip_and_serves(monkeypatch,
+                                                           caplog):
+    """A (2,2,2)-trained artifact on a ONE-chip worker: the fsdp axis
+    collapses entirely (warning logged), the layout lands at (1, 1), and
+    the fsdp helpers keep working as no-ops — the stored weight is just
+    resident and gather_for_use is the identity, so serving code written
+    against the 3-D roles runs unchanged."""
+    import logging
+
+    import jax
+    import jax.numpy as jnp
+
+    from synapseml_tpu.runtime import SpecLayout
+
+    saved = SpecLayout.build(data=2, model=2, fsdp=2).state_dict()
+    one = jax.devices()[:1]
+    real_devices = jax.devices
+    monkeypatch.setattr(jax, "devices",
+                        lambda *a, **k: one if not a and not k
+                        else real_devices(*a, **k))
+    with caplog.at_level(logging.WARNING, "synapseml_tpu.layout"):
+        degraded = SpecLayout.from_state_dict(saved)
+    assert any("degrading" in r.message for r in caplog.records)
+    assert degraded.describe() == {"data": 1, "model": 1}
+    assert degraded.n_devices == 1 and degraded.fsdp_axis is None
+    # the 3-D storage role degrades to the bare use-spec (no fsdp factor;
+    # the size-1 model axis is effectively replication)…
+    assert degraded.fsdp_weight(rank=2, dim=0,
+                                use_spec=degraded.col_weight(rank=2)) == \
+        degraded.col_weight(rank=2)
+    # …and the gather is the identity, so a serve still computes
+    w = degraded.put(jnp.arange(12.0).reshape(4, 3),
+                     degraded.fsdp_weight(2, 0, degraded.col_weight(2)))
+    x = jnp.ones((2, 4))
+
+    @jax.jit
+    def f(x, w):
+        return x @ degraded.gather_for_use(
+            w, degraded.col_weight(2))
+
+    np.testing.assert_allclose(np.asarray(f(x, w)),
+                               np.asarray(x @ jnp.arange(12.0).reshape(4, 3)))
+
+
 def test_graft_entry_dryrun_multichip_in_process():
     """The driver's multi-chip gate: with 8 visible devices the impl runs
     in-process; with fewer it must self-provision a virtual CPU mesh (the

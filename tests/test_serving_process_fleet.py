@@ -344,3 +344,98 @@ def test_scale_up_under_load_is_warm_start_bounded():
             first_reply_s, cold_compile_s)
     finally:
         fleet.stop()
+
+
+def test_beyond_hbm_model_served_fsdp_under_device_budget():
+    """Tentpole proof (ISSUE 19): a model whose replicated weights exceed
+    a virtual per-device HBM budget is served through the NORMAL process
+    fleet by storing the weights row-sharded over the 3-D layout's fsdp
+    axis (all-gathered transiently at each consumer). Pins, all measured
+    INSIDE the worker processes: (a) the replicated control really busts
+    the budget, (b) the fsdp worker's at-rest residency sits under it —
+    and under the replicated control, (c) numeric parity across the two
+    fleets, (d) a worker added later warm-starts the fsdp executable from
+    the persisted AOT cache (hit counter > 0, zero cold-compile samples).
+    The strict >= 0.9x throughput gate runs on real hardware in the
+    ``onnx_fsdp_hbm`` bench lane; here a loose wall-clock sanity bound
+    keeps CI honest without timing flakes."""
+    import json as _json
+    import time
+
+    import jax
+
+    if len(jax.devices()) < 4:
+        pytest.skip("needs 4 (virtual) devices for the (1,2,2) layout")
+    sys.path.insert(0, _REPO)
+    from tests.serving_fault_stage import (FSDP_DEVICE_BUDGET_BYTES,
+                                           FsdpOnnxReply)
+
+    def _ask(addr):
+        with urllib.request.urlopen(addr + "/", data=b"q", timeout=60) as r:
+            assert r.status == 200
+            resident, checksum = r.read().decode().split(":")
+        return int(resident), float(checksum)
+
+    # control fleet: replicated storage busts the virtual budget
+    rep = ProcessServingFleet(
+        FsdpOnnxReply(use_fsdp=False), n_workers=1,
+        import_modules=["tests.serving_fault_stage"], reply_timeout=60.0,
+        startup_timeout=120.0)
+    try:
+        rep_bytes, rep_sum = _ask(rep.address)
+        rep_times = []
+        for _ in range(10):
+            t0 = time.perf_counter()
+            _ask(rep.address)
+            rep_times.append(time.perf_counter() - t0)
+        rep_best = min(rep_times)
+    finally:
+        rep.stop()
+    assert rep_bytes > FSDP_DEVICE_BUDGET_BYTES, (
+        "control model fits replicated; the proof is vacuous")
+
+    # fsdp fleet: same model, weights stored over (fsdp=2, model=2)
+    fleet = ProcessServingFleet(
+        FsdpOnnxReply(use_fsdp=True), n_workers=1,
+        import_modules=["tests.serving_fault_stage"], reply_timeout=60.0,
+        startup_timeout=120.0, aot_cache_dir="auto")
+    try:
+        fsdp_bytes, fsdp_sum = _ask(fleet.address)
+        assert fsdp_bytes < FSDP_DEVICE_BUDGET_BYTES
+        assert fsdp_bytes < rep_bytes / 2  # 4 devices: expect ~0.25x + bias
+        assert abs(fsdp_sum - rep_sum) <= 1e-4 * abs(rep_sum)
+        fsdp_times = []
+        for _ in range(10):
+            t0 = time.perf_counter()
+            _ask(fleet.address)
+            fsdp_times.append(time.perf_counter() - t0)
+        # loose sanity: the gathers must not blow serving up by an order
+        # of magnitude (CPU all-gather is not the bench's TPU story).
+        # Best-of-10 on both sides so a single GC pause or scheduler
+        # hiccup on a loaded one-core CI box cannot flake the suite.
+        fsdp_best = min(fsdp_times)
+        assert fsdp_best < max(rep_best, 0.02) * 10.0, (fsdp_times, rep_times)
+
+        # worker 0 compiled cold and persisted the (1,2,2) executable
+        fam0 = _json.loads(urllib.request.urlopen(
+            fleet.addresses[0] + "/metrics?format=json",
+            timeout=15).read().decode())["families"]
+        assert fam0["smt_aot_cache_misses_total"]["series"][0]["value"] >= 1
+
+        # a worker added later serves its first request from the persisted
+        # cache: hit counter up, NO cold smt_compile_seconds sample
+        addr = fleet.add_worker()
+        assert addr is not None
+        new_bytes, new_sum = _ask(addr)
+        assert new_bytes == fsdp_bytes
+        assert abs(new_sum - fsdp_sum) <= 1e-6 * abs(fsdp_sum)
+        fam1 = _json.loads(urllib.request.urlopen(
+            addr + "/metrics?format=json",
+            timeout=15).read().decode())["families"]
+        hits = fam1["smt_aot_cache_hits_total"]["series"]
+        assert hits and hits[0]["value"] >= 1, hits
+        comp1 = fam1.get("smt_compile_seconds")
+        total1 = sum(s["count"] for s in comp1["series"]) if comp1 else 0
+        assert total1 == 0, comp1
+    finally:
+        fleet.stop()

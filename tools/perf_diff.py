@@ -54,6 +54,7 @@ PRIMARY = {
     "flash_attention_32k": "tflops_nominal",
     "flash_attention_gqa": "tflops_nominal",
     "onnx_tp_sharding": "rows_per_sec",
+    "onnx_fsdp_hbm": "rows_per_sec",
 }
 
 
