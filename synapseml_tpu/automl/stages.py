@@ -161,24 +161,45 @@ def _evaluate(model, val: Table, metric: str, label_col: str) -> float:
 
 
 class TuneHyperparameters(Estimator):
-    """Parallel random/grid search over estimator param spaces
-    (reference ``TuneHyperparameters.scala:36-225``; executor pool ``:97-122``)."""
+    """Parallel hyperparameter search over estimator param spaces
+    (reference ``TuneHyperparameters.scala:36-225``; executor pool ``:97-122``).
+
+    ``search_mode="random"`` (the default) and ``"grid"`` keep the
+    reference's thread-pool full-fit search. ``"asha"`` routes the study
+    through :mod:`synapseml_tpu.tuning` — asynchronous successive halving
+    over a shared pre-binned dataset, with optional worker-process
+    execution (``executor="processes"``), a total-iteration ``budget``,
+    and a ``journal_path`` for crash-resume (see ``docs/tuning.md``)."""
 
     models = ComplexParam("estimator (or list) to tune", object, default=None)
     hyperparams = ComplexParam("param name -> space dict (HyperparamBuilder."
                                "build())", object, default=None)
-    search_mode = Param("random | grid", str, default="random")
+    search_mode = Param("random | grid | asha", str, default="random")
     number_of_runs = Param("evaluations for random search", int, default=10)
     parallelism = Param("concurrent fits", int, default=4)
     evaluation_metric = Param("auc | accuracy | rmse | l1 | l2", str, default="auc")
     label_col = Param("label column", str, default="label")
     train_ratio = Param("train fraction (rest validates)", float, default=0.75)
     seed = Param("seed", int, default=0)
+    executor = Param("asha trial executor: threads | processes", str,
+                     default="threads")
+    budget = Param("asha: max total boosting iterations across the study "
+                   "(0 = unlimited)", int, default=0)
+    min_resource = Param("asha: first-rung iteration budget (0 = "
+                         "max_resource // eta**2); raise it when one "
+                         "iteration is too noisy to rank trials", int,
+                         default=0)
+    journal_path = Param("asha: append-only JSONL study journal; an existing "
+                         "journal resumes the study", str, default=None)
 
     def _fit(self, table: Table) -> "TuneHyperparametersModel":
         if self.models is None or self.hyperparams is None:
             raise ValueError(f"TuneHyperparameters({self.uid}): set models and "
                              f"hyperparams")
+        if self.search_mode not in ("random", "grid", "asha"):
+            raise ValueError(f"TuneHyperparameters({self.uid}): unknown "
+                             f"search_mode {self.search_mode!r} "
+                             f"(random | grid | asha)")
         estimators = self.models if isinstance(self.models, list) else [self.models]
         train, val = table.random_split([self.train_ratio, 1 - self.train_ratio],
                                         seed=self.seed)
@@ -190,6 +211,9 @@ class TuneHyperparameters(Estimator):
             it = space.param_maps()
             maps = [next(it) for _ in range(self.number_of_runs)]
 
+        if self.search_mode == "asha":
+            return self._fit_asha(estimators, maps, train, val)
+
         higher, _ = _EVAL[self.evaluation_metric]
         jobs: List[Tuple[Any, Dict[str, Any]]] = [
             (est, pm) for est in estimators for pm in maps
@@ -200,24 +224,136 @@ class TuneHyperparameters(Estimator):
             cand = copy.deepcopy(est)
             for k, v in pm.items():
                 cand.set(k, v)
-            m = cand.fit(train)
-            metric = _evaluate(m, val, self.evaluation_metric, self.label_col)
+            # a failing candidate records metric=None instead of aborting
+            # the whole pool.map (reference behavior: the executor pool
+            # survives individual fit failures)
+            try:
+                m = cand.fit(train)
+                metric = _evaluate(m, val, self.evaluation_metric,
+                                   self.label_col)
+            except Exception:
+                return None, pm, None
             return m, pm, metric
 
         with ThreadPoolExecutor(max_workers=self.parallelism) as pool:
             results = list(pool.map(run, jobs))
-        best = max(results, key=lambda r: r[2] if higher else -r[2])
+        ok = [r for r in results if r[2] is not None]
+        if not ok:
+            raise RuntimeError(
+                f"TuneHyperparameters({self.uid}): all {len(results)} "
+                "candidate fits failed")
+        best = max(ok, key=lambda r: r[2] if higher else -r[2])
         model, params, metric = best
         return TuneHyperparametersModel(
             best_model=model, best_params=params, best_metric=float(metric),
-            history=[{"params": p, "metric": float(m)} for _, p, m in results])
+            history=[{"params": p,
+                      "metric": None if m is None else float(m)}
+                     for _, p, m in results])
+
+    def _fit_asha(self, estimators, maps, train: Table,
+                  val: Table) -> "TuneHyperparametersModel":
+        """ASHA study over ONE GBDT estimator: shared binning, rung
+        scheduling, journaled crash-resume; ``FindBestModel`` reuses the
+        raw validation table for the final selection."""
+        if len(estimators) != 1:
+            raise ValueError("search_mode='asha' tunes exactly one "
+                             f"estimator, got {len(estimators)}")
+        est = estimators[0]
+        if not hasattr(est, "_fit_booster"):
+            raise ValueError("search_mode='asha' requires a GBDT estimator "
+                             f"(got {type(est).__name__})")
+        metric = self.evaluation_metric
+        if metric not in ("auc", "rmse", "l1", "l2"):
+            raise ValueError("search_mode='asha' supports evaluation_metric "
+                             f"auc|rmse|l1|l2 (a per-iteration train metric "
+                             f"drives the rungs), got {metric!r}")
+        higher, kind = _EVAL[metric]
+
+        from ..gbdt.estimators import _features_matrix
+
+        x = _features_matrix(train, est.features_col, est.sparse_num_bits)
+        x_val = _features_matrix(val, est.features_col, est.sparse_num_bits)
+        y_raw = np.asarray(train[self.label_col])
+        yv_raw = np.asarray(val[self.label_col])
+        classes = None
+        if kind == "classification":
+            # map labels to indices ONCE for the whole study; the winning
+            # models get the original classes patched back below
+            classes, y = np.unique(y_raw, return_inverse=True)
+            lookup = {c: i for i, c in enumerate(classes.tolist())}
+            try:
+                y_val = np.asarray([lookup[c] for c in yv_raw.tolist()],
+                                   dtype=np.float64)
+            except KeyError as e:
+                raise ValueError(f"validation label {e} never appears in "
+                                 "the training split") from None
+            y = y.astype(np.float64)
+        else:
+            y = y_raw.astype(np.float64)
+            y_val = yv_raw.astype(np.float64)
+        weight = (np.asarray(train[est.weight_col], np.float64)
+                  if est.weight_col else None)
+
+        # the scheduler owns the iteration budget: num_iterations leaves
+        # the per-trial param maps and caps the rung ladder instead
+        maps = [dict(pm) for pm in maps]
+        ni = [int(pm.pop("num_iterations")) for pm in maps
+              if "num_iterations" in pm]
+        max_resource = max(ni) if ni else int(est.num_iterations)
+
+        from ..tuning.study import Study
+
+        study = Study(
+            est, maps, x, y, x_val, y_val,
+            metric=metric, mode="max" if higher else "min",
+            study_seed=self.seed, max_resource=max_resource,
+            min_resource=self.min_resource or None,
+            executor=self.executor, parallelism=self.parallelism,
+            budget=self.budget, journal_path=self.journal_path or None,
+            weight=weight)
+        result = study.run()
+
+        from ..core.serialization import load_stage
+
+        models, model_params = [], []
+        for row in result["leaderboard"]:
+            if row["state"] != "completed":
+                continue
+            path = result["models"].get(row["trial_id"])
+            if not path:
+                continue
+            m = load_stage(path)
+            if classes is not None:
+                m.set("labels", classes.astype(np.float64)
+                      if np.issubdtype(classes.dtype, np.number) else classes)
+            models.append(m)
+            model_params.append(row["params"])
+        if not models:
+            raise RuntimeError(
+                f"TuneHyperparameters({self.uid}): no trial completed "
+                f"(journal: {result['journal_path']})")
+        selector = FindBestModel(models=models,
+                                 evaluation_metric=self.evaluation_metric,
+                                 label_col=self.label_col)
+        best = selector.fit(val)
+        best_idx = next(i for i, m in enumerate(models)
+                        if m is best.best_model)
+        history = [{"params": row["params"], "metric": row["metric"],
+                    "state": row["state"], "iterations": row["iterations"]}
+                   for row in result["leaderboard"]]
+        return TuneHyperparametersModel(
+            best_model=best.best_model, best_params=model_params[best_idx],
+            best_metric=float(best.best_metric), history=history)
 
 
 class TuneHyperparametersModel(Model):
     best_model = ComplexParam("winning fitted model", object, default=None)
     best_params = ComplexParam("winning param map", object, default=None)
     best_metric = Param("winning validation metric", float, default=0.0)
-    history = ComplexParam("all (params, metric) evaluations", object, default=[])
+    # default None, not []: ComplexParam defaults live on the CLASS, so a
+    # mutable default would be shared by every instance
+    history = ComplexParam("all (params, metric) evaluations", object,
+                           default=None)
 
     def _transform(self, table: Table) -> Table:
         return self.best_model.transform(table)
@@ -247,7 +383,8 @@ class FindBestModel(Estimator):
 class BestModel(Model):
     best_model = ComplexParam("winning model", object, default=None)
     best_metric = Param("winning metric", float, default=0.0)
-    all_metrics = ComplexParam("metric per candidate", object, default=[])
+    # default None, not []: a class-level mutable default would be shared
+    all_metrics = ComplexParam("metric per candidate", object, default=None)
 
     def _transform(self, table: Table) -> Table:
         return self.best_model.transform(table)

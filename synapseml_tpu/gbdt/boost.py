@@ -2400,8 +2400,15 @@ def train(params: Dict[str, Any], x: np.ndarray, y: Optional[np.ndarray] = None,
             evals.append(rec)
             check_early_stop(it, rec)
         if callbacks:
+            # a truthy callback return requests a stop AFTER this iteration
+            # (the tuning scheduler's rung-demotion hook): the booster keeps
+            # every tree trained so far, exactly like early stopping
+            stop_requested = False
             for cb in callbacks:
-                cb({"iteration": it, "evals": evals[-1] if evals else None})
+                if cb({"iteration": it, "evals": evals[-1] if evals else None}):
+                    stop_requested = True
+            if stop_requested:
+                break
         if stopped_early:
             break
 

@@ -122,6 +122,38 @@ class GBDTDataset:
         self.bin_dtype = bin_dtype(self.mapper.n_bins)
         self._device = None
 
+    @classmethod
+    def from_binned(cls, binned, mapper: BinMapper, *, x, label=None,
+                    feature_names: Optional[List[str]] = None) -> "GBDTDataset":
+        """Rehydrate a host dataset from an already-binned matrix and its
+        fitted mapper — the tuning subsystem's shared-binning transport:
+        a study bins ONCE, ships ``(binned, mapper, raw x)`` to trial
+        workers (the arrays can arrive memory-mapped from the study's npz),
+        and every trial's ``train()`` takes the ``reuse_dataset`` path
+        instead of re-running the searchsorted pass. ``x`` stays required
+        because continued training replays the init booster's margins from
+        the RAW matrix.
+        """
+        ds = cls.__new__(cls)
+        ds.is_device = False
+        ds._label_in = label
+        ds._label_np = None
+        ds._label_d = None
+        ds.mapper = mapper
+        ds.max_bin = int(mapper.max_bin)
+        ds.feature_names = list(feature_names) if feature_names else None
+        ds.x = np.asarray(x, dtype=np.float64)
+        if ds.x.ndim != 2:
+            raise ValueError(f"x must be (n, d), got shape {ds.x.shape}")
+        binned = np.asarray(binned)
+        if binned.shape != ds.x.shape:
+            raise ValueError(f"binned shape {binned.shape} != raw x shape "
+                             f"{ds.x.shape}")
+        ds.binned_np = binned
+        ds.bin_dtype = bin_dtype(mapper.n_bins)
+        ds._device = None
+        return ds
+
     @property
     def label_np(self) -> Optional[np.ndarray]:
         """Host float64 label (pulled once and cached for device labels)."""

@@ -240,8 +240,32 @@ class _LightGBMBase(Estimator):
             return table.filter(~mask), table.filter(mask)
         return table, None
 
+    # Instance-level seam for the tuning subsystem (synapseml_tpu/tuning):
+    # a study sets ``est._tuning_overrides`` so every trial trains from ONE
+    # shared pre-binned GBDTDataset (binning happens once per study, not
+    # once per trial) under the scheduler's iteration budget and rung
+    # callbacks. Never set on user-facing estimators outside a study.
+    _tuning_overrides = None
+
+    def _fit_booster_tuned(self, table: Table, ov: dict,
+                           extra_params: Optional[dict] = None) -> GBDTBooster:
+        self._validate_input(table, self.label_col)
+        y = np.asarray(table[self.label_col], dtype=np.float64)
+        w = (np.asarray(table[self.weight_col], dtype=np.float64)
+             if self.weight_col else None)
+        params = self._train_params()
+        params.update(extra_params or {})
+        params.update(ov.get("params") or {})
+        return train(params, ov["dataset"], y=y, weight=w,
+                     eval_set=ov.get("eval_set"),
+                     init_booster=ov.get("init_booster"),
+                     callbacks=ov.get("callbacks"), mesh=self.mesh)
+
     def _fit_booster(self, table: Table, extra_params: Optional[dict] = None,
                      group=None, eval_group_from=None) -> GBDTBooster:
+        ov = self._tuning_overrides
+        if ov is not None:
+            return self._fit_booster_tuned(table, ov, extra_params)
         self._validate_input(table, self.features_col, self.label_col)
         tr, val = self._split_validation(table)
         x = _features_matrix(tr, self.features_col, self.sparse_num_bits)

@@ -1066,3 +1066,103 @@ def _if(inputs, attrs, ctx):
         "If with traced condition not supported (branches may differ in shape); "
         "most exported models have constant conditions after shape specialization"
     )
+
+
+# ---------------------------------------------------------------------------------
+# recurrent (LSTM / GRU)
+# ---------------------------------------------------------------------------------
+
+def _rnn_act(name: str) -> Callable:
+    try:
+        return {"Sigmoid": _lazy_fn("jax.nn.sigmoid"),
+                "Tanh": _lazy_fn("jnp.tanh"),
+                "Relu": _lazy_fn("jax.nn.relu")}[name]
+    except KeyError:
+        raise NotImplementedError(f"RNN activation {name!r}") from None
+
+
+def _rnn_common(op_type: str, inputs, attrs, n_gates: int):
+    """Shared LSTM/GRU front end: forward single-direction slices,
+    combined bias, initial hidden state, optional pre-activation clip."""
+    if attrs.get("layout", 0) != 0:
+        raise NotImplementedError(f"{op_type} layout=1")
+    direction = attrs.get("direction", "forward")
+    if direction != "forward":
+        raise NotImplementedError(f"{op_type} direction={direction!r}")
+    x, w, r = inputs[0], inputs[1], inputs[2]
+    seq_lens = inputs[4] if len(inputs) > 4 else None
+    if seq_lens is not None and not (
+            isinstance(seq_lens, np.ndarray) and np.all(seq_lens == x.shape[0])):
+        raise NotImplementedError(f"{op_type} with ragged sequence_lens")
+    hidden = int(r.shape[-1])
+    w2, r2 = jnp.asarray(w[0]), jnp.asarray(r[0])  # (n_gates*H, I), (n_gates*H, H)
+    b = inputs[3] if len(inputs) > 3 else None
+    if b is not None:
+        wb, rb = jnp.split(jnp.asarray(b[0]), 2)
+    else:
+        wb = rb = jnp.zeros((n_gates * hidden,), x.dtype)
+    init_h = inputs[5] if len(inputs) > 5 else None
+    h0 = (jnp.zeros((x.shape[1], hidden), x.dtype) if init_h is None
+          else jnp.asarray(init_h[0]))
+    clip = attrs.get("clip")
+    squash = ((lambda v: jnp.clip(v, -clip, clip)) if clip is not None
+              else (lambda v: v))
+    return x, w2, r2, wb, rb, h0, hidden, squash
+
+
+@op("LSTM")
+def _lstm(inputs, attrs, ctx):
+    """Single-layer forward LSTM via ``lax.scan``; gate order iofc, optional
+    peepholes, outputs ``Y (S,1,B,H)``, ``Y_h (1,B,H)``, ``Y_c (1,B,H)``."""
+    x, w2, r2, wb, rb, h0, hidden, squash = _rnn_common("LSTM", inputs, attrs, 4)
+    acts = attrs.get("activations") or ["Sigmoid", "Tanh", "Tanh"]
+    f, g, h_act = (_rnn_act(a) for a in acts[:3])
+    init_c = inputs[6] if len(inputs) > 6 else None
+    c0 = (jnp.zeros_like(h0) if init_c is None else jnp.asarray(init_c[0]))
+    p = inputs[7] if len(inputs) > 7 else None
+    if p is not None:
+        pi, po, pf = jnp.split(jnp.asarray(p[0]), 3)
+    else:
+        pi = po = pf = jnp.zeros((hidden,), x.dtype)
+    # the input projection has no step dependence: one batched matmul
+    # outside the scan, only the H-recurrence stays sequential
+    gx = jnp.matmul(x, w2.T) + wb + rb  # (S, B, 4H)
+
+    def step(carry, xt):
+        h, c = carry
+        zi, zo, zf, zc = jnp.split(xt + jnp.matmul(h, r2.T), 4, axis=-1)
+        i = f(squash(zi + pi * c))
+        ft = f(squash(zf + pf * c))
+        c_new = ft * c + i * g(squash(zc))
+        o = f(squash(zo + po * c_new))
+        return (o * h_act(c_new), c_new), o * h_act(c_new)
+
+    (h_t, c_t), ys = lax.scan(step, (h0, c0), gx)
+    return ys[:, None], h_t[None], c_t[None]
+
+
+@op("GRU")
+def _gru(inputs, attrs, ctx):
+    """Single-layer forward GRU via ``lax.scan``; gate order zrh, both
+    ``linear_before_reset`` modes, outputs ``Y (S,1,B,H)``, ``Y_h (1,B,H)``."""
+    x, w2, r2, wb, rb, h0, hidden, squash = _rnn_common("GRU", inputs, attrs, 3)
+    acts = attrs.get("activations") or ["Sigmoid", "Tanh"]
+    f, g = _rnn_act(acts[0]), _rnn_act(acts[1])
+    lbr = int(attrs.get("linear_before_reset", 0))
+    rz, rr, rh = jnp.split(r2, 3)
+    rbz, rbr, rbh = jnp.split(rb, 3)
+    gx = jnp.matmul(x, w2.T) + wb  # (S, B, 3H)
+
+    def step(h, xt):
+        xz, xr, xh = jnp.split(xt, 3, axis=-1)
+        z = f(squash(xz + jnp.matmul(h, rz.T) + rbz))
+        r = f(squash(xr + jnp.matmul(h, rr.T) + rbr))
+        if lbr:  # reset gate applied to the already-projected hidden state
+            hh = g(squash(xh + r * (jnp.matmul(h, rh.T) + rbh)))
+        else:
+            hh = g(squash(xh + jnp.matmul(r * h, rh.T) + rbh))
+        h_new = (1.0 - z) * hh + z * h
+        return h_new, h_new
+
+    h_t, ys = lax.scan(step, h0, gx)
+    return ys[:, None], h_t[None]

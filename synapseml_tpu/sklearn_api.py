@@ -434,13 +434,13 @@ class SkTrainRegressor(_SkBase):
 
 
 class SkTuneHyperparameters(_SkBase):
-    """Parallel random/grid search over estimator param spaces"""
+    """Parallel hyperparameter search over estimator param spaces"""
 
     _native_module = 'synapseml_tpu.automl.stages'
     _native_class = 'TuneHyperparameters'
     _label_col = 'label_col'
-    _param_names = ('evaluation_metric', 'label_col', 'number_of_runs', 'parallelism', 'search_mode', 'seed', 'train_ratio')
-    _param_defaults = {'evaluation_metric': 'auc', 'label_col': 'label', 'number_of_runs': 10, 'parallelism': 4, 'search_mode': 'random', 'seed': 0, 'train_ratio': 0.75}
+    _param_names = ('budget', 'evaluation_metric', 'executor', 'journal_path', 'label_col', 'min_resource', 'number_of_runs', 'parallelism', 'search_mode', 'seed', 'train_ratio')
+    _param_defaults = {'budget': 0, 'evaluation_metric': 'auc', 'executor': 'threads', 'journal_path': None, 'label_col': 'label', 'min_resource': 0, 'number_of_runs': 10, 'parallelism': 4, 'search_mode': 'random', 'seed': 0, 'train_ratio': 0.75}
 
 
 class SkValueIndexer(_SkBase):

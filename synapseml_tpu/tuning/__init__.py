@@ -1,0 +1,31 @@
+"""Distributed hyperparameter tuning: schedulers, executors, studies.
+
+The subsystem behind ``TuneHyperparameters(search_mode="asha")``:
+
+- :mod:`.scheduler` — synchronous successive halving + asynchronous ASHA
+  rung logic (pure decision engines, seeded tie-breaks);
+- :mod:`.executor` — trial segment runner and the two backends
+  (in-process threads, persistent worker subprocesses);
+- :mod:`.trial_worker` — the worker subprocess entry point;
+- :mod:`.journal` — append-only JSONL study journal (crash-resume) and
+  the leaderboard reduction shared with ``tools/tune_report.py``;
+- :mod:`.study` — the orchestrator tying them together.
+
+Jax-free at import (enforced by ``tests/test_import_hygiene.py``): jax
+enters only when a trial actually trains.
+"""
+
+from .executor import (ProcessExecutor, StudyContext, ThreadExecutor,
+                       TrialError, TrialTask, WorkerCrash,
+                       derive_trial_seed, run_trial_segment)
+from .journal import StudyJournal, leaderboard, read_journal, space_digest
+from .scheduler import AshaScheduler, SuccessiveHalving, rung_ladder
+from .study import Study
+
+__all__ = [
+    "AshaScheduler", "SuccessiveHalving", "rung_ladder",
+    "StudyJournal", "leaderboard", "read_journal", "space_digest",
+    "TrialTask", "StudyContext", "ThreadExecutor", "ProcessExecutor",
+    "WorkerCrash", "TrialError", "derive_trial_seed", "run_trial_segment",
+    "Study",
+]

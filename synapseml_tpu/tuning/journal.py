@@ -1,0 +1,142 @@
+"""Append-only study journal (JSONL) — the crash-resume record.
+
+One line per event; a study appends as it goes and re-reading the file
+reconstructs everything: trial specs, rung results, terminal states.
+Event vocabulary (``"event"`` field):
+
+- ``study``    — header: seed, trial count, rung ladder, metric, a digest
+  of the search space. Resume refuses to continue a journal whose header
+  does not match the re-run's configuration.
+- ``trial``    — one per trial: ``trial_id``, sampled ``params``, derived
+  ``seed``.
+- ``rung``     — a metric landing at a rung: ``trial_id``, ``rung``
+  (index), ``iters`` (cumulative), ``metric``, the scheduler
+  ``decision`` and wall ``t_s`` since the trial's previous rung.
+- ``promote``  — a side promotion (a paused trial resumed by a later
+  arrival's report).
+- ``terminal`` — a trial reaching ``completed`` / ``stopped`` /
+  ``failed``, with final metric, iterations, and the saved model path.
+- ``study_end`` — best trial/metric and total boosting iterations spent.
+
+Everything here is stdlib-only (the import-hygiene gate covers
+``synapseml_tpu.tuning``); ``tools/tune_report.py`` parses the same
+format without importing this package at all.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+__all__ = ["StudyJournal", "read_journal", "space_digest", "leaderboard"]
+
+
+def space_digest(param_maps: List[Dict[str, Any]]) -> str:
+    """Stable digest of the sampled search space — the resume guard: a
+    journal replays only into a study with the same trials."""
+    blob = json.dumps(param_maps, sort_keys=True, default=str)
+    return hashlib.sha256(blob.encode()).hexdigest()[:16]
+
+
+class StudyJournal:
+    """Append-only JSONL writer; one line per event, flushed per append so
+    a crash loses at most the in-flight line."""
+
+    def __init__(self, path: str):
+        self.path = path
+        d = os.path.dirname(os.path.abspath(path))
+        os.makedirs(d, exist_ok=True)
+        self._f = open(path, "a", encoding="utf-8")
+        self._lock = threading.Lock()
+
+    def append(self, event: Dict[str, Any]) -> None:
+        line = json.dumps(dict(event, ts=time.time()), sort_keys=True,
+                          default=str)
+        with self._lock:
+            self._f.write(line + "\n")
+            self._f.flush()
+
+    def close(self) -> None:
+        with self._lock:
+            if not self._f.closed:
+                self._f.close()
+
+    def __enter__(self) -> "StudyJournal":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.close()
+        return False
+
+
+def read_journal(path: str) -> List[Dict[str, Any]]:
+    """Parse a journal; a truncated/garbled tail line (the crash case this
+    format exists for) is skipped, not fatal."""
+    events: List[Dict[str, Any]] = []
+    if not os.path.exists(path):
+        return events
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                ev = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(ev, dict) and "event" in ev:
+                events.append(ev)
+    return events
+
+
+def leaderboard(events: List[Dict[str, Any]],
+                mode: str = "max") -> List[Dict[str, Any]]:
+    """Per-trial summary rows sorted best-first (the canonical leaderboard
+    both the study result and ``tools/tune_report.py`` print).
+
+    Later events win: a re-run trial's fresh rungs/terminal replace its
+    pre-crash partials. Rows are plain JSON-able dicts so "bit-identical
+    across resume" is assertable as string equality of the dump.
+    """
+    trials: Dict[int, Dict[str, Any]] = {}
+    for ev in events:
+        kind = ev.get("event")
+        if kind == "trial":
+            t = int(ev["trial_id"])
+            trials[t] = {"trial_id": t, "params": ev.get("params") or {},
+                         "state": "pending", "iterations": 0, "metric": None,
+                         "_rungs": {}}
+        elif kind == "rung" and int(ev.get("trial_id", -1)) in trials:
+            row = trials[int(ev["trial_id"])]
+            # keyed by iters: a resumed trial re-journals its early rungs,
+            # and the re-run's values must REPLACE the pre-crash ones (not
+            # duplicate them) for the leaderboard to be resume-stable
+            row["_rungs"][int(ev.get("iters", 0))] = {
+                "rung": ev.get("rung"), "iters": ev.get("iters"),
+                "metric": ev.get("metric")}
+            row["iterations"] = max(row["iterations"], int(ev.get("iters", 0)))
+            if ev.get("metric") is not None:
+                row["metric"] = ev["metric"]
+        elif kind == "terminal" and int(ev.get("trial_id", -1)) in trials:
+            row = trials[int(ev["trial_id"])]
+            row["state"] = ev.get("state", "completed")
+            if ev.get("metric") is not None:
+                row["metric"] = ev["metric"]
+            if ev.get("iterations") is not None:
+                row["iterations"] = int(ev["iterations"])
+
+    for row in trials.values():
+        by_iters = row.pop("_rungs")
+        row["rungs"] = [by_iters[k] for k in sorted(by_iters)]
+
+    def _key(row: Dict[str, Any]):
+        m = row["metric"]
+        bad = m is None
+        s = 0.0 if bad else (float(m) if mode == "max" else -float(m))
+        return (bad, -s, row["trial_id"])
+
+    return sorted(trials.values(), key=_key)

@@ -1,0 +1,191 @@
+"""Successive-halving schedulers for hyperparameter studies.
+
+Reference: Li et al., "Hyperband: a novel bandit-based approach to
+hyperparameter optimization" (the synchronous successive-halving rung
+ladder) and Li et al., "A System for Massively Parallel Hyperparameter
+Tuning" (ASHA — the asynchronous variant this module's default mirrors).
+
+Both schedulers are pure decision engines: no clocks, no threads, no jax.
+The resource unit is **boosting iterations** (the GBDT trainer's natural
+budget); a *rung* is a cumulative iteration count at which a trial reports
+its validation metric and the scheduler decides promote-or-stop.
+
+- :class:`SuccessiveHalving` — the synchronous ladder: every surviving
+  trial trains to the rung target, then the top ``1/eta`` (never fewer
+  than one) continue to the next rung. Decisions need the WHOLE rung, so
+  the caller runs rung-synchronized waves.
+- :class:`AshaScheduler` — asynchronous: a trial is promoted from rung
+  ``k`` as soon as its metric sits in the top ``1/eta`` of the results
+  that have landed at ``k`` and at least ``quorum`` (default ``eta``)
+  results are in. A report may also make an *earlier* reporter promotable
+  ("promote as soon as quorum lands"); those side promotions are returned
+  so the executor can resume paused trials.
+
+Ties break deterministically on a seeded per-trial hash so two runs of
+the same study (same seed, same arrival order) make identical decisions.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import math
+from typing import Dict, List, Optional, Sequence
+
+__all__ = ["rung_ladder", "SuccessiveHalving", "AshaScheduler"]
+
+
+def rung_ladder(max_resource: int, min_resource: Optional[int] = None,
+                eta: int = 3) -> List[int]:
+    """Cumulative-iteration rung targets ``[r0, r0*eta, ..., R]``.
+
+    ``min_resource`` defaults to ``max(1, R // eta**2)`` — a three-rung
+    ladder for typical budgets. The top rung is always exactly ``R``.
+    """
+    if max_resource < 1:
+        raise ValueError(f"max_resource must be >= 1, got {max_resource}")
+    if eta < 2:
+        raise ValueError(f"eta must be >= 2, got {eta}")
+    r = int(min_resource) if min_resource else max(1, max_resource // (eta * eta))
+    if not 1 <= r <= max_resource:
+        raise ValueError(f"min_resource must be in [1, {max_resource}], got {r}")
+    rungs = []
+    while r < max_resource:
+        rungs.append(r)
+        r *= eta
+    rungs.append(int(max_resource))
+    return rungs
+
+
+class SuccessiveHalving:
+    """Synchronous successive halving over a rung ladder.
+
+    The study runs waves: every surviving trial trains to
+    ``rungs[k]`` iterations, ``tell`` records the metrics, and
+    :meth:`select` names the survivors for rung ``k + 1``.
+    """
+
+    sync = True
+
+    def __init__(self, max_resource: int, min_resource: Optional[int] = None,
+                 eta: int = 3, seed: int = 0, mode: str = "max"):
+        if mode not in ("max", "min"):
+            raise ValueError(f"mode must be max|min, got {mode!r}")
+        self.eta = int(eta)
+        self.seed = int(seed)
+        self.mode = mode
+        self.rungs = rung_ladder(max_resource, min_resource, eta)
+        # rung index -> {trial_id: metric}; metric None = trial produced no
+        # usable result (a failed trial), which ranks below every number
+        self.results: List[Dict[int, Optional[float]]] = [
+            {} for _ in self.rungs]
+        self.failed: set = set()
+
+    # -- deterministic ordering -------------------------------------------
+
+    def _tie(self, trial_id: int) -> int:
+        h = hashlib.sha256(f"{self.seed}:{trial_id}".encode()).hexdigest()
+        return int(h[:16], 16)
+
+    def _score(self, metric: Optional[float]) -> float:
+        if metric is None or not math.isfinite(metric):
+            return -math.inf
+        return float(metric) if self.mode == "max" else -float(metric)
+
+    def _ranked(self, rung: int) -> List[int]:
+        res = self.results[rung]
+        return sorted(res, key=lambda t: (-self._score(res[t]), self._tie(t)))
+
+    # -- recording ---------------------------------------------------------
+
+    def rung_index(self, iterations: int) -> Optional[int]:
+        """The rung index whose target is ``iterations`` (None = not a rung)."""
+        try:
+            return self.rungs.index(int(iterations))
+        except ValueError:
+            return None
+
+    def tell(self, trial_id: int, rung: int, metric: Optional[float]) -> None:
+        self.results[rung][int(trial_id)] = metric
+
+    def mark_failed(self, trial_id: int) -> None:
+        """A failed trial keeps its landed metrics (they already shaped the
+        rung statistics) but can never be promoted."""
+        self.failed.add(int(trial_id))
+
+    def select(self, rung: int) -> List[int]:
+        """Survivors of a COMPLETE rung: the top ``n // eta`` (at least
+        one) of the reported trials, seeded tie-break, failures excluded."""
+        if rung >= len(self.rungs) - 1:
+            return []
+        keep = max(1, len(self.results[rung]) // self.eta)
+        out = [t for t in self._ranked(rung) if t not in self.failed]
+        return out[:keep]
+
+
+class AshaScheduler(SuccessiveHalving):
+    """Asynchronous successive halving (ASHA).
+
+    :meth:`report` is the single entry: it records the metric and answers
+    the reporting trial's own fate plus any *side promotions* its arrival
+    unlocked for previously-paused trials.
+    """
+
+    sync = False
+
+    def __init__(self, max_resource: int, min_resource: Optional[int] = None,
+                 eta: int = 3, seed: int = 0, mode: str = "max",
+                 quorum: Optional[int] = None):
+        super().__init__(max_resource, min_resource, eta, seed, mode)
+        self.quorum = int(quorum) if quorum else self.eta
+        # per-rung set of trials already promoted out of that rung
+        self.promoted: List[set] = [set() for _ in self.rungs]
+
+    def _promotable(self, rung: int) -> List[int]:
+        res = self.results[rung]
+        if len(res) < self.quorum:
+            return []
+        allowed = len(res) // self.eta
+        if allowed <= 0:
+            return []
+        top = self._ranked(rung)[:allowed]
+        return [t for t in top
+                if t not in self.promoted[rung] and t not in self.failed]
+
+    def report(self, trial_id: int, rung: int,
+               metric: Optional[float]) -> Dict[str, object]:
+        """Record ``metric`` for ``trial_id`` at rung index ``rung``.
+
+        Returns ``{"decision", "promotions"}`` where ``decision`` is
+
+        - ``"final"``  — the top rung: the trial is done;
+        - ``"promote"`` — the trial is in the top ``1/eta`` with quorum
+          landed: keep training toward the next rung;
+        - ``"stop"``   — pause/demote at this rung budget (it may still be
+          promoted later by a subsequent report's side promotions).
+
+        ``promotions`` lists OTHER trials this report made promotable —
+        paused trials the executor should resume.
+        """
+        trial_id = int(trial_id)
+        self.tell(trial_id, rung, metric)
+        if rung >= len(self.rungs) - 1:
+            return {"decision": "final", "promotions": []}
+        promos = self._promotable(rung)
+        for t in promos:
+            self.promoted[rung].add(t)
+        # membership in the promoted set (not the fresh promos list) keeps a
+        # re-reported rung idempotent: a resumed/retried trial that was
+        # already promoted out of this rung stays promoted
+        decision = ("promote" if trial_id in self.promoted[rung]
+                    and trial_id not in self.failed else "stop")
+        return {"decision": decision,
+                "promotions": [t for t in promos if t != trial_id]}
+
+    def replay(self, records: Sequence[Dict[str, object]]) -> None:
+        """Re-feed journaled ``(trial_id, rung, metric)`` rung records in
+        their original order so a resumed study's decisions stay
+        consistent with what already ran."""
+        for r in records:
+            ri = self.rung_index(int(r["iters"]))  # type: ignore[arg-type]
+            if ri is not None:
+                self.report(int(r["trial_id"]), ri, r.get("metric"))  # type: ignore[arg-type]

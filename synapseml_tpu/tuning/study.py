@@ -1,0 +1,566 @@
+"""Study orchestration: ASHA-scheduled, journaled, fault-tolerant trials.
+
+A :class:`Study` owns one hyperparameter search end to end:
+
+- **shared work** — the dataset is binned ONCE (one ``GBDTDataset``
+  outside the trial loop); process workers mmap the same binned matrix
+  from the study directory instead of re-binning per trial;
+- **scheduling** — trials run through :class:`~.scheduler.AshaScheduler`;
+  the rung callback inside the GBDT training loop reports at each rung
+  boundary and stops demoted trials at their rung budget. A paused trial
+  promoted later resumes FROM ITS SAVED MODEL (a ``core.serialization``
+  round-trip) rather than retraining from scratch;
+- **fault tolerance** — a crashed/wedged/erroring segment is retried
+  once, then the trial is recorded ``failed`` and the study keeps going;
+- **crash-resume** — every decision lands in the append-only JSONL
+  journal; re-running the same study replays journaled trials (failed
+  ones included — they are NOT retried on resume, so the outcome is
+  reproducible) and executes only the remainder;
+- **observability** — per-trial spans, ``smt_tuning_*`` metric families,
+  and telemetry events on promote/demote/failure.
+
+Determinism: trial seeds derive from ``(study_seed, trial_id)``, scheduler
+ties break on a seeded hash, and the leaderboard is a pure function of the
+journal — the properties the resume and golden tests assert.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import queue
+import tempfile
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from .executor import (ProcessExecutor, StudyContext, ThreadExecutor,
+                       TrialError, TrialTask, WorkerCrash, derive_trial_seed)
+from .journal import StudyJournal, leaderboard, read_journal, space_digest
+from .scheduler import AshaScheduler
+
+__all__ = ["Study"]
+
+
+class Study:
+    """One scheduled hyperparameter search over a fixed trial list.
+
+    ``template`` is a GBDT estimator (its params are the per-trial
+    defaults); ``param_maps[i]`` is trial ``i``'s override dict. ``y`` and
+    ``y_val`` must already be numeric (the automl stage maps classifier
+    labels to indices before building the study and patches them back on
+    the winning models).
+    """
+
+    def __init__(self, template, param_maps: List[Dict[str, Any]],
+                 x, y, x_val, y_val, *,
+                 metric: str = "auc", mode: str = "max",
+                 study_seed: int = 0, eta: int = 3,
+                 min_resource: Optional[int] = None,
+                 max_resource: Optional[int] = None,
+                 quorum: Optional[int] = None,
+                 executor: str = "threads", parallelism: int = 2,
+                 budget: int = 0, journal_path: Optional[str] = None,
+                 workdir: Optional[str] = None, weight=None,
+                 feature_names: Optional[List[str]] = None,
+                 clock: Optional[Callable[[], float]] = None,
+                 task_timeout_s: float = 300.0,
+                 worker_env: Optional[Dict[str, str]] = None):
+        import numpy as np
+
+        if executor not in ("threads", "processes"):
+            raise ValueError(f"executor must be threads|processes, "
+                             f"got {executor!r}")
+        self.template = template
+        self.param_maps = [dict(pm) for pm in param_maps]
+        self.x = np.asarray(x, dtype=np.float64)
+        self.y = np.asarray(y, dtype=np.float64)
+        self.x_val = np.asarray(x_val, dtype=np.float64)
+        self.y_val = np.asarray(y_val, dtype=np.float64)
+        self.weight = None if weight is None else np.asarray(
+            weight, dtype=np.float64)
+        self.metric = metric
+        self.mode = mode
+        self.study_seed = int(study_seed)
+        self.executor_kind = executor
+        self.parallelism = max(1, int(parallelism))
+        self.budget = int(budget or 0)
+        self.feature_names = feature_names
+        self.clock = clock or time.monotonic
+        self.task_timeout_s = float(task_timeout_s)
+        self.worker_env = dict(worker_env or {})
+        self.workdir = workdir or tempfile.mkdtemp(prefix="smt_study_")
+        os.makedirs(self.workdir, exist_ok=True)
+        self.journal_path = journal_path or os.path.join(
+            self.workdir, "journal.jsonl")
+        R = int(max_resource or template.num_iterations)
+        self.scheduler = AshaScheduler(
+            R, min_resource, eta, seed=self.study_seed, mode=mode,
+            quorum=quorum)
+        self.R = self.scheduler.rungs[-1]
+
+        self._lock = threading.RLock()
+        self._q: "queue.Queue[Optional[TrialTask]]" = queue.Queue()
+        self._done = threading.Event()
+        self._open = 0              # enqueued-but-unfinished tasks
+        self._spent = 0             # total boosting iterations (budget)
+        self._iters_done: Dict[int, int] = {}
+        self._paused: Dict[int, tuple] = {}    # tid -> (iters, model_path)
+        self._pending_promos: set = set()      # promoted before pause landed
+        self._terminal: Dict[int, str] = {}    # tid -> state
+        self._model_paths: Dict[int, str] = {}
+        self._best: Optional[float] = None
+        self._worker_stats: List[Dict[str, Any]] = []
+
+        reg = self._registry()
+        self._m_trials = reg.counter(
+            "smt_tuning_trials_total", "trials reaching a terminal state",
+            ("state",))
+        self._m_best = reg.gauge(
+            "smt_tuning_best_metric", "best validation metric so far")
+        self._m_rung_s = reg.histogram(
+            "smt_tuning_rung_seconds", "wall seconds a trial spent training "
+            "to a rung boundary", ("rung",))
+
+    @staticmethod
+    def _registry():
+        from ..observability.metrics import get_registry
+
+        return get_registry()
+
+    def _log_event(self, method: str, **extra) -> None:
+        from ..core.telemetry import log_event
+
+        log_event(method, className="TuningStudy",
+                  uid=f"study-{self.study_seed}", **extra)
+
+    # -- study directory ----------------------------------------------------
+
+    def _prepare_dirs(self) -> None:
+        import numpy as np
+
+        self.model_dir = os.path.join(self.workdir, "models")
+        os.makedirs(self.model_dir, exist_ok=True)
+        from ..gbdt.dataset import GBDTDataset
+
+        t = self.template
+        self.dataset = GBDTDataset(
+            self.x, label=self.y, max_bin=int(t.max_bin),
+            seed=int(t.seed), bin_sample_count=int(t.bin_sample_count),
+            max_bin_by_feature=list(t.max_bin_by_feature) or None,
+            categorical_features=list(t.categorical_slot_indexes) or None,
+            feature_names=self.feature_names)
+        if self.executor_kind != "processes":
+            return
+        # ship the shared study state to worker processes: raw + binned
+        # matrices as mmap-able .npy, the fitted mapper as JSON, and the
+        # estimator template as a serialized stage
+        np.save(os.path.join(self.workdir, "x.npy"), self.x)
+        np.save(os.path.join(self.workdir, "binned.npy"),
+                self.dataset.binned_np)
+        np.save(os.path.join(self.workdir, "y.npy"), self.y)
+        np.save(os.path.join(self.workdir, "x_val.npy"), self.x_val)
+        np.save(os.path.join(self.workdir, "y_val.npy"), self.y_val)
+        if self.weight is not None:
+            np.save(os.path.join(self.workdir, "w.npy"), self.weight)
+        with open(os.path.join(self.workdir, "mapper.json"), "w",
+                  encoding="utf-8") as f:
+            json.dump(self.dataset.mapper.to_dict(), f)
+        from ..core.serialization import save_stage
+
+        save_stage(self.template, os.path.join(self.workdir, "template"))
+        meta = {"metric": self.metric, "rungs": self.scheduler.rungs,
+                "label_col": self.template.label_col,
+                "features_col": self.template.features_col,
+                "weight_col": self.template.weight_col or None,
+                "feature_names": self.feature_names,
+                "model_dir": self.model_dir}
+        with open(os.path.join(self.workdir, "meta.json"), "w",
+                  encoding="utf-8") as f:
+            json.dump(meta, f)
+
+    def _build_train_table(self):
+        import numpy as np
+
+        from ..core.table import Table
+
+        cols: Dict[str, Any] = {
+            self.template.features_col: np.zeros((len(self.y), 1),
+                                                 np.float32),
+            self.template.label_col: self.y,
+        }
+        if self.template.weight_col:
+            if self.weight is None:
+                raise ValueError(f"template sets weight_col="
+                                 f"{self.template.weight_col!r} but the "
+                                 "study got no weight array")
+            cols[self.template.weight_col] = self.weight
+        return Table(cols)
+
+    # -- resume -------------------------------------------------------------
+
+    def _load_prior(self) -> List[Dict[str, Any]]:
+        """Validate + replay an existing journal; returns its events."""
+        events = read_journal(self.journal_path)
+        if not events:
+            return events
+        digest = space_digest(self.param_maps)
+        header = next((e for e in events if e.get("event") == "study"), None)
+        if header is not None:
+            for k, want in (("digest", digest),
+                            ("study_seed", self.study_seed),
+                            ("rungs", self.scheduler.rungs),
+                            ("metric", self.metric)):
+                if header.get(k) != want:
+                    raise ValueError(
+                        f"journal {self.journal_path} is from a different "
+                        f"study: {k}={header.get(k)!r} vs {want!r}")
+        rung_events = [e for e in events if e.get("event") == "rung"]
+        self.scheduler.replay(rung_events)
+        with self._lock:  # resume runs single-threaded; lock for discipline
+            for e in rung_events:
+                tid, iters = int(e["trial_id"]), int(e.get("iters", 0))
+                prev = self._iters_done.get(tid, 0)
+                if iters > prev:
+                    self._spent += iters - prev
+                    self._iters_done[tid] = iters
+            for e in events:
+                if e.get("event") != "terminal":
+                    continue
+                tid = int(e["trial_id"])
+                state = e.get("state", "completed")
+                self._terminal[tid] = state
+                if state == "failed":
+                    self.scheduler.mark_failed(tid)
+                if e.get("model_path"):
+                    self._model_paths[tid] = e["model_path"]
+                iters = int(e.get("iterations") or 0)
+                prev = self._iters_done.get(tid, 0)
+                if iters > prev:
+                    self._spent += iters - prev
+                    self._iters_done[tid] = iters
+                if e.get("metric") is not None:
+                    self._update_best(float(e["metric"]))
+        return events
+
+    # -- accounting ---------------------------------------------------------
+
+    def _account_iters(self, trial_id: int, iters: int) -> None:
+        with self._lock:  # re-entrant: _on_rung already holds it
+            prev = self._iters_done.get(trial_id, 0)
+            if iters > prev:
+                self._spent += iters - prev
+                self._iters_done[trial_id] = iters
+
+    def _budget_exhausted(self) -> bool:
+        return bool(self.budget) and self._spent >= self.budget
+
+    def _update_best(self, metric: Optional[float]) -> None:
+        if metric is None:
+            return
+        better = (self._best is None
+                  or (metric > self._best if self.mode == "max"
+                      else metric < self._best))
+        if better:
+            self._best = float(metric)
+            self._m_best.labels().set(self._best)
+
+    # -- scheduling callbacks ------------------------------------------------
+
+    def _on_rung(self, trial_id: int, iters: int, metric: Optional[float],
+                 t_s: float) -> str:
+        with self._lock:
+            ri = self.scheduler.rung_index(iters)
+            self._account_iters(trial_id, iters)
+            if ri is None:
+                return "cont"
+            out = self.scheduler.report(trial_id, ri, metric)
+            decision = str(out["decision"])
+            if decision == "promote" and self._budget_exhausted():
+                decision = "stop"
+            self._m_rung_s.labels(str(ri)).observe(max(0.0, float(t_s)))
+            self.journal.append({"event": "rung", "trial_id": trial_id,
+                                 "rung": ri, "iters": iters,
+                                 "metric": metric, "decision": decision,
+                                 "t_s": t_s})
+            self._update_best(metric)
+            self._log_event("promote" if decision == "promote" else "demote",
+                            trial_id=trial_id, rung=ri, metric=metric)
+            for p in out["promotions"]:
+                self._promote(int(p))
+            return decision
+
+    def _promote(self, trial_id: int) -> None:
+        with self._lock:  # re-entrant: callers already hold it
+            if self._budget_exhausted() or trial_id in self._terminal:
+                return
+            if trial_id not in self._paused:
+                # its segment is still unwinding; resume once the pause
+                # lands
+                self._pending_promos.add(trial_id)
+                return
+            iters, path = self._paused.pop(trial_id)
+            self.journal.append({"event": "promote", "trial_id": trial_id,
+                                 "iters": iters})
+            self._log_event("promote", trial_id=trial_id, iters=iters)
+            task = TrialTask(trial_id, self.param_maps[trial_id],
+                             derive_trial_seed(self.study_seed, trial_id),
+                             from_iter=iters, to_iter=self.R,
+                             init_model_path=path)
+            self._open += 1
+            self._q.put(task)
+
+    # -- task lifecycle ------------------------------------------------------
+
+    def _record_terminal(self, trial_id: int, state: str,
+                         metric: Optional[float], iterations: int,
+                         model_path: Optional[str] = None,
+                         error: Optional[str] = None) -> None:
+        with self._lock:  # re-entrant: callers already hold it
+            self._terminal[trial_id] = state
+            if model_path:
+                self._model_paths[trial_id] = model_path
+            ev = {"event": "terminal", "trial_id": trial_id, "state": state,
+                  "metric": metric, "iterations": iterations,
+                  "model_path": model_path}
+            if error:
+                ev["error"] = error
+            self.journal.append(ev)
+            self._m_trials.labels(state).inc()
+            self._update_best(metric)
+
+    def _trace_trial(self, trial_id: int, iters: int, t_s: float,
+                     error: Optional[BaseException] = None) -> None:
+        from ..observability import tracing
+
+        tp = tracing.current_span()
+        if tp is not None:
+            ri = self.scheduler.rung_index(iters)
+            tp.tracer.record(f"tuning.trial[{trial_id}]", parent=tp,
+                             duration_s=max(0.0, float(t_s)),
+                             attributes={"trial_id": trial_id,
+                                         "rung": ri, "iters": iters},
+                             error=error)
+
+    def _handle_result(self, task: TrialTask, res: Dict[str, Any]) -> None:
+        stats = res.get("stats")
+        with self._lock:
+            if stats:
+                self._worker_stats.append(
+                    dict(stats, trial_id=task.trial_id))
+            iters = int(res["iterations"])
+            metric = res.get("metric")
+            self._account_iters(task.trial_id, iters)
+            if res.get("stopped"):
+                self._paused[task.trial_id] = (iters, res.get("model_path"))
+                if task.trial_id in self._pending_promos:
+                    self._pending_promos.discard(task.trial_id)
+                    self._promote(task.trial_id)
+                return
+            # ran to its segment end: the top rung means completed
+            ri = self.scheduler.rung_index(iters)
+            if ri is not None:
+                out = self.scheduler.report(task.trial_id, ri, metric)
+                self._m_rung_s.labels(str(ri)).observe(
+                    max(0.0, float(res.get("t_s", 0.0))))
+                self.journal.append({"event": "rung",
+                                     "trial_id": task.trial_id, "rung": ri,
+                                     "iters": iters, "metric": metric,
+                                     "decision": out["decision"],
+                                     "t_s": res.get("t_s", 0.0)})
+                for p in out["promotions"]:
+                    self._promote(int(p))
+            self._record_terminal(task.trial_id, "completed", metric, iters,
+                                  res.get("model_path"))
+            self._log_event("trial_completed", trial_id=task.trial_id,
+                            metric=metric, iterations=iters)
+
+    def _handle_failure(self, task: TrialTask, err: Exception) -> None:
+        with self._lock:
+            if task.attempt == 0:
+                self._log_event("trial_retry", trial_id=task.trial_id,
+                                error=str(err))
+                retry = TrialTask(task.trial_id, task.params, task.seed,
+                                  task.from_iter, task.to_iter,
+                                  task.init_model_path, attempt=1)
+                self._open += 1
+                self._q.put(retry)
+                return
+            self.scheduler.mark_failed(task.trial_id)
+            self._paused.pop(task.trial_id, None)
+            self._pending_promos.discard(task.trial_id)
+            last = self.scheduler.rung_index(
+                self._iters_done.get(task.trial_id, 0))
+            metric = None
+            for rung in self.scheduler.results:
+                if task.trial_id in rung and rung[task.trial_id] is not None:
+                    metric = rung[task.trial_id]
+            self._record_terminal(
+                task.trial_id, "failed", metric,
+                self._iters_done.get(task.trial_id, 0), error=str(err))
+            self._log_event("trial_failed", trial_id=task.trial_id,
+                            rung=last, error=str(err))
+
+    def _run_task(self, task: TrialTask) -> None:
+        from ..observability.spans import span
+
+        t0 = self.clock()
+        try:
+            with span("TuningStudy", "trial"):
+                res = self.backend.run(task, self._on_rung)
+        except (WorkerCrash, TrialError) as e:
+            self._trace_trial(task.trial_id,
+                              self._iters_done.get(task.trial_id, 0),
+                              self.clock() - t0, error=e)
+            self._handle_failure(task, e)
+            return
+        except Exception as e:  # estimator/table bugs land here: same
+            # retry-once-then-failed policy as injected faults
+            self._trace_trial(task.trial_id,
+                              self._iters_done.get(task.trial_id, 0),
+                              self.clock() - t0, error=e)
+            self._handle_failure(task, TrialError(f"{type(e).__name__}: {e}"))
+            return
+        self._trace_trial(task.trial_id, int(res["iterations"]),
+                          self.clock() - t0)
+        self._handle_result(task, res)
+
+    def _wind_down(self) -> bool:
+        """Closed-study promotions. ASHA's quorum exists because more
+        arrivals are always coming; once the queue drains, no rung will
+        ever grow again, so the remaining survivors are decided by the
+        synchronous rule (top ``max(1, n // eta)`` per rung — never fewer
+        than one, exactly :meth:`SuccessiveHalving.select`). Returns True
+        when a paused trial was resumed (another drain round runs)."""
+        enqueued = False
+        with self._lock:
+            if self._budget_exhausted():
+                return False
+            for ri in range(len(self.scheduler.rungs) - 1):
+                for tid in self.scheduler.select(ri):
+                    if (tid in self.scheduler.promoted[ri]
+                            or tid in self._terminal
+                            or tid not in self._paused):
+                        continue
+                    self.scheduler.promoted[ri].add(tid)
+                    self._promote(tid)
+                    enqueued = True
+        return enqueued
+
+    def _slot_loop(self) -> None:
+        while not self._done.is_set():
+            try:
+                task = self._q.get(timeout=0.2)
+            except queue.Empty:
+                continue
+            try:
+                self._run_task(task)
+            finally:
+                with self._lock:
+                    self._open -= 1
+                    if self._open <= 0:
+                        self._done.set()
+
+    # -- main entry ----------------------------------------------------------
+
+    def run(self) -> Dict[str, Any]:
+        self._prepare_dirs()
+        self.train_table = self._build_train_table()
+        ctx = StudyContext(self.template, self.dataset, self.train_table,
+                           [(self.x_val, self.y_val)], self.metric,
+                           self.scheduler.rungs, self.model_dir,
+                           clock=self.clock)
+        if self.executor_kind == "processes":
+            self.backend = ProcessExecutor(
+                self.workdir, task_timeout_s=self.task_timeout_s,
+                env=self.worker_env)
+        else:
+            self.backend = ThreadExecutor(ctx)
+
+        with self._lock:  # no slot threads yet; lock for write discipline
+            self.journal = StudyJournal(self.journal_path)
+        prior = self._load_prior()
+        if not any(e.get("event") == "study" for e in prior):
+            with self._lock:
+                self.journal.append({
+                    "event": "study", "study_seed": self.study_seed,
+                    "n_trials": len(self.param_maps),
+                    "eta": self.scheduler.eta, "rungs": self.scheduler.rungs,
+                    "metric": self.metric, "mode": self.mode,
+                    "digest": space_digest(self.param_maps)})
+        journaled = {int(e["trial_id"]) for e in prior
+                     if e.get("event") == "trial"}
+        self._log_event("study_start", n_trials=len(self.param_maps),
+                        executor=self.executor_kind,
+                        resumed=len(self._terminal))
+
+        tasks: List[TrialTask] = []
+        for tid, pm in enumerate(self.param_maps):
+            if tid not in journaled:
+                with self._lock:
+                    self.journal.append({
+                        "event": "trial", "trial_id": tid, "params": pm,
+                        "seed": derive_trial_seed(self.study_seed, tid)})
+            if tid in self._terminal:
+                continue  # replayed from the journal, never re-run
+            if self._budget_exhausted():
+                self._record_terminal(tid, "stopped", None, 0)
+                continue
+            tasks.append(TrialTask(
+                tid, pm, derive_trial_seed(self.study_seed, tid),
+                from_iter=0, to_iter=self.R))
+        try:
+            with self._lock:
+                self._open = len(tasks)
+            for t in tasks:
+                self._q.put(t)
+            while True:
+                with self._lock:
+                    have_work = self._open > 0
+                if have_work:
+                    self._done.clear()
+                    threads = [threading.Thread(target=self._slot_loop,
+                                                daemon=True,
+                                                name=f"tuning-slot-{i}")
+                               for i in range(self.parallelism)]
+                    for t in threads:
+                        t.start()
+                    while not self._done.wait(timeout=0.5):
+                        pass
+                    for t in threads:
+                        t.join(timeout=30)
+                if not self._wind_down():
+                    break
+            # trials still paused when the work dries up were demoted for
+            # good: journal their terminal state
+            with self._lock:
+                for tid in sorted(self._paused):
+                    iters, path = self._paused[tid]
+                    metric = None
+                    for rung in self.scheduler.results:
+                        if tid in rung and rung[tid] is not None:
+                            metric = rung[tid]
+                    self._record_terminal(tid, "stopped", metric, iters, path)
+                self._paused.clear()
+            events = read_journal(self.journal_path)
+            rows = leaderboard(events, mode=self.mode)
+            best = rows[0] if rows and rows[0]["metric"] is not None else None
+            with self._lock:
+                self.journal.append({
+                    "event": "study_end",
+                    "best_trial": best["trial_id"] if best else None,
+                    "best_metric": best["metric"] if best else None,
+                    "total_iterations": self._spent})
+            self._log_event("study_end",
+                            best_trial=best["trial_id"] if best else None,
+                            best_metric=best["metric"] if best else None,
+                            total_iterations=self._spent)
+        finally:
+            self.backend.close()
+            self.journal.close()
+        return {"leaderboard": rows, "best": best,
+                "models": dict(self._model_paths),
+                "journal_path": self.journal_path,
+                "spent_iterations": self._spent,
+                "worker_stats": list(self._worker_stats),
+                "workdir": self.workdir}

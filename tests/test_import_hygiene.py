@@ -47,6 +47,14 @@ _GATED_MODULES = [
     "synapseml_tpu.io.serving_worker",
     "synapseml_tpu.io.tenancy",
     "synapseml_tpu.gbdt.boost",
+    # the tuning package orchestrates and journals pre-accelerator; jax
+    # enters only when a trial segment actually trains
+    "synapseml_tpu.tuning",
+    "synapseml_tpu.tuning.scheduler",
+    "synapseml_tpu.tuning.journal",
+    "synapseml_tpu.tuning.executor",
+    "synapseml_tpu.tuning.study",
+    "synapseml_tpu.tuning.trial_worker",
     # PEP 562 lazy packages (core/lazyimport.py): the package import must
     # stay jax-free even though the submodules underneath use jax
     # everywhere — lint rule SMT008 enforces the __init__ shape, this gate
@@ -75,7 +83,7 @@ _TOOLS_DIR = os.path.join(
 # artifacts; they must stay jax-free (tools/ is not a package — imported
 # via a path entry)
 _GATED_TOOLS = ["trace_dump", "lint", "perf_diff", "perf_timeline",
-                "slo_report", "spmd_diff", "check_device"]
+                "slo_report", "spmd_diff", "check_device", "tune_report"]
 
 
 def test_no_jax_at_import():

@@ -785,3 +785,129 @@ def test_fsdp_only_layout_stores_without_model_axis():
             if r["decision"] == "fsdp"} == {"w1", "w2"}
     np.testing.assert_allclose(np.asarray(fn({"x": x})["y"]), ref,
                                rtol=1e-5, atol=1e-6)
+
+
+def _np_sig(v):
+    return 1.0 / (1.0 + np.exp(-v))
+
+
+def test_lstm_matches_numpy_reference():
+    """Forward iofc LSTM with bias, initial states and peepholes against a
+    step-by-step numpy reference of the ONNX gate equations."""
+    from synapseml_tpu.onnx.ops import OPS
+
+    rng = np.random.default_rng(3)
+    s, b, i, h = 5, 2, 3, 4
+    x = rng.normal(size=(s, b, i)).astype(np.float32)
+    w = rng.normal(size=(1, 4 * h, i)).astype(np.float32)
+    r = rng.normal(size=(1, 4 * h, h)).astype(np.float32)
+    bias = rng.normal(size=(1, 8 * h)).astype(np.float32)
+    h0 = rng.normal(size=(1, b, h)).astype(np.float32)
+    c0 = rng.normal(size=(1, b, h)).astype(np.float32)
+    p = rng.normal(size=(1, 3 * h)).astype(np.float32)
+
+    y, y_h, y_c = OPS["LSTM"](
+        [jnp.asarray(x), w, r, bias, None, h0, c0, p],
+        {"hidden_size": h}, {"op_type": "LSTM", "opset": 17})
+    assert np.asarray(y).shape == (s, 1, b, h)
+    assert np.asarray(y_h).shape == (1, b, h)
+
+    hc, cc = h0[0].astype(np.float64), c0[0].astype(np.float64)
+    pi, po, pf = np.split(p[0].astype(np.float64), 3)
+    cb = (bias[0, :4 * h] + bias[0, 4 * h:]).astype(np.float64)
+    ys = []
+    for t in range(s):
+        zi, zo, zf, zc = np.split(x[t] @ w[0].T + hc @ r[0].T + cb, 4, axis=-1)
+        gi, gf = _np_sig(zi + pi * cc), _np_sig(zf + pf * cc)
+        cc = gf * cc + gi * np.tanh(zc)
+        hc = _np_sig(zo + po * cc) * np.tanh(cc)
+        ys.append(hc)
+    np.testing.assert_allclose(np.asarray(y)[:, 0], np.stack(ys), rtol=2e-5, atol=2e-5)
+    np.testing.assert_allclose(np.asarray(y_h)[0], hc, rtol=2e-5, atol=2e-5)
+    np.testing.assert_allclose(np.asarray(y_c)[0], cc, rtol=2e-5, atol=2e-5)
+
+
+def test_lstm_defaults_zero_state():
+    """Omitted B/initial_h/initial_c behave as zeros."""
+    from synapseml_tpu.onnx.ops import OPS
+
+    rng = np.random.default_rng(4)
+    s, b, i, h = 3, 1, 2, 2
+    x = rng.normal(size=(s, b, i)).astype(np.float32)
+    w = rng.normal(size=(1, 4 * h, i)).astype(np.float32)
+    r = rng.normal(size=(1, 4 * h, h)).astype(np.float32)
+    y1, h1, c1 = OPS["LSTM"]([jnp.asarray(x), w, r], {"hidden_size": h},
+                             {"op_type": "LSTM", "opset": 17})
+    y2, h2, c2 = OPS["LSTM"](
+        [jnp.asarray(x), w, r, np.zeros((1, 8 * h), np.float32), None,
+         np.zeros((1, b, h), np.float32), np.zeros((1, b, h), np.float32)],
+        {"hidden_size": h}, {"op_type": "LSTM", "opset": 17})
+    np.testing.assert_allclose(np.asarray(y1), np.asarray(y2), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(c1), np.asarray(c2), rtol=1e-6)
+
+
+@pytest.mark.parametrize("lbr", [0, 1])
+def test_gru_matches_numpy_reference(lbr):
+    """Forward zrh GRU, both linear_before_reset modes, vs numpy."""
+    from synapseml_tpu.onnx.ops import OPS
+
+    rng = np.random.default_rng(7 + lbr)
+    s, b, i, h = 4, 3, 2, 5
+    x = rng.normal(size=(s, b, i)).astype(np.float32)
+    w = rng.normal(size=(1, 3 * h, i)).astype(np.float32)
+    r = rng.normal(size=(1, 3 * h, h)).astype(np.float32)
+    bias = rng.normal(size=(1, 6 * h)).astype(np.float32)
+    h0 = rng.normal(size=(1, b, h)).astype(np.float32)
+
+    y, y_h = OPS["GRU"](
+        [jnp.asarray(x), w, r, bias, None, h0],
+        {"hidden_size": h, "linear_before_reset": lbr},
+        {"op_type": "GRU", "opset": 17})
+    assert np.asarray(y).shape == (s, 1, b, h)
+
+    hc = h0[0].astype(np.float64)
+    wb, rb = bias[0, :3 * h].astype(np.float64), bias[0, 3 * h:].astype(np.float64)
+    wz, wr, wh = np.split(w[0].astype(np.float64), 3)
+    rz, rr, rh = np.split(r[0].astype(np.float64), 3)
+    wbz, wbr, wbh = np.split(wb, 3)
+    rbz, rbr, rbh = np.split(rb, 3)
+    ys = []
+    for t in range(s):
+        z = _np_sig(x[t] @ wz.T + hc @ rz.T + wbz + rbz)
+        rg = _np_sig(x[t] @ wr.T + hc @ rr.T + wbr + rbr)
+        if lbr:
+            hh = np.tanh(x[t] @ wh.T + rg * (hc @ rh.T + rbh) + wbh)
+        else:
+            hh = np.tanh(x[t] @ wh.T + (rg * hc) @ rh.T + wbh + rbh)
+        hc = (1.0 - z) * hh + z * hc
+        ys.append(hc)
+    np.testing.assert_allclose(np.asarray(y)[:, 0], np.stack(ys), rtol=2e-5, atol=2e-5)
+    np.testing.assert_allclose(np.asarray(y_h)[0], hc, rtol=2e-5, atol=2e-5)
+
+
+def test_lstm_graph_end_to_end():
+    """LSTM inside a graph: multi-output wiring and downstream consumption."""
+    rng = np.random.default_rng(11)
+    s, b, i, h = 4, 2, 3, 3
+    w = rng.normal(size=(1, 4 * h, i)).astype(np.float32)
+    r = rng.normal(size=(1, 4 * h, h)).astype(np.float32)
+    fn = build_fn(
+        [node("LSTM", ["x", "w", "r"], ["y", "y_h", "y_c"], hidden_size=h),
+         node("Relu", ["y_h"], ["z"])],
+        [value_info("x", np.float32, [s, b, i])],
+        [value_info("y", np.float32, None), value_info("z", np.float32, None)],
+        {"w": w, "r": r},
+    )
+    x = rng.normal(size=(s, b, i)).astype(np.float32)
+    out = fn({"x": x})
+    direct = np.asarray(OPS_LSTM_REF(x, w, r))
+    np.testing.assert_allclose(np.asarray(out["y"]), direct, rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(out["z"]), np.maximum(direct[-1], 0), rtol=1e-5, atol=1e-5)
+
+
+def OPS_LSTM_REF(x, w, r):
+    from synapseml_tpu.onnx.ops import OPS
+    y, _, _ = OPS["LSTM"]([jnp.asarray(x), w, r], {"hidden_size": r.shape[-1]},
+                          {"op_type": "LSTM", "opset": 17})
+    return y

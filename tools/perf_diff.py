@@ -55,6 +55,7 @@ PRIMARY = {
     "flash_attention_gqa": "tflops_nominal",
     "onnx_tp_sharding": "rows_per_sec",
     "onnx_fsdp_hbm": "rows_per_sec",
+    "hyperparam_search": "search_speedup",
 }
 
 

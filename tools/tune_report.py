@@ -1,0 +1,219 @@
+#!/usr/bin/env python
+"""Leaderboard / rung report for a tuning study journal.
+
+Reads the append-only JSONL journal that ``synapseml_tpu.tuning`` writes
+(one event per line: ``study`` header, ``trial`` specs, ``rung``
+landings, ``promote``, ``terminal``, ``study_end``) and renders the
+study leaderboard plus the per-rung survival table:
+
+    python tools/tune_report.py study.jsonl             # tables
+    python tools/tune_report.py study.jsonl --json      # machine-readable
+    python tools/tune_report.py study.jsonl --check golden.jsonl
+    python tools/tune_report.py study.jsonl --check golden.jsonl --tol 1e-6
+
+``--check`` compares the study's best metric against a golden journal's
+and exits 1 when it regressed by more than ``--tol`` (or when the study
+produced no completed trial at all) — the CI gate for "the scheduler
+still finds what it used to find".
+
+Stdlib-only and import-hygiene-gated (``tests/test_import_hygiene.py``):
+it parses the journal format directly and never imports
+``synapseml_tpu`` — pointing it at a journal from a wedged study must
+never drag jax into the process doing the looking.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Any, Dict, List, Optional
+
+
+def load_events(path: str) -> List[Dict[str, Any]]:
+    """Journal lines; a truncated/garbled tail (the crash case the format
+    exists for) is skipped, not fatal. Mirrors ``tuning.journal.read_journal``."""
+    events: List[Dict[str, Any]] = []
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                ev = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(ev, dict) and "event" in ev:
+                events.append(ev)
+    return events
+
+
+def reduce_study(events: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Header + per-trial leaderboard rows + per-rung survival stats.
+
+    The row reduction mirrors ``tuning.journal.leaderboard`` exactly
+    (later events win; re-journaled rungs keyed by ``iters`` replace
+    pre-crash partials) so this report and the in-process study result
+    agree byte for byte.
+    """
+    header: Dict[str, Any] = {}
+    end: Optional[Dict[str, Any]] = None
+    trials: Dict[int, Dict[str, Any]] = {}
+    promotes: List[Dict[str, Any]] = []
+    for ev in events:
+        kind = ev.get("event")
+        if kind == "study":
+            header = {k: ev.get(k) for k in
+                      ("study_seed", "n_trials", "eta", "rungs", "metric",
+                       "mode", "digest")}
+        elif kind == "study_end":
+            end = ev
+        elif kind == "promote":
+            promotes.append(ev)
+        elif kind == "trial":
+            t = int(ev["trial_id"])
+            trials[t] = {"trial_id": t, "params": ev.get("params") or {},
+                         "state": "pending", "iterations": 0, "metric": None,
+                         "_rungs": {}}
+        elif kind == "rung" and int(ev.get("trial_id", -1)) in trials:
+            row = trials[int(ev["trial_id"])]
+            row["_rungs"][int(ev.get("iters", 0))] = {
+                "rung": ev.get("rung"), "iters": ev.get("iters"),
+                "metric": ev.get("metric")}
+            row["iterations"] = max(row["iterations"], int(ev.get("iters", 0)))
+            if ev.get("metric") is not None:
+                row["metric"] = ev["metric"]
+        elif kind == "terminal" and int(ev.get("trial_id", -1)) in trials:
+            row = trials[int(ev["trial_id"])]
+            row["state"] = ev.get("state", "completed")
+            if ev.get("metric") is not None:
+                row["metric"] = ev["metric"]
+            if ev.get("iterations") is not None:
+                row["iterations"] = int(ev["iterations"])
+
+    mode = header.get("mode") or "max"
+    for row in trials.values():
+        by_iters = row.pop("_rungs")
+        row["rungs"] = [by_iters[k] for k in sorted(by_iters)]
+
+    def _key(row):
+        m = row["metric"]
+        bad = m is None
+        s = 0.0 if bad else (float(m) if mode == "max" else -float(m))
+        return (bad, -s, row["trial_id"])
+
+    rows = sorted(trials.values(), key=_key)
+
+    rung_targets = header.get("rungs") or []
+    rung_stats = []
+    promoted_by_rung: Dict[int, int] = {}
+    for p in promotes:
+        ri = p.get("rung")
+        if ri is not None:
+            promoted_by_rung[int(ri)] = promoted_by_rung.get(int(ri), 0) + 1
+    for ri, target in enumerate(rung_targets):
+        landed = [r for row in rows for r in row["rungs"]
+                  if r.get("iters") == target]
+        metrics = [r["metric"] for r in landed if r.get("metric") is not None]
+        if metrics:
+            best = max(metrics) if mode == "max" else min(metrics)
+        else:
+            best = None
+        rung_stats.append({"rung": ri, "iters": target, "landed": len(landed),
+                           "promoted": promoted_by_rung.get(ri, 0),
+                           "best_metric": best})
+
+    best_row = rows[0] if rows and rows[0]["metric"] is not None else None
+    return {"header": header, "leaderboard": rows, "rungs": rung_stats,
+            "end": end, "best": best_row}
+
+
+def _fmt_metric(m) -> str:
+    return "-" if m is None else f"{float(m):.6f}"
+
+
+def render(study: Dict[str, Any]) -> str:
+    h = study["header"]
+    out = []
+    out.append(f"study  seed={h.get('study_seed')}  metric={h.get('metric')} "
+               f"({h.get('mode')})  eta={h.get('eta')}  "
+               f"rungs={h.get('rungs')}  digest={h.get('digest')}")
+    out.append("")
+    out.append(f"{'trial':>5}  {'state':<9} {'iters':>6}  {'metric':>10}  params")
+    for row in study["leaderboard"]:
+        params = json.dumps(row["params"], sort_keys=True)
+        out.append(f"{row['trial_id']:>5}  {row['state']:<9} "
+                   f"{row['iterations']:>6}  {_fmt_metric(row['metric']):>10}  "
+                   f"{params}")
+    out.append("")
+    out.append(f"{'rung':>4}  {'iters':>6}  {'landed':>6}  {'promoted':>8}  "
+               f"{'best':>10}")
+    for r in study["rungs"]:
+        out.append(f"{r['rung']:>4}  {r['iters']:>6}  {r['landed']:>6}  "
+                   f"{r['promoted']:>8}  {_fmt_metric(r['best_metric']):>10}")
+    end = study.get("end")
+    if end:
+        out.append("")
+        out.append(f"study_end  best_trial={end.get('best_trial')}  "
+                   f"best_metric={_fmt_metric(end.get('best_metric'))}  "
+                   f"total_iterations={end.get('total_iterations')}")
+    return "\n".join(out)
+
+
+def check(study: Dict[str, Any], golden: Dict[str, Any],
+          tol: float) -> List[str]:
+    """Regression verdicts vs a golden journal; empty list = pass."""
+    problems = []
+    best = study.get("best")
+    if best is None:
+        problems.append("no trial produced a metric")
+        return problems
+    gold_best = golden.get("best")
+    if gold_best is None:
+        return problems  # golden had nothing to hold us to
+    mode = (study["header"].get("mode") or "max")
+    cur, ref = float(best["metric"]), float(gold_best["metric"])
+    regressed = (cur < ref - tol) if mode == "max" else (cur > ref + tol)
+    if regressed:
+        problems.append(
+            f"best {study['header'].get('metric')} regressed: "
+            f"{cur:.6f} vs golden {ref:.6f} (tol {tol})")
+    completed = sum(1 for r in study["leaderboard"]
+                    if r["state"] == "completed")
+    if completed < 1:
+        problems.append("no completed trial")
+    return problems
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="tune_report",
+        description="leaderboard / rung report for a tuning study journal")
+    ap.add_argument("journal", help="study journal (JSONL)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit the reduced study as JSON")
+    ap.add_argument("--check", metavar="GOLDEN",
+                    help="golden journal to compare the best metric against; "
+                         "exit 1 on regression")
+    ap.add_argument("--tol", type=float, default=0.0,
+                    help="allowed best-metric slack for --check (default 0)")
+    args = ap.parse_args(argv)
+
+    study = reduce_study(load_events(args.journal))
+    if args.as_json:
+        print(json.dumps(study, indent=2, sort_keys=True, default=str))
+    else:
+        print(render(study))
+    if args.check:
+        problems = check(study, reduce_study(load_events(args.check)),
+                         args.tol)
+        for p in problems:
+            print(f"CHECK FAIL: {p}", file=sys.stderr)
+        if problems:
+            return 1
+        print("check: ok", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
